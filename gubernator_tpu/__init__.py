@@ -1,0 +1,39 @@
+"""gubernator_tpu — a TPU-native distributed rate-limiting framework.
+
+A ground-up redesign of the capabilities of mailgun/gubernator (reference:
+/root/reference) for TPU hardware:
+
+- The counter hot path (reference algorithms.go) is a single vectorized
+  int64 decide() kernel (JAX/XLA) over an HBM-resident slot table holding
+  millions of keys, instead of per-key read-modify-write in worker
+  goroutines (reference workers.go).
+- GLOBAL behavior's hit aggregation + state broadcast (reference global.go)
+  runs as ICI collectives (lax.psum) on a jax.sharding.Mesh inside a pod,
+  with gRPC retained at the edge and across pods.
+- The API surface (gRPC V1/PeersV1 + HTTP/JSON gateway), algorithms,
+  behavior flags, consistent-hash peer ownership, discovery, and
+  Loader/Store seams match the reference's contract
+  (gubernator.proto, peers.proto).
+"""
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    Status,
+    RateLimitReq,
+    RateLimitResp,
+    HealthCheckResp,
+    has_behavior,
+)
+from gubernator_tpu.version import __version__
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "Status",
+    "RateLimitReq",
+    "RateLimitResp",
+    "HealthCheckResp",
+    "has_behavior",
+    "__version__",
+]

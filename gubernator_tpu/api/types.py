@@ -1,0 +1,127 @@
+"""Core request/response types and enums.
+
+Semantics match the reference proto contract (reference gubernator.proto:56-213
+and peers.proto:36-73). These are plain Python dataclasses used on the host
+side; the wire formats (protobuf for gRPC, JSON for the HTTP gateway) are
+defined in gubernator_tpu.service.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Duration constants in milliseconds (mirrors the reference client constants).
+MILLISECOND = 1
+SECOND = 1000
+MINUTE = 60 * SECOND
+HOUR = 60 * MINUTE
+
+# Hard cap on items per GetRateLimits call (reference gubernator.go:40).
+MAX_BATCH_SIZE = 1000
+
+
+class Algorithm(enum.IntEnum):
+    """Rate limit algorithm (reference gubernator.proto:56-61)."""
+
+    TOKEN_BUCKET = 0
+    LEAKY_BUCKET = 1
+
+
+class Behavior(enum.IntFlag):
+    """Per-request behavior bit flags (reference gubernator.proto:64-135).
+
+    Config travels with the request: the service holds no per-limit
+    configuration, only counter state.
+    """
+
+    BATCHING = 0  # default; present for parity, has no effect when used
+    NO_BATCHING = 1
+    GLOBAL = 2
+    DURATION_IS_GREGORIAN = 4
+    RESET_REMAINING = 8
+    MULTI_REGION = 16
+    DRAIN_OVER_LIMIT = 32
+
+
+class Status(enum.IntEnum):
+    """Rate limit decision status (reference gubernator.proto:185-188)."""
+
+    UNDER_LIMIT = 0
+    OVER_LIMIT = 1
+
+
+def has_behavior(behavior: int, flag: Behavior) -> bool:
+    """Bit test (reference gubernator.go:776-781)."""
+    if flag == Behavior.BATCHING:
+        return behavior == 0
+    return bool(behavior & flag)
+
+
+@dataclass
+class RateLimitReq:
+    """A single rate limit check (reference gubernator.proto:137-183)."""
+
+    name: str = ""
+    unique_key: str = ""
+    hits: int = 0
+    limit: int = 0
+    duration: int = 0  # milliseconds (or Gregorian interval enum)
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    behavior: int = 0
+    burst: int = 0
+    metadata: Dict[str, str] = field(default_factory=dict)
+    # Epoch ms when the request was created; filled by the server if unset
+    # (reference gubernator.proto:172-182).
+    created_at: Optional[int] = None
+
+    def hash_key(self) -> str:
+        """The canonical cache/ownership key (reference client.go:39-41)."""
+        return self.name + "_" + self.unique_key
+
+
+@dataclass
+class RateLimitResp:
+    """A single rate limit decision (reference gubernator.proto:190-203)."""
+
+    status: int = Status.UNDER_LIMIT
+    limit: int = 0
+    remaining: int = 0
+    reset_time: int = 0  # epoch ms when the limit window resets
+    error: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class HealthCheckResp:
+    """Service health (reference gubernator.proto:206-213)."""
+
+    status: str = "healthy"  # 'healthy' | 'unhealthy'
+    message: str = ""
+    peer_count: int = 0
+
+
+@dataclass
+class UpdatePeerGlobal:
+    """Owner-to-replica state push for one GLOBAL key
+    (reference peers.proto:52-72)."""
+
+    key: str = ""
+    status: RateLimitResp = field(default_factory=RateLimitResp)
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    duration: int = 0
+    created_at: int = 0
+
+
+def validate_request(req: RateLimitReq) -> Optional[str]:
+    """Per-item validation; returns an error string or None.
+
+    Error strings match the reference exactly (functional_test.go
+    TestMissingFields expectations; reference gubernator.go:205-213).
+    """
+    if not req.name:
+        return "field 'namespace' cannot be empty"
+    if not req.unique_key:
+        return "field 'unique_key' cannot be empty"
+    return None

@@ -1,0 +1,20 @@
+from gubernator_tpu.models.bucket import (
+    FIXED_SHIFT,
+    FIXED_ONE,
+    LeakyBucketState,
+    TokenBucketState,
+    leak_fixed,
+    rate_int,
+)
+from gubernator_tpu.models.oracle import CacheEntry, OracleEngine
+
+__all__ = [
+    "FIXED_SHIFT",
+    "FIXED_ONE",
+    "LeakyBucketState",
+    "TokenBucketState",
+    "leak_fixed",
+    "rate_int",
+    "CacheEntry",
+    "OracleEngine",
+]

@@ -1,0 +1,120 @@
+"""Bucket state records and the exact integer arithmetic of the spec.
+
+The reference keeps per-key mutable state in two structs
+(reference store.go:29-43): `TokenBucketItem{Status, Limit, Duration,
+Remaining, CreatedAt}` and `LeakyBucketItem{Limit, Duration, Remaining
+float64, UpdatedAt, Burst}`.
+
+Design decision (TPU-first): the leaky bucket's fractional `Remaining` is
+kept in **Q44.20 fixed point** (int64, scale 2^20 ≈ 1e-6 token resolution)
+instead of float64. TPUs have no native f64, and fixed point makes the
+device kernel, the host oracle, and every replica bit-identical — a feature
+for a distributed system that the reference's float64 math does not have.
+All observable semantics (truncation to whole tokens, leak-accrual
+threshold, burst clamping) match the reference's float64 behavior except
+within 2^-20 of a token boundary.
+
+`leak_fixed` is the one nontrivial op: floor(elapsed * limit * SCALE /
+rate_num) computed without 128-bit intermediates, so the identical sequence
+of int64 ops runs inside the XLA kernel (ops/decide.py) and in this pure
+Python spec. Its exactness (vs bignum) is unit-tested in
+tests/test_fixedpoint.py over the validated input domain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from gubernator_tpu.api.types import Status
+
+# Fixed-point scale for leaky-bucket fractional remaining.
+FIXED_SHIFT = 20
+FIXED_ONE = 1 << FIXED_SHIFT
+
+# Validated input domain (enforced host-side in batch assembly). Within
+# these bounds every intermediate in leak_fixed fits in int64.
+MAX_ELAPSED_MS = 1 << 42  # ~139 years
+MAX_DURATION_MS = 1 << 42
+MAX_COUNT = (1 << 31) - 1  # limit, burst, |hits|
+
+
+@dataclass
+class TokenBucketState:
+    """Mutable token-bucket counter (reference store.go:36-43)."""
+
+    status: int = Status.UNDER_LIMIT
+    limit: int = 0
+    duration: int = 0
+    remaining: int = 0
+    created_at: int = 0
+
+
+@dataclass
+class LeakyBucketState:
+    """Mutable leaky-bucket counter (reference store.go:29-34).
+
+    `remaining_s` is Q44.20 fixed point (whole tokens = remaining_s >>
+    FIXED_SHIFT, matching the reference's int64(b.Remaining) truncation).
+    """
+
+    limit: int = 0
+    duration: int = 0
+    remaining_s: int = 0
+    updated_at: int = 0
+    burst: int = 0
+
+
+def leak_fixed(elapsed: int, limit: int, rate_num: int, burst: int) -> int:
+    """Fixed-point leak accrual: min(floor(elapsed*limit*2^20 / rate_num),
+    (burst+1) << 20), for elapsed >= 0.
+
+    The reference computes `leak = float64(elapsed) / rate` with
+    `rate = rate_num / limit` (reference algorithms.go:336, 360-362). The
+    result is saturated just above `burst` because the caller clamps
+    remaining to burst immediately after accrual (algorithms.go:369-371),
+    so any leak >= burst+1 tokens is observationally equivalent.
+
+    Every intermediate fits int64 when elapsed <= 2^42, rate_num <= 2^42,
+    limit <= 2^31, burst <= 2^31 — the same ops run under jit in the
+    device kernel. Division is by-parts (16-bit split of `limit`) to avoid
+    the 128-bit product elapsed*limit*2^20.
+    """
+    if elapsed <= 0:
+        return 0
+    limit_g = max(limit, 1)
+    rate_num = max(rate_num, 1)  # duration 0 => immediate full refill
+    cap_t = burst + 1
+
+    e_c = min(elapsed, MAX_ELAPSED_MS)
+    a = e_c // rate_num  # whole rate-periods elapsed
+    e = e_c % rate_num  # partial period, < rate_num
+
+    # Whole-period token credit a*limit, saturated at cap_t.
+    a_lim = cap_t // limit_g + 1
+    a_c = min(a, a_lim)
+    whole = a_c * limit  # <= cap_t + 2*limit, fits easily
+    saturated = (a > a_lim) | (whole >= cap_t)
+
+    # Partial-period credit: floor(e*limit / rate_num) tokens + fixed frac.
+    hi = limit >> 16
+    lo = limit & 0xFFFF
+    p1 = e * hi
+    q1, r1 = divmod(p1, rate_num)
+    q2, r2 = divmod(r1 << 16, rate_num)
+    p2 = e * lo
+    q3, r3 = divmod(r2 + p2, rate_num)
+    tok = (q1 << 16) + q2 + q3  # == e*limit // rate_num exactly
+    frac_s = (r3 << FIXED_SHIFT) // rate_num
+
+    cap_s = cap_t << FIXED_SHIFT
+    if saturated:
+        return cap_s
+    leak_s = ((whole + tok) << FIXED_SHIFT) + frac_s
+    return min(leak_s, cap_s)
+
+
+def rate_int(rate_num: int, limit: int) -> int:
+    """int64(rate) where rate = rate_num/limit (reference
+    algorithms.go:336, 377). Guarded against limit==0 (the reference
+    produces +Inf there; tests never exercise it)."""
+    return rate_num // max(limit, 1)

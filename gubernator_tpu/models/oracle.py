@@ -1,0 +1,388 @@
+"""Reference oracle: the behavioral spec of the decide step.
+
+A dict-backed, sequential, pure-Python engine implementing the exact
+observable semantics of the reference's hot path (reference
+algorithms.go:37-493, cache.go:43-57, gubernator.go:183-309). It exists to
+
+1. pin the semantics with transcribed golden tests (tests/test_oracle_*),
+2. serve as the fuzz target the vectorized TPU kernel must match bit-for-bit,
+3. document every branch the kernel has to reproduce as masked vector ops.
+
+Branch order is deliberately identical to the reference, including its
+quirks (sticky token-bucket Status, the stale-response path when a duration
+change renews an expired item, over-limit rejections not consuming hits,
+new-item rate computed from the raw duration field under Gregorian).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    has_behavior,
+    validate_request,
+    MAX_BATCH_SIZE,
+)
+from gubernator_tpu.models.bucket import (
+    FIXED_SHIFT,
+    LeakyBucketState,
+    TokenBucketState,
+    leak_fixed,
+    rate_int,
+)
+from gubernator_tpu.utils import gregorian as greg
+
+
+@dataclass
+class CacheEntry:
+    """Host-side mirror of the reference CacheItem (reference cache.go:29-41)."""
+
+    algorithm: int
+    key: str
+    value: object
+    expire_at: int = 0
+    invalid_at: int = 0
+
+    def is_expired(self, now: int) -> bool:
+        # reference cache.go:43-57
+        if self.invalid_at != 0 and self.invalid_at < now:
+            return True
+        return self.expire_at < now
+
+
+class OracleEngine:
+    """Sequential in-memory rate limiter with exact reference semantics."""
+
+    def __init__(self, store=None):
+        self.cache: Dict[str, CacheEntry] = {}
+        self.store = store  # optional Store plugin (read-through/write-behind)
+
+    # -- public API ---------------------------------------------------------
+
+    def get_rate_limits(
+        self, reqs: List[RateLimitReq], now_ms: int, is_owner: bool = True
+    ) -> List[RateLimitResp]:
+        if len(reqs) > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        out = []
+        for r in reqs:
+            err = validate_request(r)
+            if err is not None:
+                out.append(RateLimitResp(error=err))
+                continue
+            out.append(self.decide(r, now_ms, is_owner))
+        return out
+
+    def decide(
+        self, r: RateLimitReq, now_ms: int, is_owner: bool = True
+    ) -> RateLimitResp:
+        if r.created_at is None:
+            r.created_at = now_ms
+        if r.algorithm == Algorithm.LEAKY_BUCKET:
+            return self._leaky_bucket(r, now_ms, is_owner)
+        return self._token_bucket(r, now_ms, is_owner)
+
+    # -- cache access with lazy expiry --------------------------------------
+
+    def _get(self, r: RateLimitReq, now_ms: int) -> Optional[CacheEntry]:
+        key = r.hash_key()
+        item = self.cache.get(key)
+        if item is not None and item.is_expired(now_ms):
+            # lazy removal on read (reference lrucache.go:111-128)
+            del self.cache[key]
+            item = None
+        if item is None and self.store is not None:
+            # read-through on cache miss (reference algorithms.go:45-51)
+            item = self.store.get(r)
+            if item is not None:
+                self.cache[item.key] = item
+        return item
+
+    def _remove(self, key: str) -> None:
+        self.cache.pop(key, None)
+        if self.store is not None:
+            self.store.remove(key)
+
+    def _on_change(self, r: RateLimitReq, item: CacheEntry, is_owner: bool) -> None:
+        # write-behind (reference algorithms.go:149-153, 252-254, 488-490)
+        if self.store is not None and is_owner:
+            self.store.on_change(r, item)
+
+    # -- token bucket (reference algorithms.go:37-257) -----------------------
+
+    def _token_bucket(
+        self, r: RateLimitReq, now_ms: int, is_owner: bool
+    ) -> RateLimitResp:
+        key = r.hash_key()
+        item = self._get(r, now_ms)
+
+        if item is not None:
+            if has_behavior(r.behavior, Behavior.RESET_REMAINING):
+                # reference algorithms.go:78-90
+                self._remove(key)
+                return RateLimitResp(
+                    status=Status.UNDER_LIMIT,
+                    limit=r.limit,
+                    remaining=r.limit,
+                    reset_time=0,
+                )
+            if item.algorithm != Algorithm.TOKEN_BUCKET:
+                # algorithm switch resets state (reference algorithms.go:91-103)
+                self._remove(key)
+                return self._token_bucket_new_item(r, now_ms, is_owner)
+
+            t: TokenBucketState = item.value
+
+            # Limit hot-change: credit/debit the difference
+            # (reference algorithms.go:105-113).
+            if t.limit != r.limit:
+                t.remaining += r.limit - t.limit
+                if t.remaining < 0:
+                    t.remaining = 0
+                t.limit = r.limit
+
+            rl = RateLimitResp(
+                status=t.status,
+                limit=r.limit,
+                remaining=t.remaining,
+                reset_time=item.expire_at,
+            )
+
+            # Duration hot-change, possibly renewing an expired-by-new-rules
+            # item (reference algorithms.go:122-147). Note the reference does
+            # NOT refresh rl.remaining after a renewal — preserved here.
+            if t.duration != r.duration:
+                expire = t.created_at + r.duration
+                if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+                    expire = greg.gregorian_expiration(now_ms, r.duration)
+                created_at = r.created_at
+                if expire <= created_at:
+                    expire = created_at + r.duration
+                    t.created_at = created_at
+                    t.remaining = t.limit
+                item.expire_at = expire
+                t.duration = r.duration
+                rl.reset_time = expire
+
+            self._on_change(r, item, is_owner)
+
+            # Status/config read only (reference algorithms.go:157-159).
+            if r.hits == 0:
+                return rl
+
+            # Already at the limit (reference algorithms.go:162-170).
+            # Sticky: stored status flips to OVER_LIMIT.
+            if rl.remaining == 0 and r.hits > 0:
+                rl.status = Status.OVER_LIMIT
+                t.status = Status.OVER_LIMIT
+                return rl
+
+            # Exact drain (reference algorithms.go:173-178).
+            if t.remaining == r.hits:
+                t.remaining = 0
+                rl.remaining = 0
+                return rl
+
+            # Over the limit: reject WITHOUT consuming, unless
+            # DRAIN_OVER_LIMIT (reference algorithms.go:182-194).
+            if r.hits > t.remaining:
+                rl.status = Status.OVER_LIMIT
+                if has_behavior(r.behavior, Behavior.DRAIN_OVER_LIMIT):
+                    t.remaining = 0
+                    rl.remaining = 0
+                return rl
+
+            t.remaining -= r.hits
+            rl.remaining = t.remaining
+            return rl
+
+        return self._token_bucket_new_item(r, now_ms, is_owner)
+
+    def _token_bucket_new_item(
+        self, r: RateLimitReq, now_ms: int, is_owner: bool
+    ) -> RateLimitResp:
+        # reference algorithms.go:206-257
+        created_at = r.created_at
+        expire = created_at + r.duration
+        t = TokenBucketState(
+            status=Status.UNDER_LIMIT,
+            limit=r.limit,
+            duration=r.duration,
+            remaining=r.limit - r.hits,
+            created_at=created_at,
+        )
+        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+            expire = greg.gregorian_expiration(now_ms, r.duration)
+
+        rl = RateLimitResp(
+            status=Status.UNDER_LIMIT,
+            limit=r.limit,
+            remaining=t.remaining,
+            reset_time=expire,
+        )
+
+        # First request already over the limit: do not consume; note the
+        # stored status stays UNDER_LIMIT (reference algorithms.go:240-248).
+        if r.hits > r.limit:
+            rl.status = Status.OVER_LIMIT
+            rl.remaining = r.limit
+            t.remaining = r.limit
+
+        item = CacheEntry(
+            algorithm=Algorithm.TOKEN_BUCKET, key=r.hash_key(), value=t, expire_at=expire
+        )
+        self.cache[item.key] = item
+        self._on_change(r, item, is_owner)
+        return rl
+
+    # -- leaky bucket (reference algorithms.go:260-493) -----------------------
+
+    def _leaky_bucket(
+        self, r: RateLimitReq, now_ms: int, is_owner: bool
+    ) -> RateLimitResp:
+        if r.burst == 0:
+            r.burst = r.limit  # reference algorithms.go:264-266
+        created_at = r.created_at
+        key = r.hash_key()
+        item = self._get(r, now_ms)
+
+        if item is not None:
+            if item.algorithm != Algorithm.LEAKY_BUCKET:
+                # reference algorithms.go:308-318
+                self._remove(key)
+                return self._leaky_bucket_new_item(r, now_ms, is_owner)
+
+            b: LeakyBucketState = item.value
+
+            if has_behavior(r.behavior, Behavior.RESET_REMAINING):
+                b.remaining_s = r.burst << FIXED_SHIFT  # algorithms.go:320-322
+
+            # Burst hot-change (reference algorithms.go:325-330).
+            if b.burst != r.burst:
+                if r.burst > (b.remaining_s >> FIXED_SHIFT):
+                    b.remaining_s = r.burst << FIXED_SHIFT
+                b.burst = r.burst
+
+            b.limit = r.limit
+            b.duration = r.duration  # algorithms.go:332-333
+
+            duration = r.duration
+            rate_num = duration  # rate = rate_num / limit
+            if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+                # Rate uses the full Gregorian interval; effective duration
+                # runs to the end of the interval (algorithms.go:338-354).
+                rate_num = greg.gregorian_duration(now_ms, r.duration)
+                expire = greg.gregorian_expiration(now_ms, r.duration)
+                duration = expire - now_ms
+
+            if r.hits != 0:
+                item.expire_at = created_at + duration  # algorithms.go:356-358
+
+            # Leak accrual since last update (algorithms.go:360-367).
+            elapsed = created_at - b.updated_at
+            leak_s = leak_fixed(elapsed, r.limit, rate_num, b.burst)
+            if (leak_s >> FIXED_SHIFT) > 0:
+                b.remaining_s += leak_s
+                b.updated_at = created_at
+
+            # Burst clamp (algorithms.go:369-371) — unconditional.
+            if (b.remaining_s >> FIXED_SHIFT) > b.burst:
+                b.remaining_s = b.burst << FIXED_SHIFT
+
+            ri = rate_int(rate_num, r.limit)
+            rem = b.remaining_s >> FIXED_SHIFT
+            rl = RateLimitResp(
+                status=Status.UNDER_LIMIT,
+                limit=b.limit,
+                remaining=rem,
+                reset_time=created_at + (b.limit - rem) * ri,
+            )
+
+            self._on_change(r, item, is_owner)
+
+            # Already at the limit (algorithms.go:389-395).
+            if rem == 0 and r.hits > 0:
+                rl.status = Status.OVER_LIMIT
+                return rl
+
+            # Exact drain — note this precedes the hits==0 check, so a
+            # status read with zero remaining truncates the stored fraction
+            # (algorithms.go:398-403).
+            if rem == r.hits:
+                b.remaining_s = 0
+                rl.remaining = 0
+                rl.reset_time = created_at + (rl.limit - 0) * ri
+                return rl
+
+            # Over the limit: no consumption unless DRAIN_OVER_LIMIT
+            # (algorithms.go:407-420).
+            if r.hits > rem:
+                rl.status = Status.OVER_LIMIT
+                if has_behavior(r.behavior, Behavior.DRAIN_OVER_LIMIT):
+                    b.remaining_s = 0
+                    rl.remaining = 0
+                return rl
+
+            # Status read (algorithms.go:423-425).
+            if r.hits == 0:
+                return rl
+
+            b.remaining_s -= r.hits << FIXED_SHIFT
+            rl.remaining = b.remaining_s >> FIXED_SHIFT
+            rl.reset_time = created_at + (rl.limit - rl.remaining) * ri
+            return rl
+
+        return self._leaky_bucket_new_item(r, now_ms, is_owner)
+
+    def _leaky_bucket_new_item(
+        self, r: RateLimitReq, now_ms: int, is_owner: bool
+    ) -> RateLimitResp:
+        # reference algorithms.go:437-493. NOTE: the reference computes
+        # `rate` from the raw duration field BEFORE the Gregorian override,
+        # so under DURATION_IS_GREGORIAN the new-item rate is effectively 0
+        # (duration holds the interval enum 0..5) — preserved bug-for-bug.
+        created_at = r.created_at
+        duration = r.duration
+        ri = rate_int(duration, r.limit)
+        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+            expire = greg.gregorian_expiration(now_ms, r.duration)
+            duration = expire - now_ms
+
+        b = LeakyBucketState(
+            limit=r.limit,
+            duration=duration,
+            remaining_s=(r.burst - r.hits) << FIXED_SHIFT,
+            updated_at=created_at,
+            burst=r.burst,
+        )
+        rl = RateLimitResp(
+            status=Status.UNDER_LIMIT,
+            limit=b.limit,
+            remaining=r.burst - r.hits,
+            reset_time=created_at + (b.limit - (r.burst - r.hits)) * ri,
+        )
+
+        # First request over the burst (reference algorithms.go:469-477).
+        if r.hits > r.burst:
+            rl.status = Status.OVER_LIMIT
+            rl.remaining = 0
+            rl.reset_time = created_at + (rl.limit - 0) * ri
+            b.remaining_s = 0
+
+        item = CacheEntry(
+            algorithm=Algorithm.LEAKY_BUCKET,
+            key=r.hash_key(),
+            value=b,
+            expire_at=created_at + duration,
+        )
+        self.cache[item.key] = item
+        self._on_change(r, item, is_owner)
+        return rl

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding
+(jax.sharding.Mesh) is exercised without TPU hardware, mirroring how the
+reference tests spin up an in-process multi-node cluster without a real
+cluster (reference cluster/cluster.go:123-189). Real-TPU runs happen via
+bench.py, not pytest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def frozen_clock():
+    from gubernator_tpu.utils import clock
+
+    with clock.freeze() as clk:
+        yield clk

@@ -1,0 +1,63 @@
+"""Exactness of the int64-safe leak decomposition against bignum math."""
+
+import random
+
+from gubernator_tpu.models.bucket import (
+    FIXED_SHIFT,
+    MAX_COUNT,
+    MAX_DURATION_MS,
+    MAX_ELAPSED_MS,
+    leak_fixed,
+)
+
+INT64_MAX = (1 << 63) - 1
+
+
+def exact(elapsed, limit, rate_num, burst):
+    if elapsed <= 0:
+        return 0
+    rate_num = max(rate_num, 1)
+    cap_s = (burst + 1) << FIXED_SHIFT
+    e_c = min(elapsed, MAX_ELAPSED_MS)
+    return min((e_c * limit << FIXED_SHIFT) // rate_num, cap_s)
+
+
+def test_leak_fixed_exact_random():
+    rng = random.Random(42)
+    for _ in range(20_000):
+        elapsed = rng.randrange(0, MAX_ELAPSED_MS)
+        limit = rng.randrange(0, MAX_COUNT)
+        rate_num = rng.randrange(0, MAX_DURATION_MS)
+        burst = rng.randrange(0, MAX_COUNT)
+        got = leak_fixed(elapsed, limit, rate_num, burst)
+        want = exact(elapsed, limit, rate_num, burst)
+        assert got == want, (elapsed, limit, rate_num, burst)
+        assert -INT64_MAX <= got <= INT64_MAX
+
+
+def test_leak_fixed_edges():
+    # zero / negative elapsed
+    assert leak_fixed(0, 10, 1000, 10) == 0
+    assert leak_fixed(-5, 10, 1000, 10) == 0
+    # limit 0: no leak (reference: rate=+Inf => leak 0)
+    assert leak_fixed(1000, 0, 1000, 10) == 0
+    # rate_num 0 (duration 0): guarded to 1 => elapsed*limit tokens, capped
+    assert leak_fixed(1, 10, 0, 10) == 10 << FIXED_SHIFT
+    assert leak_fixed(2, 10, 0, 10) == 11 << FIXED_SHIFT  # cap at burst+1
+    # simple exact case: 3 tokens after 9s at 3s/token
+    assert leak_fixed(9000, 10, 30_000, 10) == 3 << FIXED_SHIFT
+    # half a token
+    assert leak_fixed(1500, 10, 30_000, 10) == 1 << (FIXED_SHIFT - 1)
+    # saturation at burst+1
+    assert leak_fixed(MAX_ELAPSED_MS, 1 << 30, 1, 5) == 6 << FIXED_SHIFT
+
+
+def test_leak_fixed_boundaries():
+    # Adversarial small/large mixes near the int64 envelope
+    for elapsed in (1, 2, MAX_ELAPSED_MS - 1, MAX_ELAPSED_MS):
+        for limit in (1, 2, 0xFFFF, 0x10000, MAX_COUNT):
+            for rate_num in (1, 2, MAX_DURATION_MS - 1):
+                for burst in (0, 1, MAX_COUNT):
+                    got = leak_fixed(elapsed, limit, rate_num, burst)
+                    want = exact(elapsed, limit, rate_num, burst)
+                    assert got == want, (elapsed, limit, rate_num, burst)

@@ -1,0 +1,59 @@
+"""Gregorian interval math (reference interval.go:74-148 semantics)."""
+
+import datetime as dt
+
+import pytest
+
+from gubernator_tpu.utils import gregorian as g
+
+
+def ms(y, mo, d, h=0, mi=0, s=0, us=0):
+    return int(
+        dt.datetime(y, mo, d, h, mi, s, us, tzinfo=dt.timezone.utc).timestamp() * 1000
+    )
+
+
+def test_fixed_durations():
+    now = ms(2019, 1, 1, 11, 20, 10)
+    assert g.gregorian_duration(now, g.GREGORIAN_MINUTES) == 60_000
+    assert g.gregorian_duration(now, g.GREGORIAN_HOURS) == 3_600_000
+    assert g.gregorian_duration(now, g.GREGORIAN_DAYS) == 86_400_000
+
+
+def test_month_year_durations():
+    now = ms(2019, 1, 15)
+    assert g.gregorian_duration(now, g.GREGORIAN_MONTHS) == 31 * 86_400_000
+    assert g.gregorian_duration(now, g.GREGORIAN_YEARS) == 365 * 86_400_000
+    # leap year / February
+    assert g.gregorian_duration(ms(2020, 2, 10), g.GREGORIAN_MONTHS) == 29 * 86_400_000
+    assert g.gregorian_duration(ms(2020, 6, 1), g.GREGORIAN_YEARS) == 366 * 86_400_000
+
+
+def test_expiration_minute():
+    # reference interval.go:115-116 example: 11:20:10 -> end of 11:20
+    now = ms(2019, 1, 1, 11, 20, 10)
+    assert g.gregorian_expiration(now, g.GREGORIAN_MINUTES) == ms(2019, 1, 1, 11, 21) - 1
+
+
+def test_expiration_hour_day():
+    now = ms(2019, 6, 15, 11, 20, 10)
+    assert g.gregorian_expiration(now, g.GREGORIAN_HOURS) == ms(2019, 6, 15, 12) - 1
+    assert g.gregorian_expiration(now, g.GREGORIAN_DAYS) == ms(2019, 6, 16) - 1
+
+
+def test_expiration_month_year():
+    now = ms(2019, 12, 15, 3)
+    assert g.gregorian_expiration(now, g.GREGORIAN_MONTHS) == ms(2020, 1, 1) - 1
+    assert g.gregorian_expiration(now, g.GREGORIAN_YEARS) == ms(2020, 1, 1) - 1
+
+
+def test_weeks_unsupported():
+    with pytest.raises(g.GregorianError):
+        g.gregorian_duration(0, g.GREGORIAN_WEEKS)
+    with pytest.raises(g.GregorianError):
+        g.gregorian_expiration(0, g.GREGORIAN_WEEKS)
+
+
+def test_invalid_interval():
+    with pytest.raises(g.GregorianError):
+        g.gregorian_expiration(0, 99)

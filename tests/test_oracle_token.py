@@ -1,0 +1,259 @@
+"""Token-bucket semantics, transcribed from the reference functional suite
+(reference functional_test.go: TestTokenBucket :160, TestTokenBucketGregorian
+:228, TestTokenBucketNegativeHits :299, TestDrainOverLimit :368,
+TestTokenBucketRequestMoreThanAvailable :433, TestMissingFields :855)."""
+
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Status,
+    MILLISECOND,
+    SECOND,
+    MINUTE,
+)
+from gubernator_tpu.models.oracle import OracleEngine
+from gubernator_tpu.utils.gregorian import GREGORIAN_MINUTES
+
+NOW = 1_753_700_000_000  # arbitrary fixed epoch ms
+
+
+def req(**kw):
+    defaults = dict(
+        name="test_token_bucket",
+        unique_key="account:1234",
+        algorithm=Algorithm.TOKEN_BUCKET,
+        duration=5 * MILLISECOND,
+        limit=2,
+        hits=1,
+    )
+    defaults.update(kw)
+    return RateLimitReq(**defaults)
+
+
+def test_token_bucket_basic():
+    eng = OracleEngine()
+    now = NOW
+    # remaining should be one
+    rl = eng.decide(req(), now)
+    assert (rl.status, rl.remaining, rl.limit) == (Status.UNDER_LIMIT, 1, 2)
+    assert rl.reset_time != 0
+    # remaining should be zero and under limit
+    rl = eng.decide(req(), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+    # after waiting 100ms (limit expired), remaining should be 1 again
+    now += 100
+    rl = eng.decide(req(), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 1)
+
+
+def test_token_bucket_over_limit_sticky_status():
+    eng = OracleEngine()
+    now = NOW
+    eng.decide(req(limit=1), now)  # consume the only token
+    rl = eng.decide(req(limit=1), now)
+    assert rl.status == Status.OVER_LIMIT
+    # status read reflects the stored (sticky) OVER_LIMIT status
+    rl = eng.decide(req(limit=1, hits=0), now)
+    assert rl.status == Status.OVER_LIMIT
+
+
+def test_token_bucket_gregorian():
+    eng = OracleEngine()
+    now = NOW
+    base = dict(
+        name="test_token_bucket_greg",
+        unique_key="account:12345",
+        behavior=Behavior.DURATION_IS_GREGORIAN,
+        duration=GREGORIAN_MINUTES,
+        limit=60,
+    )
+    rl = eng.decide(req(hits=1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 59)
+    rl = eng.decide(req(hits=1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 58)
+    rl = eng.decide(req(hits=58, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+    rl = eng.decide(req(hits=1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.OVER_LIMIT, 0)
+    # 61s later the minute rolled over: fresh item, full limit on a read
+    now += 61 * SECOND
+    rl = eng.decide(req(hits=0, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 60)
+
+
+def test_token_bucket_negative_hits():
+    eng = OracleEngine()
+    now = NOW
+    base = dict(name="test_token_bucket_negative", unique_key="account:12345")
+    rl = eng.decide(req(hits=-1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 3)
+    rl = eng.decide(req(hits=-1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 4)
+    rl = eng.decide(req(hits=4, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+    rl = eng.decide(req(hits=-1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 1)
+
+
+@pytest.mark.parametrize("algorithm", [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET])
+def test_drain_over_limit(algorithm):
+    eng = OracleEngine()
+    now = NOW
+    base = dict(
+        name="test_drain_over_limit",
+        unique_key=f"account:1234:{int(algorithm)}",
+        algorithm=algorithm,
+        behavior=Behavior.DRAIN_OVER_LIMIT,
+        duration=30 * SECOND,
+        limit=10,
+    )
+    cases = [
+        (0, 10, Status.UNDER_LIMIT),  # check remaining before hit
+        (1, 9, Status.UNDER_LIMIT),  # first hit
+        (100, 0, Status.OVER_LIMIT),  # over limit hit drains to zero
+        (0, 0, Status.UNDER_LIMIT),  # check remaining after drain
+    ]
+    for hits, remaining, status in cases:
+        rl = eng.decide(req(hits=hits, **base), now)
+        assert (rl.status, rl.remaining, rl.limit) == (status, remaining, 10), (
+            hits,
+            remaining,
+        )
+
+
+def test_token_bucket_request_more_than_available():
+    eng = OracleEngine()
+    now = NOW
+    base = dict(
+        name="test_token_more_than_available",
+        unique_key="account:123456",
+        duration=1000,
+        limit=2000,
+    )
+    seq = [
+        (1000, Status.UNDER_LIMIT, 1000),
+        # Over-limit request does NOT consume (NOTE in reference
+        # algorithms.go:29-34)
+        (1500, Status.OVER_LIMIT, 1000),
+        (500, Status.UNDER_LIMIT, 500),
+        (400, Status.UNDER_LIMIT, 100),
+        (100, Status.UNDER_LIMIT, 0),
+        (1, Status.OVER_LIMIT, 0),
+    ]
+    for hits, status, remaining in seq:
+        rl = eng.decide(req(hits=hits, **base), now)
+        assert (rl.status, rl.remaining) == (status, remaining), hits
+
+
+def test_token_bucket_first_hit_over_limit_does_not_consume():
+    eng = OracleEngine()
+    # new item with hits > limit: OVER_LIMIT, remaining untouched at limit
+    rl = eng.decide(req(hits=100, limit=10), NOW)
+    assert (rl.status, rl.remaining) == (Status.OVER_LIMIT, 10)
+    # and a retry within the window that fits succeeds
+    rl = eng.decide(req(hits=10, limit=10), NOW)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+
+
+def test_reset_remaining():
+    eng = OracleEngine()
+    now = NOW
+    eng.decide(req(limit=5, hits=5, duration=MINUTE), now)
+    rl = eng.decide(
+        req(limit=5, hits=0, duration=MINUTE, behavior=Behavior.RESET_REMAINING), now
+    )
+    assert (rl.status, rl.remaining, rl.reset_time) == (Status.UNDER_LIMIT, 5, 0)
+    # item was removed; next request builds a fresh bucket
+    rl = eng.decide(req(limit=5, hits=1, duration=MINUTE), now)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 4)
+
+
+def test_change_limit():
+    """Limit hot-change credits/debits the difference (reference
+    functional_test.go TestChangeLimit :1343)."""
+    eng = OracleEngine()
+    now = NOW
+    base = dict(name="test_change_limit", unique_key="account:1234", duration=MINUTE)
+    rl = eng.decide(req(limit=100, hits=1, **base), now)
+    assert (rl.remaining, rl.limit) == (99, 100)
+    # limit 100 -> 50: remaining follows the delta
+    rl = eng.decide(req(limit=50, hits=1, **base), now)
+    assert (rl.remaining, rl.limit) == (48, 50)
+    # limit 50 -> 200: remaining credited by 150
+    rl = eng.decide(req(limit=200, hits=1, **base), now)
+    assert (rl.remaining, rl.limit) == (197, 200)
+
+
+def test_duration_change_renews_expired_item():
+    """Duration shrink that makes the item expired renews it
+    (reference algorithms.go:134-142)."""
+    eng = OracleEngine()
+    now = NOW
+    base = dict(name="t", unique_key="k", limit=10)
+    eng.decide(req(duration=10_000, hits=10, **base), now)  # drain fully
+    # 2s later shrink duration to 1s => created_at + 1000 < now => renewal
+    # refills the stored bucket, but the already-at-limit check reads the
+    # STALE pre-renewal remaining (0) => OVER_LIMIT despite the refill
+    # (reference algorithms.go:115-120 vs :134-142 ordering).
+    now += 2000
+    rl = eng.decide(req(duration=1000, hits=1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.OVER_LIMIT, 0)
+    assert rl.reset_time == now + 1000
+    # the stored bucket WAS refilled; sticky OVER_LIMIT status persists
+    rl = eng.decide(req(duration=1000, hits=1, **base), now)
+    assert (rl.status, rl.remaining) == (Status.OVER_LIMIT, 9)
+
+
+def test_missing_fields_validation():
+    eng = OracleEngine()
+    now = NOW
+    # duration 0 is accepted (expires immediately on next read)
+    rls = eng.get_rate_limits(
+        [
+            RateLimitReq(
+                name="test_missing_fields",
+                unique_key="account:1234",
+                hits=1,
+                limit=10,
+                duration=0,
+            )
+        ],
+        now,
+    )
+    assert rls[0].error == "" and rls[0].status == Status.UNDER_LIMIT
+    # limit 0 with hits 1 => OVER_LIMIT, no error
+    rls = eng.get_rate_limits(
+        [
+            RateLimitReq(
+                name="test_missing_fields",
+                unique_key="account:12345",
+                hits=1,
+                limit=0,
+                duration=10_000,
+            )
+        ],
+        now,
+    )
+    assert rls[0].error == "" and rls[0].status == Status.OVER_LIMIT
+    # empty name
+    rls = eng.get_rate_limits(
+        [RateLimitReq(unique_key="account:1234", hits=1, limit=5, duration=10_000)],
+        now,
+    )
+    assert rls[0].error == "field 'namespace' cannot be empty"
+    # empty unique_key
+    rls = eng.get_rate_limits(
+        [RateLimitReq(name="test_missing_fields", hits=1, limit=5, duration=10_000)],
+        now,
+    )
+    assert rls[0].error == "field 'unique_key' cannot be empty"
+
+
+def test_batch_size_cap():
+    eng = OracleEngine()
+    reqs = [req(unique_key=f"k{i}") for i in range(1001)]
+    with pytest.raises(ValueError):
+        eng.get_rate_limits(reqs, NOW)
