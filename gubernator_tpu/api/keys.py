@@ -1,0 +1,47 @@
+"""Key hashing: string hash-key -> 128-bit slot-table identity.
+
+The reference keys its cache by the raw string `name + "_" + unique_key`
+(reference client.go:39-41) and routes with 64-bit fnv1 for peer ownership
+(reference replicated_hash.go:104-119). The slot table instead stores a
+128-bit xxh3 of the hash-key: at 10M keys the collision probability is
+~2.9e-25, so two distinct strings never merge limits (SURVEY.md §7 hard
+part (c)) without the table having to store strings. The host keeps the
+hash -> original-string dictionary where needed (Loader snapshots,
+debugging); the device never sees strings.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import xxhash
+
+_M64 = (1 << 64) - 1
+_SIGN = 1 << 63
+
+
+def _to_signed(v: int) -> int:
+    return v - (1 << 64) if v >= _SIGN else v
+
+
+def _to_unsigned(v: int) -> int:
+    return v & _M64
+
+
+def key_hash128(hash_key: str) -> Tuple[int, int]:
+    """128-bit identity of a rate-limit key, as two signed int64 halves.
+
+    (0, 0) is reserved as the empty-slot sentinel; the astronomically
+    unlikely all-zero digest is nudged.
+    """
+    d = xxhash.xxh3_128_intdigest(hash_key.encode("utf-8"))
+    hi = (d >> 64) & _M64
+    lo = d & _M64
+    if hi == 0 and lo == 0:
+        lo = 1
+    return _to_signed(hi), _to_signed(lo)
+
+
+def group_of(key_lo: int, num_groups: int) -> int:
+    """Slot-group index from the (signed) low hash half."""
+    return _to_unsigned(key_lo) % num_groups

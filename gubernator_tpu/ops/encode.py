@@ -1,0 +1,92 @@
+"""Host-side encoding of validated requests into device batch operands.
+
+Resolves everything the kernel must not do itself: string hashing, group
+addressing, Gregorian calendar math (SURVEY.md §7 hard part (e)), leaky
+burst defaulting, and domain clamping for the int64-exact leak math.
+
+The caller (assembler) guarantees all active lanes in one batch have
+distinct groups; this module just encodes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from gubernator_tpu.api.keys import group_of, key_hash128
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, has_behavior
+from gubernator_tpu.models.bucket import MAX_COUNT, MAX_DURATION_MS
+from gubernator_tpu.ops.layout import RequestBatch
+from gubernator_tpu.utils import gregorian as greg
+
+
+class EncodeError(ValueError):
+    """Per-request encoding failure (e.g. invalid Gregorian interval)."""
+
+
+def encode_one(
+    batch: RequestBatch,
+    lane: int,
+    r: RateLimitReq,
+    now_ms: int,
+    num_groups: int,
+    key: Optional[tuple] = None,
+) -> None:
+    """Encode one request into `lane` of a host-side RequestBatch.
+
+    `key` optionally carries a precomputed (key_hi, key_lo) pair.
+    Raises EncodeError for invalid Gregorian durations; the caller turns
+    that into a per-item error response (the reference propagates the
+    error from GregorianExpiration the same way, algorithms.go:128-131).
+    """
+    hi, lo = key if key is not None else key_hash128(r.hash_key())
+    is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
+
+    duration = min(max(int(r.duration), 0), MAX_DURATION_MS) if not is_greg else int(r.duration)
+    if is_greg:
+        # Host resolves the calendar; kernel sees only epoch-ms operands.
+        try:
+            rate_num = greg.gregorian_duration(now_ms, r.duration)
+            greg_expire = greg.gregorian_expiration(now_ms, r.duration)
+        except greg.GregorianError as e:
+            raise EncodeError(str(e)) from e
+        eff_duration = greg_expire - now_ms
+    else:
+        rate_num = duration
+        greg_expire = 0
+        eff_duration = duration
+
+    limit = min(max(int(r.limit), -MAX_COUNT), MAX_COUNT)
+    hits = min(max(int(r.hits), -MAX_COUNT), MAX_COUNT)
+    burst = min(max(int(r.burst), 0), MAX_COUNT)
+    if r.algorithm == Algorithm.LEAKY_BUCKET and burst == 0:
+        burst = limit  # reference algorithms.go:264-266
+
+    batch.key_hi[lane] = hi
+    batch.key_lo[lane] = lo
+    batch.group[lane] = group_of(lo, num_groups)
+    batch.algo[lane] = int(r.algorithm)
+    batch.behavior[lane] = int(r.behavior)
+    batch.hits[lane] = hits
+    batch.limit[lane] = limit
+    batch.duration[lane] = duration
+    batch.rate_num[lane] = rate_num
+    batch.eff_duration[lane] = eff_duration
+    batch.greg_expire[lane] = greg_expire
+    batch.burst[lane] = burst
+    batch.created_at[lane] = (
+        int(r.created_at) if r.created_at is not None else int(now_ms)
+    )
+    batch.active[lane] = True
+
+
+def encode_batch(
+    reqs: Sequence[RateLimitReq], now_ms: int, num_groups: int, batch_size: int
+) -> RequestBatch:
+    """Encode up to batch_size requests (caller ensures distinct groups)."""
+    assert len(reqs) <= batch_size
+    b = RequestBatch.zeros(batch_size)
+    for i, r in enumerate(reqs):
+        encode_one(b, i, r, now_ms, num_groups)
+    return b

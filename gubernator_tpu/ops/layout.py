@@ -1,0 +1,145 @@
+"""HBM slot table layout and batch operand structs.
+
+The table replaces the reference's per-worker LRU caches + bucket structs
+(reference lrucache.go:32-214, store.go:29-43, cache.go:29-41) with one
+struct-of-arrays region designed for vectorized gather/scatter:
+
+- W-way set-associative: a key's 128-bit hash picks a *group* of W
+  contiguous slots; matching, insertion, and LRU eviction all happen
+  inside the decide kernel over the W gathered candidates — no host
+  round-trips (SURVEY.md §7 hard part (d)).
+- Eviction policy is least-recently-used within the group, preferring
+  expired slots, mirroring the reference cache's evict-oldest +
+  lazy-expiry behavior (reference lrucache.go:98-100, 115-118) at group
+  granularity.
+- `remaining` holds whole tokens for TOKEN_BUCKET and Q44.20 fixed point
+  for LEAKY_BUCKET (see models/bucket.py).
+- `stamp` is TokenBucketItem.CreatedAt / LeakyBucketItem.UpdatedAt.
+- `invalid_at` supports the Store plugin's re-fetch hint
+  (reference cache.go:35-40).
+
+All arrays are int64/bool; (key_hi, key_lo) == (0, 0) marks empty.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_WAYS = 8
+
+
+class SlotTable(NamedTuple):
+    """Struct-of-arrays counter table; a JAX pytree."""
+
+    key_hi: jnp.ndarray  # (N,) int64
+    key_lo: jnp.ndarray  # (N,) int64
+    used: jnp.ndarray  # (N,) bool
+    algo: jnp.ndarray  # (N,) int8
+    status: jnp.ndarray  # (N,) int8 (token-bucket sticky status)
+    limit: jnp.ndarray  # (N,) int64
+    duration: jnp.ndarray  # (N,) int64
+    remaining: jnp.ndarray  # (N,) int64 (token: tokens; leaky: Q44.20)
+    stamp: jnp.ndarray  # (N,) int64 (token: created_at; leaky: updated_at)
+    expire_at: jnp.ndarray  # (N,) int64 epoch ms
+    invalid_at: jnp.ndarray  # (N,) int64 epoch ms, 0 = unset
+    burst: jnp.ndarray  # (N,) int64 (leaky only)
+    lru: jnp.ndarray  # (N,) int64 last-access epoch ms
+
+    @property
+    def num_slots(self) -> int:
+        return self.key_hi.shape[0]
+
+    @staticmethod
+    def create(num_groups: int, ways: int = DEFAULT_WAYS) -> "SlotTable":
+        n = num_groups * ways
+        i64 = lambda: jnp.zeros((n,), dtype=jnp.int64)  # noqa: E731
+        return SlotTable(
+            key_hi=i64(),
+            key_lo=i64(),
+            used=jnp.zeros((n,), dtype=bool),
+            algo=jnp.zeros((n,), dtype=jnp.int8),
+            status=jnp.zeros((n,), dtype=jnp.int8),
+            limit=i64(),
+            duration=i64(),
+            remaining=i64(),
+            stamp=i64(),
+            expire_at=i64(),
+            invalid_at=i64(),
+            burst=i64(),
+            lru=i64(),
+        )
+
+
+class RequestBatch(NamedTuple):
+    """Device operands for one decide() call, padded to a fixed batch size.
+
+    Host-resolved fields (the kernel is calendar/string-free):
+    - key_hi/key_lo: 128-bit key hash (api/keys.py)
+    - group: key's slot-group index (key_lo mod num_groups)
+    - rate_num: leaky rate numerator — duration, or the full Gregorian
+      interval under DURATION_IS_GREGORIAN (reference algorithms.go:336,349-351)
+    - eff_duration: effective duration — duration, or time to end of the
+      Gregorian interval (reference algorithms.go:353, 449)
+    - greg_expire: gregorian_expiration(now), or 0 when not Gregorian
+
+    Invariant the assembler maintains: within one batch, all active lanes
+    have distinct `group` values (duplicate keys and group collisions go to
+    subsequent waves), so scatters never collide and per-key request order
+    is preserved across waves.
+    """
+
+    key_hi: jnp.ndarray  # (B,) int64
+    key_lo: jnp.ndarray  # (B,) int64
+    group: jnp.ndarray  # (B,) int32
+    algo: jnp.ndarray  # (B,) int8
+    behavior: jnp.ndarray  # (B,) int32 bit flags
+    hits: jnp.ndarray  # (B,) int64
+    limit: jnp.ndarray  # (B,) int64
+    duration: jnp.ndarray  # (B,) int64 (raw request field)
+    rate_num: jnp.ndarray  # (B,) int64
+    eff_duration: jnp.ndarray  # (B,) int64
+    greg_expire: jnp.ndarray  # (B,) int64
+    burst: jnp.ndarray  # (B,) int64 (leaky: 0 already replaced by limit)
+    created_at: jnp.ndarray  # (B,) int64 epoch ms
+    active: jnp.ndarray  # (B,) bool padding mask
+
+    @property
+    def batch_size(self) -> int:
+        return self.key_hi.shape[0]
+
+    @staticmethod
+    def zeros(b: int) -> "RequestBatch":
+        i64 = lambda: np.zeros((b,), dtype=np.int64)  # noqa: E731
+        return RequestBatch(
+            key_hi=i64(),
+            key_lo=i64(),
+            group=np.zeros((b,), dtype=np.int32),
+            algo=np.zeros((b,), dtype=np.int8),
+            behavior=np.zeros((b,), dtype=np.int32),
+            hits=i64(),
+            limit=i64(),
+            duration=i64(),
+            rate_num=i64(),
+            eff_duration=i64(),
+            greg_expire=i64(),
+            burst=i64(),
+            created_at=i64(),
+            active=np.zeros((b,), dtype=bool),
+        )
+
+
+class DecideOutput(NamedTuple):
+    """Per-lane decisions plus batch metrics."""
+
+    status: jnp.ndarray  # (B,) int8
+    limit: jnp.ndarray  # (B,) int64
+    remaining: jnp.ndarray  # (B,) int64
+    reset_time: jnp.ndarray  # (B,) int64
+    # metrics (scalars): cache hits, misses, unexpired evictions, over-limit
+    hits: jnp.ndarray
+    misses: jnp.ndarray
+    unexpired_evictions: jnp.ndarray
+    over_limit: jnp.ndarray

@@ -5,16 +5,25 @@ Tests run on CPU with 8 virtual devices so multi-chip sharding
 reference tests spin up an in-process multi-node cluster without a real
 cluster (reference cluster/cluster.go:123-189). Real-TPU runs happen via
 bench.py, not pytest.
+
+NOTE: in this environment a sitecustomize hook imports jax at interpreter
+startup with JAX_PLATFORMS=axon (the tunneled TPU). Backend init is lazy,
+so overriding via jax.config here still forces CPU — plain env mutation
+would be too late.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
