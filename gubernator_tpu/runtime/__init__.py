@@ -1,0 +1,3 @@
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+__all__ = ["DeviceEngine", "EngineConfig"]

@@ -1,0 +1,308 @@
+"""Device engine: micro-batch assembly + the TPU-resident counter table.
+
+This is the TPU-native replacement for the reference's entire execution
+engine (reference workers.go:54-626): instead of sharding the key space
+across single-threaded goroutine workers with channel hops, requests
+accumulate into fixed-shape device batches and one jitted decide() call
+updates the HBM slot table in place.
+
+The micro-batching policy transfers directly from the reference's peer
+batching (reference peer_client.go:284-337; config.go:126-128): flush at
+`batch_limit` items or after `batch_wait` (default 500µs), whichever
+first; NO_BATCHING requests flush immediately.
+
+Duplicate handling (SURVEY.md §7 hard part (a)): the reference serializes
+same-key requests through one worker, so in-batch duplicates see each
+other's effects in request order, and an over-limit rejection does NOT
+consume. The assembler reproduces this with *waves*: within one flush,
+requests whose slot-group is already taken by an earlier request go to the
+next wave; waves execute as sequential decide() calls. Group (not key)
+granularity also guarantees scatter-disjointness inside each wave.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from gubernator_tpu.api.keys import group_of, key_hash128
+from gubernator_tpu.api.types import (
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    has_behavior,
+    validate_request,
+)
+from gubernator_tpu.ops.encode import EncodeError, encode_one
+from gubernator_tpu.ops.layout import RequestBatch, SlotTable
+from gubernator_tpu.ops.decide import decide
+from gubernator_tpu.utils import clock as _clock
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Sizing and batching knobs (defaults mirror the reference's
+    BehaviorConfig, config.go:126-140, adapted to device batches)."""
+
+    num_groups: int = 1 << 15  # 32k groups x 8 ways = 256k slots
+    ways: int = 8
+    batch_size: int = 1024  # lanes per device batch (fixed shape)
+    batch_limit: int = 1000  # max requests accumulated per flush
+    batch_wait_s: float = 500e-6  # 500 µs
+    max_flush_items: int = 8192  # hard cap pulled off the queue per flush
+    keep_key_strings: bool = True  # hash -> string dict (Loader/debug)
+    device: Optional[object] = None  # jax device for the table
+
+
+class EngineMetrics:
+    """Counters the observability layer exports (names map to the
+    reference's Prometheus catalog, docs/prometheus.md)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.unexpired_evictions = 0
+        self.over_limit = 0
+        self.batches = 0
+        self.waves = 0
+        self.requests = 0
+        self.batch_duration_sum = 0.0
+
+    def observe(self, hits, misses, evic, over, waves, n, dur):
+        with self.lock:
+            self.cache_hits += hits
+            self.cache_misses += misses
+            self.unexpired_evictions += evic
+            self.over_limit += over
+            self.batches += 1
+            self.waves += waves
+            self.requests += n
+            self.batch_duration_sum += dur
+
+
+class DeviceEngine:
+    """Owns the device slot table; turns request streams into decisions.
+
+    Thread model: callers (any thread / asyncio executor) enqueue
+    (request, Future) pairs; one pump thread drains the queue, assembles
+    waves, runs the kernel, and resolves futures. All device state is
+    touched only by the pump thread — the moral equivalent of the
+    reference's single-writer worker exclusivity (workers.go:19-25)
+    with one writer for the whole table.
+    """
+
+    def __init__(
+        self,
+        config: EngineConfig = EngineConfig(),
+        now_fn: Callable[[], int] = _clock.now_ms,
+    ):
+        self.cfg = config
+        self.now_fn = now_fn
+        self.metrics = EngineMetrics()
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._key_strings: Dict[Tuple[int, int], str] = {}
+        self._lock = threading.Lock()  # guards table swap (load/restore)
+
+        dev = config.device
+
+        with jax.default_device(dev) if dev is not None else _nullcontext():
+            self.table: SlotTable = SlotTable.create(config.num_groups, config.ways)
+
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._pump, name="gubernator-tpu-engine", daemon=True
+        )
+        self._thread.start()
+
+    # ---- public API --------------------------------------------------------
+
+    def check_async(self, req: RateLimitReq) -> "Future[RateLimitResp]":
+        """Enqueue one request; resolves after its wave executes."""
+        fut: Future = Future()
+        err = validate_request(req)
+        if err is not None:
+            fut.set_result(RateLimitResp(error=err))
+            return fut
+        if req.created_at is None:
+            req.created_at = self.now_fn()
+        self._queue.put((req, fut))
+        return fut
+
+    def check_batch(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        """Synchronous batched check (returns in request order)."""
+        futs = [self.check_async(r) for r in reqs]
+        return [f.result() for f in futs]
+
+    def flush_now(self) -> None:
+        """Force the pump to flush without waiting the batch window."""
+        self._queue.put(_FLUSH)
+
+    def close(self) -> None:
+        self._running = False
+        self._queue.put(_STOP)
+        self._thread.join(timeout=5)
+
+    def key_string(self, hi: int, lo: int) -> Optional[str]:
+        return self._key_strings.get((hi, lo))
+
+    # ---- pump --------------------------------------------------------------
+
+    def _pump(self) -> None:
+        while self._running:
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _STOP:
+                break
+            batch: List[Tuple[RateLimitReq, Future]] = []
+            flush = item is _FLUSH
+            if not flush:
+                batch.append(item)
+                flush = has_behavior(item[0].behavior, Behavior.NO_BATCHING)
+            deadline = time.monotonic() + self.cfg.batch_wait_s
+            while not flush and len(batch) < self.cfg.max_flush_items:
+                remaining = deadline - time.monotonic()
+                if len(batch) >= self.cfg.batch_limit or remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    self._running = False
+                    break
+                if nxt is _FLUSH:
+                    break
+                batch.append(nxt)
+                if has_behavior(nxt[0].behavior, Behavior.NO_BATCHING):
+                    break
+            if batch:
+                try:
+                    self._process(batch)
+                except Exception as e:  # never kill the pump
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_result(RateLimitResp(error=str(e)))
+
+    # ---- wave assembly + kernel dispatch -----------------------------------
+
+    def _process(self, items: List[Tuple[RateLimitReq, Future]]) -> None:
+        t0 = time.perf_counter()
+        now = self.now_fn()
+        cfg = self.cfg
+        B = cfg.batch_size
+
+        # Assign each request to (wave, lane): first wave where its group is
+        # unused and a lane is free. Preserves per-key request order because
+        # same key => same group => strictly increasing wave index.
+        waves: List[RequestBatch] = []
+        wave_groups: List[set] = []
+        wave_fill: List[int] = []
+        placements: List[Optional[Tuple[int, int]]] = []
+
+        for req, fut in items:
+            hi, lo = key_hash128(req.hash_key())
+            if cfg.keep_key_strings:
+                self._key_strings[(hi, lo)] = req.hash_key()
+            grp = group_of(lo, cfg.num_groups)
+            w = 0
+            while True:
+                if w == len(waves):
+                    waves.append(RequestBatch.zeros(B))
+                    wave_groups.append(set())
+                    wave_fill.append(0)
+                if grp not in wave_groups[w] and wave_fill[w] < B:
+                    break
+                w += 1
+            lane = wave_fill[w]
+            try:
+                encode_one(waves[w], lane, req, now, cfg.num_groups, key=(hi, lo))
+            except EncodeError as e:
+                fut.set_result(RateLimitResp(error=str(e)))
+                placements.append(None)
+                continue
+            wave_groups[w].add(grp)
+            wave_fill[w] += 1
+            placements.append((w, lane))
+
+        # Execute waves sequentially against the (donated) table.
+        outs = []
+        with self._lock:
+            table = self.table
+            for wb in waves:
+                table, out = decide(table, wb, now, ways=cfg.ways)
+                outs.append(out)
+            self.table = table
+
+        # Materialize results (one host sync per wave) and demux.
+        host = [
+            (
+                np.asarray(o.status),
+                np.asarray(o.remaining),
+                np.asarray(o.reset_time),
+                np.asarray(o.limit),
+                int(o.hits),
+                int(o.misses),
+                int(o.unexpired_evictions),
+                int(o.over_limit),
+            )
+            for o in outs
+        ]
+        tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
+        self.metrics.observe(
+            tot[0], tot[1], tot[2], tot[3], len(waves), len(items),
+            time.perf_counter() - t0,
+        )
+
+        for (req, fut), place in zip(items, placements):
+            if place is None:
+                continue  # already resolved (encode error)
+            w, lane = place
+            st, rem, rst, lim = host[w][0], host[w][1], host[w][2], host[w][3]
+            fut.set_result(
+                RateLimitResp(
+                    status=int(st[lane]),
+                    limit=int(lim[lane]),
+                    remaining=int(rem[lane]),
+                    reset_time=int(rst[lane]),
+                )
+            )
+
+    # ---- snapshot / restore (Loader seam, task: store) ---------------------
+
+    def snapshot(self) -> dict:
+        """Device -> host snapshot of the table (the Loader.Save analog,
+        reference store.go:76-78; SURVEY.md §5 checkpoint/resume)."""
+        with self._lock:
+            tbl = self.table
+            host = {f: np.asarray(getattr(tbl, f)) for f in tbl._fields}
+        host["key_strings"] = dict(self._key_strings)
+        return host
+
+    def restore(self, snap: dict) -> None:
+        """Host -> device restore (the Loader.Load analog)."""
+        fields = {f: jax.numpy.asarray(snap[f]) for f in SlotTable._fields}
+        with self._lock:
+            self.table = SlotTable(**fields)
+        self._key_strings.update(snap.get("key_strings", {}))
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+_FLUSH = object()
+_STOP = object()

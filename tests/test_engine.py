@@ -1,0 +1,151 @@
+"""DeviceEngine: micro-batching, wave ordering for duplicate keys,
+validation, NO_BATCHING, metrics, snapshot/restore."""
+
+import dataclasses
+import threading
+
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.models.oracle import OracleEngine
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+NOW = 1_753_700_000_000
+
+
+@pytest.fixture
+def engine():
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.002),
+        now_fn=lambda: clock["now"],
+    )
+    eng._test_clock = clock
+    yield eng
+    eng.close()
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+def test_single_request(engine):
+    rl = engine.check_batch([mk()])[0]
+    assert (rl.status, rl.limit, rl.remaining) == (Status.UNDER_LIMIT, 10, 9)
+    assert rl.error == ""
+
+
+def test_duplicate_keys_sequential_semantics(engine):
+    """Same key many times in ONE batch must behave like sequential
+    requests (reference worker-serialization semantics), including
+    over-limit not consuming."""
+    reqs = [mk(hits=4), mk(hits=4), mk(hits=4), mk(hits=2), mk(hits=1)]
+    rls = engine.check_batch(reqs)
+    oracle = OracleEngine()
+    want = [oracle.decide(dataclasses.replace(r), NOW) for r in reqs]
+    got = [(r.status, r.remaining) for r in rls]
+    assert got == [(w.status, w.remaining) for w in want]
+    # explicit: 4+4=8 consumed, third 4 rejected w/o consuming, 2 ok, 1 over
+    assert got == [
+        (Status.UNDER_LIMIT, 6),
+        (Status.UNDER_LIMIT, 2),
+        (Status.OVER_LIMIT, 2),
+        (Status.UNDER_LIMIT, 0),
+        (Status.OVER_LIMIT, 0),
+    ]
+
+
+def test_many_keys_one_batch_matches_oracle(engine):
+    reqs = [mk(key=f"k{i}", hits=i % 5, limit=7) for i in range(50)]
+    rls = engine.check_batch(reqs)
+    oracle = OracleEngine()
+    for r, got in zip(reqs, rls):
+        w = oracle.decide(dataclasses.replace(r), NOW)
+        assert (got.status, got.limit, got.remaining, got.reset_time) == (
+            w.status,
+            w.limit,
+            w.remaining,
+            w.reset_time,
+        ), r.unique_key
+
+
+def test_validation_errors(engine):
+    rls = engine.check_batch(
+        [RateLimitReq(unique_key="k", hits=1), RateLimitReq(name="n", hits=1)]
+    )
+    assert rls[0].error == "field 'namespace' cannot be empty"
+    assert rls[1].error == "field 'unique_key' cannot be empty"
+
+
+def test_gregorian_error_is_per_item(engine):
+    bad = mk(behavior=Behavior.DURATION_IS_GREGORIAN, duration=3)  # weeks
+    good = mk(key="other")
+    rls = engine.check_batch([bad, good])
+    assert "not yet supported" in rls[0].error
+    assert rls[1].error == "" and rls[1].remaining == 9
+
+
+def test_no_batching_flushes_immediately(engine):
+    rl = engine.check_batch([mk(behavior=Behavior.NO_BATCHING)])[0]
+    assert rl.remaining == 9
+
+
+def test_concurrent_submitters(engine):
+    """Many threads hammering one key: total consumption must be exact."""
+    n_threads, per_thread = 8, 25
+    results = []
+    lock = threading.Lock()
+
+    def worker():
+        rls = [engine.check_async(mk(key="shared", limit=1000)) for _ in range(per_thread)]
+        out = [f.result() for f in rls]
+        with lock:
+            results.extend(out)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r.status == Status.UNDER_LIMIT for r in results)
+    final = engine.check_batch([mk(key="shared", limit=1000, hits=0)])[0]
+    assert final.remaining == 1000 - n_threads * per_thread
+
+
+def test_metrics(engine):
+    engine.check_batch([mk(key="a"), mk(key="a"), mk(key="b", hits=100)])
+    m = engine.metrics
+    assert m.requests == 3
+    assert m.cache_misses >= 2  # a(new), b(new)
+    assert m.cache_hits >= 1  # second a
+    assert m.over_limit == 1
+
+
+def test_snapshot_restore(engine):
+    engine.check_batch([mk(key="persist", hits=7)])
+    snap = engine.snapshot()
+    cfg = EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.002)
+    eng2 = DeviceEngine(cfg, now_fn=lambda: NOW)
+    try:
+        eng2.restore(snap)
+        rl = eng2.check_batch([mk(key="persist", hits=0)])[0]
+        assert rl.remaining == 3
+        assert eng2.key_string(*__import__("gubernator_tpu.api.keys", fromlist=["key_hash128"]).key_hash128("t_persist")) == "t_persist"
+    finally:
+        eng2.close()
+
+
+def test_time_advance_expiry(engine):
+    engine.check_batch([mk(key="exp", duration=50, hits=10)])
+    engine._test_clock["now"] = NOW + 1000
+    rl = engine.check_batch([mk(key="exp", duration=50, hits=1)])[0]
+    assert rl.remaining == 9  # expired -> fresh bucket
