@@ -93,6 +93,16 @@ class RateLimitResp:
     metadata: Dict[str, str] = field(default_factory=dict)
 
 
+@dataclass(frozen=True)
+class PeerInfo:
+    """A cluster member (reference config.go:161-175)."""
+
+    grpc_address: str = ""
+    http_address: str = ""
+    data_center: str = ""
+    is_owner: bool = False  # true when this PeerInfo describes the local node
+
+
 @dataclass
 class HealthCheckResp:
     """Service health (reference gubernator.proto:206-213)."""
@@ -117,11 +127,11 @@ class UpdatePeerGlobal:
 def validate_request(req: RateLimitReq) -> Optional[str]:
     """Per-item validation; returns an error string or None.
 
-    Error strings match the reference exactly (functional_test.go
-    TestMissingFields expectations; reference gubernator.go:205-213).
+    Error strings and check order match the reference exactly
+    (reference gubernator.go:208-216; functional_test.go TestMissingFields).
     """
-    if not req.name:
-        return "field 'namespace' cannot be empty"
     if not req.unique_key:
         return "field 'unique_key' cannot be empty"
+    if not req.name:
+        return "field 'namespace' cannot be empty"
     return None

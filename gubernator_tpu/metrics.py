@@ -1,0 +1,182 @@
+"""Prometheus metrics, name-compatible with the reference catalog
+(reference docs/prometheus.md:17-43).
+
+The reference's functional tests poll these metrics as their
+synchronization API (SURVEY.md §4) — sample names must match exactly
+(e.g. `gubernator_broadcast_duration_count`). Two exposition notes:
+
+- Counter-style metrics use prometheus_client Gauge under the hood:
+  Client_python's Counter force-appends `_total`, but the reference's Go
+  names (`gubernator_getratelimit_counter`, `gubernator_cache_access_count`,
+  ...) have no suffix. A Gauge emits the bare name; we only ever inc() it.
+- Summary emits `<name>_count` / `<name>_sum`, matching Go's summaries.
+
+Each Daemon owns one CollectorRegistry (like the reference's per-daemon
+registry, daemon.go:91-103) so in-process cluster fixtures don't collide.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Gauge,
+    Summary,
+    generate_latest,
+    CONTENT_TYPE_LATEST,
+)
+
+
+class Metrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+
+        def counter(name, doc, labels=()):
+            return Gauge(name, doc, list(labels), registry=r)
+
+        # Core serving metrics (reference gubernator.go:60-111)
+        self.getratelimit_counter = counter(
+            "gubernator_getratelimit_counter",
+            "The count of getLocalRateLimit() calls.",
+            ["calltype"],  # local | forward | global
+        )
+        self.func_duration = Summary(
+            "gubernator_func_duration",
+            "The timings of key functions in seconds.",
+            ["name"],
+            registry=r,
+        )
+        self.over_limit_counter = counter(
+            "gubernator_over_limit_counter",
+            "The number of rate limit checks that are over the limit.",
+        )
+        self.concurrent_checks = Gauge(
+            "gubernator_concurrent_checks_counter",
+            "The number of concurrent GetRateLimits API calls.",
+            registry=r,
+        )
+        self.check_error_counter = counter(
+            "gubernator_check_error_counter",
+            "The number of errors while checking rate limits.",
+            ["error"],
+        )
+
+        # Engine (replaces worker-pool metrics, reference gubernator.go:86-93)
+        self.worker_queue_length = Gauge(
+            "gubernator_worker_queue_length",
+            "Requests queued for the device engine.",
+            registry=r,
+        )
+        self.command_counter = counter(
+            "gubernator_command_counter",
+            "The count of commands processed by the device engine.",
+        )
+
+        # Cache (reference lrucache.go:48-59)
+        self.cache_access_count = counter(
+            "gubernator_cache_access_count",
+            "Cache access counts during rate checks.",
+            ["type"],  # 'hit' | 'miss'
+        )
+        self.cache_size = Gauge(
+            "gubernator_cache_size",
+            "The number of live entries in the counter table.",
+            registry=r,
+        )
+        self.unexpired_evictions = counter(
+            "gubernator_unexpired_evictions_count",
+            "Count of evictions of unexpired entries (capacity pressure).",
+        )
+
+        # Batch behavior (reference gubernator.go:96-110)
+        self.batch_send_duration = Summary(
+            "gubernator_batch_send_duration",
+            "The timings of batch sends to a remote peer in seconds.",
+            registry=r,
+        )
+        self.batch_queue_length = Gauge(
+            "gubernator_batch_queue_length",
+            "Rate checks queued for batching to remote peers.",
+            registry=r,
+        )
+        self.batch_send_retries = counter(
+            "gubernator_batch_send_retries",
+            "Retries while forwarding requests to another peer.",
+        )
+
+        # GLOBAL behavior (reference global.go:50-67)
+        self.broadcast_duration = Summary(
+            "gubernator_broadcast_duration",
+            "The timings of GLOBAL broadcasts to peers in seconds.",
+            registry=r,
+        )
+        self.broadcast_counter = counter(
+            "gubernator_broadcast_counter",
+            "The count of GLOBAL broadcasts.",
+        )
+        self.global_send_duration = Summary(
+            "gubernator_global_send_duration",
+            "The timings of GLOBAL hit-update sends to owners in seconds.",
+            registry=r,
+        )
+        self.global_queue_length = Gauge(
+            "gubernator_global_queue_length",
+            "Requests queued for GLOBAL broadcast.",
+            registry=r,
+        )
+        self.global_send_queue_length = Gauge(
+            "gubernator_global_send_queue_length",
+            "Requests queued for GLOBAL hit-update send.",
+            registry=r,
+        )
+
+        # gRPC stats (reference grpc_stats.go:51-62)
+        self.grpc_request_counts = counter(
+            "gubernator_grpc_request_counts",
+            "The count of gRPC requests.",
+            ["method", "status"],
+        )
+        self.grpc_request_duration = Summary(
+            "gubernator_grpc_request_duration",
+            "The timings of gRPC requests in seconds.",
+            ["method"],
+            registry=r,
+        )
+
+        self._syncs = []
+
+    def add_sync(self, fn) -> None:
+        """Register a callback run before each exposition (bridges engine
+        counters into the registry at scrape time)."""
+        self._syncs.append(fn)
+
+    def sync(self) -> None:
+        for fn in self._syncs:
+            try:
+                fn(self)
+            except Exception:
+                pass
+
+    def render(self) -> bytes:
+        self.sync()
+        return generate_latest(self.registry)
+
+    content_type = CONTENT_TYPE_LATEST
+
+
+def engine_sync(engine):
+    """Sync callback exporting DeviceEngine counters under the reference's
+    cache/worker metric names (reference lrucache.go:48-59,
+    gubernator.go:86-93)."""
+
+    def _sync(m: "Metrics") -> None:
+        em = engine.metrics
+        m.cache_access_count.labels("hit").set(em.cache_hits)
+        m.cache_access_count.labels("miss").set(em.cache_misses)
+        m.unexpired_evictions.set(em.unexpired_evictions)
+        m.over_limit_counter.set(em.over_limit)
+        m.command_counter.set(em.requests)
+        m.worker_queue_length.set(engine.queue_depth())
+        m.cache_size.set(engine.live_count())
+
+    return _sync

@@ -1,0 +1,104 @@
+"""Direct state injection into the slot table (AddCacheItem analog).
+
+Used by the GLOBAL replication path — replicas overwrite local state with
+the owner's authoritative broadcast (reference gubernator.go:425-459 →
+workers.go:537-580) — and by the Loader restore path. Probes each key's
+group with the same policy as decide() and overwrites/creates the entry.
+
+The caller guarantees distinct groups within one call (the engine's wave
+logic is reused).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.ops.decide import _choose_slot
+from gubernator_tpu.ops.layout import RequestBatch, SlotTable
+
+I64 = jnp.int64
+
+
+class InjectBatch(NamedTuple):
+    """Authoritative per-key state to write (padded, distinct groups)."""
+
+    key_hi: jnp.ndarray  # (B,) int64
+    key_lo: jnp.ndarray  # (B,) int64
+    group: jnp.ndarray  # (B,) int32
+    algo: jnp.ndarray  # (B,) int8
+    status: jnp.ndarray  # (B,) int8
+    limit: jnp.ndarray  # (B,) int64
+    duration: jnp.ndarray  # (B,) int64
+    remaining: jnp.ndarray  # (B,) int64 (already Q44.20 for leaky)
+    stamp: jnp.ndarray  # (B,) int64
+    expire_at: jnp.ndarray  # (B,) int64
+    burst: jnp.ndarray  # (B,) int64
+    active: jnp.ndarray  # (B,) bool
+
+    @staticmethod
+    def zeros(b: int) -> "InjectBatch":
+        i64 = lambda: np.zeros((b,), dtype=np.int64)  # noqa: E731
+        return InjectBatch(
+            key_hi=i64(),
+            key_lo=i64(),
+            group=np.zeros((b,), dtype=np.int32),
+            algo=np.zeros((b,), dtype=np.int8),
+            status=np.zeros((b,), dtype=np.int8),
+            limit=i64(),
+            duration=i64(),
+            remaining=i64(),
+            stamp=i64(),
+            expire_at=i64(),
+            burst=i64(),
+            active=np.zeros((b,), dtype=bool),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
+    now = jnp.asarray(now, dtype=I64)
+    # Reuse decide's probe by viewing the inject batch as a request batch
+    # (only key/group fields are read by _choose_slot).
+    probe = RequestBatch(
+        key_hi=items.key_hi,
+        key_lo=items.key_lo,
+        group=items.group,
+        algo=items.algo,
+        behavior=jnp.zeros_like(items.group),
+        hits=items.limit,
+        limit=items.limit,
+        duration=items.duration,
+        rate_num=items.duration,
+        eff_duration=items.duration,
+        greg_expire=items.expire_at,
+        burst=items.burst,
+        created_at=items.stamp,
+        active=items.active,
+    )
+    slot, _exists, _ev = _choose_slot(table, probe, now, ways)
+    n = table.num_slots
+    idx = jnp.where(items.active, slot, n)
+
+    def upd(arr, val):
+        return arr.at[idx].set(val, mode="drop")
+
+    return SlotTable(
+        key_hi=upd(table.key_hi, items.key_hi),
+        key_lo=upd(table.key_lo, items.key_lo),
+        used=upd(table.used, jnp.ones_like(items.active)),
+        algo=upd(table.algo, items.algo),
+        status=upd(table.status, items.status),
+        limit=upd(table.limit, items.limit),
+        duration=upd(table.duration, items.duration),
+        remaining=upd(table.remaining, items.remaining),
+        stamp=upd(table.stamp, items.stamp),
+        expire_at=upd(table.expire_at, items.expire_at),
+        invalid_at=upd(table.invalid_at, jnp.zeros_like(items.key_hi)),
+        burst=upd(table.burst, items.burst),
+        lru=upd(table.lru, jnp.broadcast_to(now, idx.shape)),
+    )

@@ -1,0 +1,220 @@
+"""GLOBAL behavior: async hit aggregation + authoritative broadcast.
+
+Reimplements the reference globalManager (reference global.go:43-291) on
+asyncio, preserving its observable contract (reference functional tests,
+SURVEY.md §3.3):
+
+- Non-owners answer GLOBAL checks from their local replica and queue the
+  hit; hits aggregate per key and flush to owners at `global_batch_limit`
+  (1000) or every `global_sync_wait` (100ms), whichever first.
+- Owners queue an update after any owner-side GLOBAL check; the broadcast
+  loop re-reads each key's status with hits=0 and pushes one
+  UpdatePeerGlobals to every non-self peer on the same cadence.
+- Hits at the owner produce broadcast only (no hit-update); hits at one
+  non-owner produce exactly one hit-update + one broadcast; after one
+  sync interval every peer reports the same remaining.
+
+Transport modes:
+- "grpc": reference-compatible cross-host path (this module).
+- "ici": single-process multi-device collective mode — replica deltas are
+  psum'd over the device mesh each tick (parallel/ici.py) — used when the
+  "cluster" is chips in one pod rather than hosts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Dict, Optional
+
+from gubernator_tpu.api.types import (
+    Behavior,
+    RateLimitReq,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+from gubernator_tpu.service.config import BehaviorConfig
+
+
+class GlobalManager:
+    def __init__(self, svc, behaviors: BehaviorConfig, mode: str = "grpc"):
+        self.svc = svc
+        self.b = behaviors
+        self.mode = mode
+        self.hits: Dict[str, RateLimitReq] = {}
+        self.updates: Dict[str, RateLimitReq] = {}
+        self._hits_wake = asyncio.Event()
+        self._hits_full = asyncio.Event()
+        self._upd_wake = asyncio.Event()
+        self._upd_full = asyncio.Event()
+        self._running = True
+        self._tasks = [
+            asyncio.ensure_future(self._hits_loop()),
+            asyncio.ensure_future(self._broadcast_loop()),
+        ]
+
+    # -- queueing (reference global.go:74-84) --------------------------------
+
+    def queue_hit(self, r: RateLimitReq) -> None:
+        if r.hits == 0:
+            return
+        key = r.hash_key()
+        existing = self.hits.get(key)
+        if existing is not None:
+            if has_behavior(r.behavior, Behavior.RESET_REMAINING):
+                existing.behavior |= Behavior.RESET_REMAINING
+            existing.hits += r.hits
+        else:
+            self.hits[key] = dataclasses.replace(r, metadata=dict(r.metadata))
+        self.svc.metrics.global_send_queue_length.set(len(self.hits))
+        if len(self.hits) >= self.b.global_batch_limit:
+            self._hits_full.set()
+        self._hits_wake.set()
+
+    def queue_update(self, r: RateLimitReq) -> None:
+        if r.hits == 0:
+            return
+        self.updates[r.hash_key()] = dataclasses.replace(r, metadata=dict(r.metadata))
+        self.svc.metrics.global_queue_length.set(len(self.updates))
+        if len(self.updates) >= self.b.global_batch_limit:
+            self._upd_full.set()
+        self._upd_wake.set()
+
+    # -- loops (reference global.go:91-140, 193-231) -------------------------
+
+    async def _hits_loop(self) -> None:
+        while self._running:
+            if not self.hits:
+                await self._hits_wake.wait()
+                self._hits_wake.clear()
+                if not self._running:
+                    break
+            if len(self.hits) < self.b.global_batch_limit:
+                try:
+                    await asyncio.wait_for(
+                        self._hits_full.wait(), self.b.global_sync_wait_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
+            self._hits_full.clear()
+            take, self.hits = self.hits, {}
+            self.svc.metrics.global_send_queue_length.set(0)
+            if take:
+                try:
+                    await self._send_hits(take)
+                except Exception:
+                    pass
+
+    async def _broadcast_loop(self) -> None:
+        while self._running:
+            if not self.updates:
+                await self._upd_wake.wait()
+                self._upd_wake.clear()
+                if not self._running:
+                    break
+            if len(self.updates) < self.b.global_batch_limit:
+                try:
+                    await asyncio.wait_for(
+                        self._upd_full.wait(), self.b.global_sync_wait_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
+            self._upd_full.clear()
+            take, self.updates = self.updates, {}
+            self.svc.metrics.global_queue_length.set(0)
+            if take:
+                try:
+                    await self._broadcast(take)
+                except Exception:
+                    pass
+
+    # -- send hits to owners (reference global.go:144-187) -------------------
+
+    async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        t0 = time.perf_counter()
+        try:
+            by_peer: Dict[str, tuple] = {}
+            for key, r in hits.items():
+                try:
+                    peer = self.svc.picker.get(key)
+                except Exception:
+                    continue
+                addr = peer.info.grpc_address
+                if addr in by_peer:
+                    by_peer[addr][1].append(r)
+                else:
+                    by_peer[addr] = (peer, [r])
+
+            sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
+
+            async def send(peer, reqs):
+                async with sem:
+                    try:
+                        await peer.get_peer_rate_limits(
+                            reqs, timeout=self.b.global_timeout_s
+                        )
+                    except Exception as e:
+                        if hasattr(self.svc.forwarder, "record_error"):
+                            self.svc.forwarder.record_error(
+                                f"global send to {peer.info.grpc_address}: {e}"
+                            )
+
+            await asyncio.gather(*(send(p, rs) for p, rs in by_peer.values()))
+        finally:
+            self.svc.metrics.global_send_duration.observe(time.perf_counter() - t0)
+
+    # -- broadcast to replicas (reference global.go:234-283) -----------------
+
+    async def _broadcast(self, updates: Dict[str, RateLimitReq]) -> None:
+        t0 = time.perf_counter()
+        try:
+            # Enqueue ALL status reads first so the engine pump coalesces
+            # them into a few waves, then await; awaiting one-by-one would
+            # serialize a full micro-batch flush per key.
+            futs = [
+                asyncio.wrap_future(
+                    self.svc.engine.check_async(
+                        dataclasses.replace(upd, hits=0, metadata=dict(upd.metadata))
+                    )
+                )
+                for upd in updates.values()
+            ]
+            statuses = await asyncio.gather(*futs)
+            globals_ = [
+                UpdatePeerGlobal(
+                    key=key,
+                    status=status,
+                    algorithm=upd.algorithm,
+                    duration=upd.duration,
+                    created_at=upd.created_at or 0,
+                )
+                for (key, upd), status in zip(updates.items(), statuses)
+            ]
+
+            peers = [
+                p for p in self.svc.picker.peers() if not p.info.is_owner
+            ]
+            sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
+
+            async def push(peer):
+                async with sem:
+                    try:
+                        await peer.update_peer_globals(
+                            globals_, timeout=self.b.global_timeout_s
+                        )
+                    except Exception:
+                        pass
+
+            await asyncio.gather(*(push(p) for p in peers))
+            self.svc.metrics.broadcast_counter.inc()
+        finally:
+            self.svc.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+
+    async def close(self) -> None:
+        self._running = False
+        self._hits_wake.set()
+        self._upd_wake.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)

@@ -1,0 +1,90 @@
+"""Consistent-hash peer ownership (reference replicated_hash.go:29-119).
+
+Same scheme as the reference so key->owner assignment is drop-in
+compatible: 512 virtual replicas per peer, replica hash =
+fnv1_64(str(i) + md5hex(grpc_address)), key hash = fnv1_64(hash_key),
+owner = first replica clockwise (binary search, wraparound). The hash
+function is pluggable (fnv1/fnv1a, reference config.go:421-443).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+DEFAULT_REPLICAS = 512
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_M64 = (1 << 64) - 1
+
+
+def fnv1_64(data: str) -> int:
+    h = _FNV_OFFSET
+    for b in data.encode("utf-8"):
+        h = ((h * _FNV_PRIME) & _M64) ^ b
+    return h
+
+
+def fnv1a_64(data: str) -> int:
+    h = _FNV_OFFSET
+    for b in data.encode("utf-8"):
+        h = ((h ^ b) * _FNV_PRIME) & _M64
+    return h
+
+
+HASHES: Dict[str, Callable[[str], int]] = {
+    "fnv1": fnv1_64,
+    "fnv1a": fnv1a_64,
+}
+
+
+class ReplicatedConsistentHash:
+    """Maps rate-limit keys to owning peers. Peers are any objects with a
+    `.info.grpc_address` attribute (runtime Peer handles)."""
+
+    def __init__(
+        self,
+        hash_fn: Callable[[str], int] = fnv1_64,
+        replicas: int = DEFAULT_REPLICAS,
+    ):
+        self.hash_fn = hash_fn
+        self.replicas = replicas
+        self._peers: Dict[str, object] = {}
+        self._ring_hashes: List[int] = []
+        self._ring_peers: List[object] = []
+
+    def new(self) -> "ReplicatedConsistentHash":
+        return ReplicatedConsistentHash(self.hash_fn, self.replicas)
+
+    def add(self, peer) -> None:
+        addr = peer.info.grpc_address
+        self._peers[addr] = peer
+        key = hashlib.md5(addr.encode("utf-8")).hexdigest()
+        entries = [(self.hash_fn(str(i) + key), peer) for i in range(self.replicas)]
+        merged = sorted(
+            list(zip(self._ring_hashes, self._ring_peers)) + entries,
+            key=lambda e: e[0],
+        )
+        self._ring_hashes = [h for h, _ in merged]
+        self._ring_peers = [p for _, p in merged]
+
+    def size(self) -> int:
+        return len(self._peers)
+
+    def peers(self) -> List[object]:
+        return list(self._peers.values())
+
+    def get_by_address(self, grpc_address: str):
+        return self._peers.get(grpc_address)
+
+    def get(self, key: str):
+        """Owning peer for a hash-key; raises if the pool is empty."""
+        if not self._peers:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        h = self.hash_fn(key)
+        idx = bisect.bisect_left(self._ring_hashes, h)
+        if idx == len(self._ring_hashes):
+            idx = 0
+        return self._ring_peers[idx]

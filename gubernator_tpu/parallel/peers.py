@@ -1,0 +1,281 @@
+"""Peer runtime: per-peer clients with micro-batching, mesh membership,
+and the forwarding path for non-owned keys.
+
+Reimplements the reference's PeerClient/SetPeers machinery
+(reference peer_client.go:85-435, gubernator.go:616-711) on asyncio:
+
+- One Peer handle per remote address, with a lazy gRPC channel and a
+  batch pump: requests accumulate until `batch_limit` (1000) or
+  `batch_wait` (500µs), ship as one GetPeerRateLimits RPC, and demux by
+  index (reference peer_client.go:237-404).
+- PeerMesh is both PeerPicker and forwarder: hash-ring lookup, ≤5
+  retries with owner re-resolution (ownership may migrate to us
+  mid-flight, reference gubernator.go:326-371), and a TTL'd error log
+  feeding HealthCheck (reference peer_client.go:206-235).
+- set_peers atomically swaps rings, reusing existing Peer handles by
+  address and draining orphans (reference gubernator.go:645-711).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.api.types import (
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+from gubernator_tpu.parallel.hash_ring import ReplicatedConsistentHash
+from gubernator_tpu.parallel.region import RegionPicker
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.service.rpc import PeersV1Stub
+
+_ERROR_TTL_S = 300.0  # reference: 5-minute TTL error cache
+
+
+class Peer:
+    """Client handle for one peer (self included)."""
+
+    def __init__(self, info: PeerInfo, behaviors: BehaviorConfig, metrics=None):
+        self.info = info
+        self.behaviors = behaviors
+        self.metrics = metrics
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._stub: Optional[PeersV1Stub] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._pump_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # -- transport -----------------------------------------------------------
+
+    def _ensure_stub(self) -> PeersV1Stub:
+        if self._stub is None:
+            self._channel = grpc.aio.insecure_channel(self.info.grpc_address)
+            self._stub = PeersV1Stub(self._channel)
+        return self._stub
+
+    def _ensure_pump(self) -> asyncio.Queue:
+        if self._queue is None:
+            self._queue = asyncio.Queue(maxsize=1000)
+            self._pump_task = asyncio.ensure_future(self._run_batch())
+        return self._queue
+
+    # -- API -----------------------------------------------------------------
+
+    async def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
+        """Single check via the peer's batch queue (reference
+        peer_client.go:125-162); NO_BATCHING bypasses the queue."""
+        if has_behavior(req.behavior, Behavior.NO_BATCHING):
+            out = await self.get_peer_rate_limits([req])
+            return out[0]
+        if self._closed:
+            # Peer was removed by a membership change; the caller's retry
+            # loop re-resolves the owner from the new ring.
+            raise RuntimeError("peer client shutdown")
+        q = self._ensure_pump()
+        fut = asyncio.get_running_loop().create_future()
+        await q.put((req, fut))
+        # Upper bound so a request can never hang if the pump dies between
+        # the _closed check and the put (shutdown race).
+        return await asyncio.wait_for(fut, self.behaviors.batch_timeout_s * 2 + 1.0)
+
+    async def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
+        stub = self._ensure_stub()
+        msg = pb.peers_pb.GetPeerRateLimitsReq()
+        for r in reqs:
+            msg.requests.append(pb.req_to_pb(r))
+        resp = await stub.get_peer_rate_limits(
+            msg, timeout=timeout or self.behaviors.batch_timeout_s
+        )
+        if len(resp.rate_limits) != len(reqs):
+            raise RuntimeError(
+                "number of rate limits in peer response does not match request"
+            )
+        return [pb.resp_from_pb(r) for r in resp.rate_limits]
+
+    async def update_peer_globals(
+        self, globals_: Sequence[UpdatePeerGlobal], timeout: Optional[float] = None
+    ) -> None:
+        stub = self._ensure_stub()
+        msg = pb.peers_pb.UpdatePeerGlobalsReq()
+        for g in globals_:
+            msg.globals.append(pb.global_to_pb(g))
+        await stub.update_peer_globals(
+            msg, timeout=timeout or self.behaviors.global_timeout_s
+        )
+
+    # -- batch pump (reference peer_client.go:284-404) -----------------------
+
+    async def _run_batch(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            item = await self._queue.get()
+            if item is None:
+                break
+            batch = [item]
+            deadline = loop.time() + self.behaviors.batch_wait_s
+            while len(batch) < self.behaviors.batch_limit:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = await asyncio.wait_for(self._queue.get(), remaining)
+                except asyncio.TimeoutError:
+                    break
+                if nxt is None:
+                    self._closed = True
+                    break
+                batch.append(nxt)
+            await self._send_batch([b for b in batch if b is not None])
+
+    async def _send_batch(self, batch) -> None:
+        if not batch:
+            return
+        t0 = time.perf_counter()
+        try:
+            out = await self.get_peer_rate_limits([r for r, _ in batch])
+            for (_, fut), resp in zip(batch, out):
+                if not fut.done():
+                    fut.set_result(resp)
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(_clone_exc(e))
+        finally:
+            if self.metrics is not None:
+                self.metrics.batch_send_duration.observe(time.perf_counter() - t0)
+
+    async def shutdown(self) -> None:
+        """Graceful close: stop the pump, fail queued requests, close the
+        channel (reference peer_client.go:408-435)."""
+        self._closed = True
+        if self._queue is not None:
+            await self._queue.put(None)
+        if self._pump_task is not None:
+            try:
+                await asyncio.wait_for(self._pump_task, timeout=1.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._pump_task.cancel()
+            while self._queue is not None and not self._queue.empty():
+                item = self._queue.get_nowait()
+                if item is not None and not item[1].done():
+                    item[1].set_exception(RuntimeError("peer client shutdown"))
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self._stub = None
+
+
+def _clone_exc(e: Exception) -> Exception:
+    # grpc.aio exceptions are not always safe to set on multiple futures
+    return RuntimeError(str(e)) if not isinstance(e, RuntimeError) else e
+
+
+class PeerMesh:
+    """PeerPicker + forwarder + membership (the V1Service seams)."""
+
+    def __init__(self, svc, behaviors: BehaviorConfig):
+        self.svc = svc
+        self.behaviors = behaviors
+        self.local_ring = ReplicatedConsistentHash()
+        self.region_picker = RegionPicker()
+        self._all: Dict[str, Peer] = {}
+        self._errors: List[tuple] = []  # (ts, message)
+
+    # -- PeerPicker interface ------------------------------------------------
+
+    def get(self, key: str) -> Peer:
+        return self.local_ring.get(key)
+
+    def peers(self) -> List[Peer]:
+        return self.local_ring.peers()
+
+    def region_peers(self) -> List[Peer]:
+        return self.region_picker.peers()
+
+    def set_peers(self, peers: Sequence[PeerInfo], local_info: PeerInfo) -> None:
+        """Atomic ring swap with Peer reuse (reference gubernator.go:616-711)."""
+        new_local = self.local_ring.new()
+        new_region = self.region_picker.new()
+        keep: Dict[str, Peer] = {}
+        for info in peers:
+            existing = self._all.get(info.grpc_address)
+            if existing is not None:
+                existing.info = info
+                peer = existing
+            else:
+                peer = Peer(info, self.behaviors, metrics=self.svc.metrics)
+            keep[info.grpc_address] = peer
+            if not info.data_center or info.data_center == local_info.data_center:
+                new_local.add(peer)
+            else:
+                new_region.add(peer)
+        orphans = [p for a, p in self._all.items() if a not in keep]
+        self.local_ring = new_local
+        self.region_picker = new_region
+        self._all = keep
+        for p in orphans:
+            asyncio.ensure_future(p.shutdown())
+
+    # -- forwarder interface (reference gubernator.go:311-391) ---------------
+
+    async def forward(self, peer: Peer, req: RateLimitReq) -> RateLimitResp:
+        key = req.hash_key()
+        attempts = 0
+        while True:
+            if peer.info.is_owner:
+                # Ownership migrated to us mid-flight: serve locally.
+                resp = await asyncio.wrap_future(self.svc.engine.check_async(req))
+                return resp
+            try:
+                resp = await peer.get_peer_rate_limit(req)
+                resp.metadata = dict(resp.metadata or {})
+                resp.metadata["owner"] = peer.info.grpc_address
+                return resp
+            except Exception as e:
+                self.record_error(f"{peer.info.grpc_address}: {e}")
+                if attempts >= 5:
+                    self.svc.metrics.check_error_counter.labels(
+                        "Error in get_peer_rate_limit"
+                    ).inc()
+                    raise
+                attempts += 1
+                self.svc.metrics.batch_send_retries.inc()
+                peer = self.get(key)
+
+    # -- health (reference gubernator.go:542-586) ----------------------------
+
+    def record_error(self, msg: str) -> None:
+        now = time.monotonic()
+        self._errors.append((now, msg))
+        self._errors = [(t, m) for t, m in self._errors if now - t < _ERROR_TTL_S]
+
+    def recent_errors(self) -> List[str]:
+        now = time.monotonic()
+        return [m for t, m in self._errors if now - t < _ERROR_TTL_S]
+
+    async def close(self) -> None:
+        for p in list(self._all.values()):
+            await p.shutdown()
+        self._all.clear()
+
+
+def wire_peers(daemon, global_mode: str = "grpc") -> None:
+    """Attach the peer mesh + GLOBAL manager to a daemon's service."""
+    from gubernator_tpu.parallel.global_sync import GlobalManager
+
+    svc = daemon.svc
+    mesh = PeerMesh(svc, daemon.conf.behaviors)
+    svc.picker = mesh
+    svc.forwarder = mesh
+    svc.global_mgr = GlobalManager(svc, daemon.conf.behaviors, mode=global_mode)

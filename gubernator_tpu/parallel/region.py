@@ -1,0 +1,44 @@
+"""Multi-region peer picking (reference region_picker.go:19-103).
+
+Peers whose data_center differs from the local node's are routed into
+per-region rings; MULTI_REGION replication across those rings is a
+declared-but-unimplemented behavior in the reference (its multi-region
+test is an empty TODO, functional_test.go:1578-1586) and is likewise a
+forward seam here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from gubernator_tpu.parallel.hash_ring import ReplicatedConsistentHash
+
+
+class RegionPicker:
+    def __init__(self, local_picker: ReplicatedConsistentHash = None):
+        self.local_picker = local_picker or ReplicatedConsistentHash()
+        self.regions: Dict[str, ReplicatedConsistentHash] = {}
+
+    def new(self) -> "RegionPicker":
+        return RegionPicker(self.local_picker.new())
+
+    def add(self, peer) -> None:
+        dc = peer.info.data_center
+        ring = self.regions.get(dc)
+        if ring is None:
+            ring = self.local_picker.new()
+            self.regions[dc] = ring
+        ring.add(peer)
+
+    def pickers(self) -> Dict[str, ReplicatedConsistentHash]:
+        return self.regions
+
+    def peers(self) -> List[object]:
+        out = []
+        for ring in self.regions.values():
+            out.extend(ring.peers())
+        return out
+
+    def get_by_region(self, region: str, key: str):
+        ring = self.regions.get(region)
+        return ring.get(key) if ring is not None else None

@@ -88,6 +88,36 @@ class EngineMetrics:
             self.batch_duration_sum += dur
 
 
+class _WaveAssembler:
+    """First-fit placement of requests into scatter-disjoint waves: a
+    request goes to the first wave where its slot-group is unused and a
+    lane is free. Same key => same group => strictly increasing wave
+    index, which preserves per-key request order."""
+
+    def __init__(self, make_batch, batch_size: int):
+        self._make = make_batch
+        self._B = batch_size
+        self.waves: List[object] = []
+        self._groups: List[set] = []
+        self._fill: List[int] = []
+
+    def place(self, grp: int) -> Tuple[object, int, int]:
+        """Returns (wave_batch, wave_index, lane) without committing."""
+        w = 0
+        while True:
+            if w == len(self.waves):
+                self.waves.append(self._make(self._B))
+                self._groups.append(set())
+                self._fill.append(0)
+            if grp not in self._groups[w] and self._fill[w] < self._B:
+                return self.waves[w], w, self._fill[w]
+            w += 1
+
+    def commit(self, w: int, grp: int) -> None:
+        self._groups[w].add(grp)
+        self._fill[w] += 1
+
+
 class DeviceEngine:
     """Owns the device slot table; turns request streams into decisions.
 
@@ -116,11 +146,28 @@ class DeviceEngine:
         with jax.default_device(dev) if dev is not None else _nullcontext():
             self.table: SlotTable = SlotTable.create(config.num_groups, config.ways)
 
+        self._warmup()
+
         self._running = True
         self._thread = threading.Thread(
             target=self._pump, name="gubernator-tpu-engine", daemon=True
         )
         self._thread.start()
+
+    def _warmup(self) -> None:
+        """Compile the decide AND inject kernels before serving: first XLA
+        compilation takes seconds (tens of seconds on TPU), which would
+        blow through peer-forwarding / GLOBAL broadcast timeouts (500ms
+        default) on the first request."""
+        from gubernator_tpu.ops.inject import InjectBatch, inject
+
+        now = self.now_fn()
+        wb = RequestBatch.zeros(self.cfg.batch_size)
+        table, out = decide(self.table, wb, now, ways=self.cfg.ways)
+        np.asarray(out.status)
+        table = inject(table, InjectBatch.zeros(self.cfg.batch_size), now, ways=self.cfg.ways)
+        np.asarray(table.used[:1])
+        self.table = table
 
     # ---- public API --------------------------------------------------------
 
@@ -152,6 +199,15 @@ class DeviceEngine:
 
     def key_string(self, hi: int, lo: int) -> Optional[str]:
         return self._key_strings.get((hi, lo))
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def live_count(self) -> int:
+        """Number of occupied slots (gubernator_cache_size analog).
+        One device reduction; intended for scrape cadence, not hot path."""
+        with self._lock:
+            return int(jax.numpy.sum(self.table.used))
 
     # ---- pump --------------------------------------------------------------
 
@@ -201,12 +257,7 @@ class DeviceEngine:
         cfg = self.cfg
         B = cfg.batch_size
 
-        # Assign each request to (wave, lane): first wave where its group is
-        # unused and a lane is free. Preserves per-key request order because
-        # same key => same group => strictly increasing wave index.
-        waves: List[RequestBatch] = []
-        wave_groups: List[set] = []
-        wave_fill: List[int] = []
+        asm = _WaveAssembler(RequestBatch.zeros, B)
         placements: List[Optional[Tuple[int, int]]] = []
 
         for req, fut in items:
@@ -214,25 +265,16 @@ class DeviceEngine:
             if cfg.keep_key_strings:
                 self._key_strings[(hi, lo)] = req.hash_key()
             grp = group_of(lo, cfg.num_groups)
-            w = 0
-            while True:
-                if w == len(waves):
-                    waves.append(RequestBatch.zeros(B))
-                    wave_groups.append(set())
-                    wave_fill.append(0)
-                if grp not in wave_groups[w] and wave_fill[w] < B:
-                    break
-                w += 1
-            lane = wave_fill[w]
+            wb, w, lane = asm.place(grp)
             try:
-                encode_one(waves[w], lane, req, now, cfg.num_groups, key=(hi, lo))
+                encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
             except EncodeError as e:
                 fut.set_result(RateLimitResp(error=str(e)))
                 placements.append(None)
                 continue
-            wave_groups[w].add(grp)
-            wave_fill[w] += 1
+            asm.commit(w, grp)
             placements.append((w, lane))
+        waves = asm.waves
 
         # Execute waves sequentially against the (donated) table.
         outs = []
@@ -276,6 +318,52 @@ class DeviceEngine:
                     reset_time=int(rst[lane]),
                 )
             )
+
+    # ---- direct state injection (AddCacheItem analog) ----------------------
+
+    def inject_globals(self, globals_: Sequence) -> None:
+        """Overwrite local state with authoritative GLOBAL updates from the
+        owner (reference gubernator.go:425-459: rebuilds a CacheItem with
+        stamp=now, expire=status.reset_time, leaky burst=limit)."""
+        from gubernator_tpu.api.types import Algorithm
+        from gubernator_tpu.models.bucket import FIXED_SHIFT
+        from gubernator_tpu.ops.inject import InjectBatch, inject
+
+        if not globals_:
+            return
+        now = self.now_fn()
+        cfg = self.cfg
+        B = cfg.batch_size
+
+        asm = _WaveAssembler(InjectBatch.zeros, B)
+        for g in globals_:
+            hi, lo = key_hash128(g.key)
+            if cfg.keep_key_strings:
+                self._key_strings[(hi, lo)] = g.key
+            grp = group_of(lo, cfg.num_groups)
+            ib, w, lane = asm.place(grp)
+            leaky = int(g.algorithm) == int(Algorithm.LEAKY_BUCKET)
+            ib.key_hi[lane] = hi
+            ib.key_lo[lane] = lo
+            ib.group[lane] = grp
+            ib.algo[lane] = int(g.algorithm)
+            ib.status[lane] = int(g.status.status)
+            ib.limit[lane] = g.status.limit
+            ib.duration[lane] = g.duration
+            ib.remaining[lane] = (
+                g.status.remaining << FIXED_SHIFT if leaky else g.status.remaining
+            )
+            ib.stamp[lane] = now
+            ib.expire_at[lane] = g.status.reset_time
+            ib.burst[lane] = g.status.limit if leaky else 0
+            ib.active[lane] = True
+            asm.commit(w, grp)
+
+        with self._lock:
+            table = self.table
+            for ib in asm.waves:
+                table = inject(table, ib, now, ways=cfg.ways)
+            self.table = table
 
     # ---- snapshot / restore (Loader seam, task: store) ---------------------
 

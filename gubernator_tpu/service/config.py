@@ -1,0 +1,67 @@
+"""Daemon configuration (reference config.go:73-252 analog).
+
+Library users fill these dataclasses directly; the CLI/env layer
+(`gubernator_tpu.service.envconfig`) populates them from GUBER_* env vars
+the way the reference's SetupDaemonConfig does (config.go:270-479).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.runtime.engine import EngineConfig
+
+
+@dataclasses.dataclass
+class BehaviorConfig:
+    """Batching / GLOBAL tuning knobs (reference config.go:49-70,126-134)."""
+
+    batch_timeout_s: float = 0.5
+    batch_wait_s: float = 500e-6
+    batch_limit: int = 1000
+
+    global_timeout_s: float = 0.5
+    global_sync_wait_s: float = 0.1
+    global_batch_limit: int = 1000
+    global_peer_requests_concurrency: int = 100
+
+    force_global: bool = False
+
+
+@dataclasses.dataclass
+class DaemonConfig:
+    grpc_listen_address: str = "127.0.0.1:0"
+    http_listen_address: str = "127.0.0.1:0"
+    advertise_address: str = ""  # defaults to the bound gRPC address
+    data_center: str = ""
+
+    # Counter capacity: total slots = cache_size rounded up to groups*ways
+    # (reference default 50k items, config.go:139-140)
+    cache_size: int = 50_000
+
+    behaviors: BehaviorConfig = dataclasses.field(default_factory=BehaviorConfig)
+    engine: Optional[EngineConfig] = None
+
+    # Static peer list (the in-process cluster fixture and tests use this;
+    # discovery pools feed the same set_peers path)
+    peers: List[PeerInfo] = dataclasses.field(default_factory=list)
+
+    # GLOBAL sync transport: "grpc" (cross-host, reference-compatible) or
+    # "ici" (single-process multi-device collective mode)
+    global_mode: str = "grpc"
+
+    def engine_config(self) -> EngineConfig:
+        if self.engine is not None:
+            return self.engine
+        ways = 8
+        groups = 1
+        while groups * ways < self.cache_size:
+            groups <<= 1
+        return EngineConfig(
+            num_groups=groups,
+            ways=ways,
+            batch_wait_s=self.behaviors.batch_wait_s,
+            batch_limit=self.behaviors.batch_limit,
+        )

@@ -1,0 +1,143 @@
+"""Daemon: composition root (reference daemon.go:73-366).
+
+Builds the device engine, core service, gRPC server (V1 + PeersV1), and
+the HTTP gateway; exposes SetPeers for discovery backends and a client
+helper for tests. One process can host many daemons (each with its own
+engine/table/registry) — the in-process cluster fixture depends on that,
+like the reference's cluster harness (cluster/cluster.go:151-189).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Sequence
+
+import grpc
+from aiohttp import web
+
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.runtime.engine import DeviceEngine
+from gubernator_tpu.service import rpc
+from gubernator_tpu.service.config import DaemonConfig
+from gubernator_tpu.service.gateway import build_app
+from gubernator_tpu.service.grpc_service import PeersV1Servicer, V1Servicer
+from gubernator_tpu.service.server import V1Service
+
+
+class Daemon:
+    def __init__(self, conf: DaemonConfig):
+        self.conf = conf
+        self.engine: Optional[DeviceEngine] = None
+        self.svc: Optional[V1Service] = None
+        self.grpc_server: Optional[grpc.aio.Server] = None
+        self.http_runner: Optional[web.AppRunner] = None
+        self.grpc_address = ""
+        self.http_address = ""
+        self._channel: Optional[grpc.aio.Channel] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    async def spawn(cls, conf: DaemonConfig) -> "Daemon":
+        d = cls(conf)
+        await d.start()
+        return d
+
+    async def start(self) -> None:
+        conf = self.conf
+        self.engine = DeviceEngine(conf.engine_config())
+        metrics = Metrics()
+        from gubernator_tpu.metrics import engine_sync
+
+        metrics.add_sync(engine_sync(self.engine))
+
+        self.svc = V1Service(
+            self.engine,
+            metrics=metrics,
+            force_global=conf.behaviors.force_global,
+        )
+
+        # gRPC server hosting both services (reference daemon.go:139-167)
+        self.grpc_server = grpc.aio.server()
+        self.grpc_server.add_generic_rpc_handlers(
+            (rpc.v1_handler(V1Servicer(self.svc)), rpc.peers_handler(PeersV1Servicer(self.svc)))
+        )
+        port = self.grpc_server.add_insecure_port(conf.grpc_listen_address)
+        host = conf.grpc_listen_address.rsplit(":", 1)[0]
+        self.grpc_address = f"{host}:{port}"
+        await self.grpc_server.start()
+
+        # Local identity must be known before peers are set
+        advertise = conf.advertise_address or self.grpc_address
+
+        # HTTP gateway + metrics (reference daemon.go:251-299)
+        app = build_app(self.svc)
+        self.http_runner = web.AppRunner(app)
+        await self.http_runner.setup()
+        hhost, hport = conf.http_listen_address.rsplit(":", 1)
+        site = web.TCPSite(self.http_runner, hhost, int(hport))
+        await site.start()
+        actual = site._server.sockets[0].getsockname()
+        self.http_address = f"{hhost}:{actual[1]}"
+
+        self.svc.local_info = PeerInfo(
+            grpc_address=advertise,
+            http_address=self.http_address,
+            data_center=conf.data_center,
+            is_owner=True,
+        )
+
+        # Peer mesh (hash ring + forwarder + global manager) is attached by
+        # wire_peers(); a daemon with no peers serves everything locally.
+        from gubernator_tpu.parallel.peers import wire_peers
+
+        wire_peers(self, global_mode=conf.global_mode)
+        if conf.peers:
+            self.set_peers(conf.peers)
+
+    async def close(self) -> None:
+        if self.svc is not None and self.svc.global_mgr is not None:
+            await self.svc.global_mgr.close()
+        if self.svc is not None and self.svc.forwarder is not None:
+            await self.svc.forwarder.close()
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+        if self.grpc_server is not None:
+            await self.grpc_server.stop(grace=0.5)
+        if self.http_runner is not None:
+            await self.http_runner.cleanup()
+        if self.engine is not None:
+            self.engine.close()
+
+    # -- peers ---------------------------------------------------------------
+
+    def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Discovery callback (reference daemon.go:208-243 -> SetPeers)."""
+        local = self.svc.local_info
+        normalized: List[PeerInfo] = []
+        for p in peers:
+            is_self = p.grpc_address == local.grpc_address
+            normalized.append(
+                PeerInfo(
+                    grpc_address=p.grpc_address,
+                    http_address=p.http_address,
+                    data_center=p.data_center,
+                    is_owner=is_self,
+                )
+            )
+        self.svc.set_peers(normalized)
+
+    def peer_info(self) -> PeerInfo:
+        return self.svc.local_info
+
+    # -- client helper (reference daemon.go:433-447) -------------------------
+
+    def client(self) -> rpc.V1Stub:
+        if self._channel is None:
+            self._channel = grpc.aio.insecure_channel(self.grpc_address)
+        return rpc.V1Stub(self._channel)
+
+    async def must_client(self) -> rpc.V1Stub:
+        return self.client()

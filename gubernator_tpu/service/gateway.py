@@ -1,0 +1,56 @@
+"""HTTP/JSON gateway + /metrics + /healthz.
+
+Mirrors the reference's grpc-gateway mux (reference daemon.go:251-299):
+POST /v1/GetRateLimits and GET /v1/HealthCheck speak snake_case JSON
+(pinned by the reference's TestGRPCGateway), /metrics serves Prometheus
+text, /healthz is the liveness probe.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.server import ApiError, V1Service
+
+
+def build_app(svc: V1Service) -> web.Application:
+    app = web.Application()
+
+    async def get_rate_limits(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response(
+                {"code": 3, "message": f"invalid JSON: {e}"}, status=400
+            )
+        items = body.get("requests") or []
+        reqs = [pb.req_from_json(d) for d in items]
+        try:
+            out = await svc.get_rate_limits(reqs)
+        except ApiError as e:
+            return web.json_response({"code": 11, "message": str(e)}, status=e.http_code)
+        return web.json_response({"responses": [pb.resp_to_json(r) for r in out]})
+
+    async def health_check(request: web.Request) -> web.Response:
+        h = await svc.health_check()
+        return web.json_response(pb.health_to_json(h))
+
+    async def healthz(request: web.Request) -> web.Response:
+        h = await svc.health_check()
+        return web.Response(
+            text=h.status, status=200 if h.status == "healthy" else 503
+        )
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(
+            body=svc.metrics.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    app.router.add_post("/v1/GetRateLimits", get_rate_limits)
+    app.router.add_get("/v1/HealthCheck", health_check)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    return app

@@ -1,0 +1,74 @@
+"""grpc.aio servicers bridging the wire to V1Service."""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.server import ApiError, V1Service
+
+_GRPC_CODES = {
+    "OUT_OF_RANGE": grpc.StatusCode.OUT_OF_RANGE,
+    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+    "INTERNAL": grpc.StatusCode.INTERNAL,
+}
+
+
+class V1Servicer:
+    def __init__(self, svc: V1Service):
+        self.svc = svc
+
+    async def GetRateLimits(self, request, context):
+        m = self.svc.metrics
+        t0 = time.perf_counter()
+        try:
+            reqs = [pb.req_from_pb(r) for r in request.requests]
+            try:
+                out = await self.svc.get_rate_limits(reqs)
+            except ApiError as e:
+                m.grpc_request_counts.labels("/pb.gubernator.V1/GetRateLimits", "failed").inc()
+                await context.abort(
+                    _GRPC_CODES.get(e.grpc_code, grpc.StatusCode.INTERNAL), str(e)
+                )
+            resp = pb.pb.GetRateLimitsResp()
+            for r in out:
+                resp.responses.append(pb.resp_to_pb(r))
+            m.grpc_request_counts.labels("/pb.gubernator.V1/GetRateLimits", "success").inc()
+            return resp
+        finally:
+            m.grpc_request_duration.labels("/pb.gubernator.V1/GetRateLimits").observe(
+                time.perf_counter() - t0
+            )
+
+    async def HealthCheck(self, request, context):
+        h = await self.svc.health_check()
+        self.svc.metrics.grpc_request_counts.labels(
+            "/pb.gubernator.V1/HealthCheck", "success"
+        ).inc()
+        return pb.health_to_pb(h)
+
+
+class PeersV1Servicer:
+    def __init__(self, svc: V1Service):
+        self.svc = svc
+
+    async def GetPeerRateLimits(self, request, context):
+        try:
+            reqs = [pb.req_from_pb(r) for r in request.requests]
+            out = await self.svc.get_peer_rate_limits(reqs)
+        except ApiError as e:
+            await context.abort(
+                _GRPC_CODES.get(e.grpc_code, grpc.StatusCode.INTERNAL), str(e)
+            )
+        resp = pb.peers_pb.GetPeerRateLimitsResp()
+        for r in out:
+            resp.rate_limits.append(pb.resp_to_pb(r))
+        return resp
+
+    async def UpdatePeerGlobals(self, request, context):
+        await self.svc.update_peer_globals(
+            [pb.global_from_pb(g) for g in request.globals]
+        )
+        return pb.peers_pb.UpdatePeerGlobalsResp()

@@ -1,0 +1,86 @@
+"""Hand-written gRPC service glue (grpc_tools codegen unavailable).
+
+Defines the V1 and PeersV1 services (reference gubernator.proto:27-44,
+peers.proto:28-34) as generic handlers over the protoc-generated message
+classes, plus async client stubs. Method paths match the reference's
+generated stubs exactly, so reference Go/Python clients interoperate.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gubernator_tpu.service import pb
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_SERVICE = "pb.gubernator.PeersV1"
+
+
+def v1_handler(servicer) -> grpc.GenericRpcHandler:
+    """servicer: async methods GetRateLimits(req, ctx), HealthCheck(req, ctx)."""
+    return grpc.method_handlers_generic_handler(
+        V1_SERVICE,
+        {
+            "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetRateLimits,
+                request_deserializer=pb.pb.GetRateLimitsReq.FromString,
+                response_serializer=pb.pb.GetRateLimitsResp.SerializeToString,
+            ),
+            "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                servicer.HealthCheck,
+                request_deserializer=pb.pb.HealthCheckReq.FromString,
+                response_serializer=pb.pb.HealthCheckResp.SerializeToString,
+            ),
+        },
+    )
+
+
+def peers_handler(servicer) -> grpc.GenericRpcHandler:
+    """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req, ctx)."""
+    return grpc.method_handlers_generic_handler(
+        PEERS_SERVICE,
+        {
+            "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetPeerRateLimits,
+                request_deserializer=pb.peers_pb.GetPeerRateLimitsReq.FromString,
+                response_serializer=pb.peers_pb.GetPeerRateLimitsResp.SerializeToString,
+            ),
+            "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+                servicer.UpdatePeerGlobals,
+                request_deserializer=pb.peers_pb.UpdatePeerGlobalsReq.FromString,
+                response_serializer=pb.peers_pb.UpdatePeerGlobalsResp.SerializeToString,
+            ),
+        },
+    )
+
+
+class V1Stub:
+    """Async client for the public V1 service."""
+
+    def __init__(self, channel: grpc.aio.Channel):
+        self.get_rate_limits = channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=pb.pb.GetRateLimitsReq.SerializeToString,
+            response_deserializer=pb.pb.GetRateLimitsResp.FromString,
+        )
+        self.health_check = channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=pb.pb.HealthCheckReq.SerializeToString,
+            response_deserializer=pb.pb.HealthCheckResp.FromString,
+        )
+
+
+class PeersV1Stub:
+    """Async client for the peer-to-peer service."""
+
+    def __init__(self, channel: grpc.aio.Channel):
+        self.get_peer_rate_limits = channel.unary_unary(
+            f"/{PEERS_SERVICE}/GetPeerRateLimits",
+            request_serializer=pb.peers_pb.GetPeerRateLimitsReq.SerializeToString,
+            response_deserializer=pb.peers_pb.GetPeerRateLimitsResp.FromString,
+        )
+        self.update_peer_globals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+            request_serializer=pb.peers_pb.UpdatePeerGlobalsReq.SerializeToString,
+            response_deserializer=pb.peers_pb.UpdatePeerGlobalsResp.FromString,
+        )

@@ -1,0 +1,247 @@
+"""Core rate-limit service: validation, ownership routing, execution.
+
+The transport-agnostic heart of the daemon (the reference's V1Instance,
+gubernator.go:45-773): gRPC servicers and the HTTP gateway both call into
+this class. Owner-path items go to the local DeviceEngine in one batch;
+non-owner items are forwarded to the owning peer (micro-batched by
+PeerForwarder) or, for GLOBAL, answered from the local replica and
+reconciled asynchronously.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence
+
+from gubernator_tpu.api.types import (
+    Behavior,
+    HealthCheckResp,
+    MAX_BATCH_SIZE,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.runtime.engine import DeviceEngine
+from gubernator_tpu.utils import clock as _clock
+
+
+class ApiError(Exception):
+    """Whole-call failure, mapped to gRPC OUT_OF_RANGE / HTTP 400 etc."""
+
+    def __init__(self, message: str, grpc_code: str = "INVALID_ARGUMENT", http_code: int = 400):
+        super().__init__(message)
+        self.grpc_code = grpc_code
+        self.http_code = http_code
+
+
+class V1Service:
+    def __init__(
+        self,
+        engine: DeviceEngine,
+        metrics: Optional[Metrics] = None,
+        local_info: Optional[PeerInfo] = None,
+        force_global: bool = False,
+        now_fn=_clock.now_ms,
+    ):
+        self.engine = engine
+        self.metrics = metrics or Metrics()
+        self.local_info = local_info or PeerInfo(is_owner=True)
+        self.force_global = force_global
+        self.now_fn = now_fn
+        # Peer mesh seams, wired by the daemon (tasks: peers, global)
+        self.picker = None  # PeerPicker; None => every key is local
+        self.forwarder = None  # PeerForwarder for non-owner items
+        self.global_mgr = None  # GlobalManager for GLOBAL behavior
+        self._peers_lock = asyncio.Lock()
+
+    # ---- V1.GetRateLimits (reference gubernator.go:183-309) ----------------
+
+    async def get_rate_limits(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        m = self.metrics
+        if len(reqs) > MAX_BATCH_SIZE:
+            m.check_error_counter.labels("Request too large").inc()
+            raise ApiError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+                grpc_code="OUT_OF_RANGE",
+            )
+        m.concurrent_checks.inc()
+        t0 = time.perf_counter()
+        try:
+            return await self._get_rate_limits(reqs)
+        finally:
+            m.concurrent_checks.dec()
+            m.func_duration.labels("V1Instance.GetRateLimits").observe(
+                time.perf_counter() - t0
+            )
+
+    async def _get_rate_limits(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        m = self.metrics
+        now = self.now_fn()
+        n = len(reqs)
+        responses: List[Optional[RateLimitResp]] = [None] * n
+        local_idx: List[int] = []
+        local_futs = []
+        forward_tasks = []
+
+        from gubernator_tpu.api.types import validate_request
+
+        for i, req in enumerate(reqs):
+            err = validate_request(req)
+            if err is not None:
+                m.check_error_counter.labels("Invalid request").inc()
+                responses[i] = RateLimitResp(error=err)
+                continue
+            if req.created_at is None or req.created_at == 0:
+                req.created_at = now
+            if self.force_global:
+                req.behavior |= Behavior.GLOBAL
+
+            key = req.hash_key()
+            try:
+                peer = self._get_peer(key)
+            except Exception as e:
+                m.check_error_counter.labels("Error in GetPeer").inc()
+                responses[i] = RateLimitResp(
+                    error=f"Error in GetPeer, looking up peer that owns rate limit '{key}': {e}"
+                )
+                continue
+
+            if peer.info.is_owner:
+                m.getratelimit_counter.labels("local").inc()
+                local_idx.append(i)
+                local_futs.append(asyncio.wrap_future(self.engine.check_async(req)))
+                if self.global_mgr is not None and has_behavior(
+                    req.behavior, Behavior.GLOBAL
+                ):
+                    # Owner-side GLOBAL update broadcast queue
+                    # (reference gubernator.go:603-606)
+                    self.global_mgr.queue_update(req)
+            elif has_behavior(req.behavior, Behavior.GLOBAL):
+                m.getratelimit_counter.labels("global").inc()
+                local_idx.append(i)
+                local_futs.append(
+                    asyncio.ensure_future(
+                        self._get_global_rate_limit(req, peer.info)
+                    )
+                )
+            else:
+                m.getratelimit_counter.labels("forward").inc()
+                forward_tasks.append(
+                    (i, asyncio.ensure_future(self._forward(peer, req)))
+                )
+
+        for i, fut in zip(local_idx, local_futs):
+            try:
+                responses[i] = await fut
+            except Exception as e:
+                responses[i] = RateLimitResp(error=str(e))
+        for i, task in forward_tasks:
+            try:
+                responses[i] = await task
+            except Exception as e:
+                m.check_error_counter.labels("Error in asyncRequests").inc()
+                responses[i] = RateLimitResp(error=str(e))
+        return [r if r is not None else RateLimitResp(error="internal: no response") for r in responses]
+
+    def _get_peer(self, key: str):
+        """Hash-ring lookup (reference gubernator.go:714-725); a standalone
+        daemon (no peers configured) owns every key."""
+        if self.picker is None or not self.picker.peers():
+            return _LocalPeer(self.local_info)
+        return self.picker.get(key)
+
+    # ---- GLOBAL non-owner path (reference gubernator.go:395-421) -----------
+
+    async def _get_global_rate_limit(
+        self, req: RateLimitReq, owner: PeerInfo
+    ) -> RateLimitResp:
+        import dataclasses
+
+        req2 = dataclasses.replace(req, metadata=dict(req.metadata))
+        req2.behavior = (req.behavior | Behavior.NO_BATCHING) & ~Behavior.GLOBAL
+        resp = await asyncio.wrap_future(self.engine.check_async(req2))
+        if self.global_mgr is not None:
+            self.global_mgr.queue_hit(req)
+        resp.metadata = {"owner": owner.grpc_address}
+        return resp
+
+    async def _forward(self, peer, req: RateLimitReq) -> RateLimitResp:
+        if self.forwarder is None:
+            raise RuntimeError("no peer forwarder configured")
+        return await self.forwarder.forward(peer, req)
+
+    # ---- PeersV1.GetPeerRateLimits (reference gubernator.go:462-539) -------
+
+    async def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        if len(reqs) > MAX_BATCH_SIZE:
+            self.metrics.check_error_counter.labels("Request too large").inc()
+            raise ApiError(
+                f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
+                grpc_code="OUT_OF_RANGE",
+            )
+        futs = []
+        for req in reqs:
+            if has_behavior(req.behavior, Behavior.GLOBAL):
+                # Owner handling a relayed GLOBAL hit always drains
+                # (reference gubernator.go:510-512) and queues a broadcast.
+                req.behavior |= Behavior.DRAIN_OVER_LIMIT
+            if req.created_at is None or req.created_at == 0:
+                req.created_at = self.now_fn()
+            futs.append(asyncio.wrap_future(self.engine.check_async(req)))
+            if self.global_mgr is not None and has_behavior(req.behavior, Behavior.GLOBAL):
+                self.global_mgr.queue_update(req)
+        out = []
+        for f in futs:
+            try:
+                out.append(await f)
+            except Exception as e:
+                out.append(RateLimitResp(error=str(e)))
+        return out
+
+    # ---- PeersV1.UpdatePeerGlobals (reference gubernator.go:425-459) -------
+
+    async def update_peer_globals(self, globals_: Sequence[UpdatePeerGlobal]) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.engine.inject_globals, globals_)
+
+    # ---- V1.HealthCheck (reference gubernator.go:542-586) ------------------
+
+    async def health_check(self) -> HealthCheckResp:
+        errors: List[str] = []
+        peer_count = 0
+        if self.picker is not None:
+            peer_count = len(self.picker.peers())
+            if hasattr(self.picker, "region_peers"):
+                peer_count += len(self.picker.region_peers())
+            if self.forwarder is not None:
+                errors = self.forwarder.recent_errors()
+        if errors:
+            return HealthCheckResp(
+                status="unhealthy", message="; ".join(errors[:3]), peer_count=peer_count
+            )
+        return HealthCheckResp(status="healthy", peer_count=peer_count)
+
+    # ---- peer membership (reference gubernator.go:616-711) -----------------
+
+    def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Swap in a new peer set; wired fully by the daemon/peers layer."""
+        if self.picker is not None:
+            self.picker.set_peers(peers, self.local_info)
+
+
+class _LocalPeer:
+    """Self-peer shim for daemons running without a mesh."""
+
+    def __init__(self, info: PeerInfo):
+        self.info = PeerInfo(
+            grpc_address=info.grpc_address,
+            http_address=info.http_address,
+            data_center=info.data_center,
+            is_owner=True,
+        )

@@ -25,6 +25,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -34,3 +37,32 @@ def frozen_clock():
 
     with clock.freeze() as clk:
         yield clk
+
+
+class LoopThread:
+    """A dedicated asyncio event loop running on a background thread, so
+    long-lived async fixtures (the in-process cluster) span many tests
+    without pytest-asyncio."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout=30):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+@pytest.fixture(scope="module")
+def loop_thread():
+    lt = LoopThread()
+    yield lt
+    lt.stop()

@@ -1,0 +1,217 @@
+"""Service-level functional tests over a real in-process cluster: gRPC V1,
+HTTP/JSON gateway, routing to owners, health, metrics.
+
+Ports of the reference's single-node functional tests (functional_test.go:
+TestOverTheLimit :101, TestTokenBucket :160, TestLeakyBucket :476,
+TestMissingFields :855, TestHealthCheck :1544, TestGRPCGateway :1588) —
+black-box through real listeners, as SURVEY.md §4 prescribes.
+"""
+
+import json
+
+import grpc
+import pytest
+import requests
+
+from gubernator_tpu.api.types import Algorithm, Status, SECOND
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+from gubernator_tpu.utils import clock as uclock
+
+NUM_DAEMONS = 4
+
+
+@pytest.fixture(scope="module")
+def cluster(loop_thread):
+    c = loop_thread.run(Cluster.start(NUM_DAEMONS), timeout=120)
+    yield c
+    loop_thread.run(c.stop())
+
+
+def grpc_call(loop_thread, daemon, reqs, timeout=10):
+    async def call():
+        msg = pb.pb.GetRateLimitsReq()
+        for r in reqs:
+            msg.requests.append(pb.pb.RateLimitReq(**r))
+        return await daemon.client().get_rate_limits(msg, timeout=timeout)
+
+    return loop_thread.run(call())
+
+
+def test_over_the_limit(cluster, loop_thread):
+    peer = cluster.get_random_peer()
+    tests = [(1, Status.UNDER_LIMIT), (1, Status.UNDER_LIMIT), (1, Status.OVER_LIMIT)]
+    for i, (hits, want) in enumerate(tests):
+        resp = grpc_call(
+            loop_thread,
+            peer,
+            [
+                dict(
+                    name="test_over_limit",
+                    unique_key="account:1234",
+                    algorithm=Algorithm.TOKEN_BUCKET,
+                    duration=SECOND * 9999,
+                    limit=2,
+                    hits=hits,
+                )
+            ],
+        )
+        rl = resp.responses[0]
+        assert rl.error == ""
+        assert rl.status == int(want), f"case {i}"
+        assert rl.limit == 2
+
+
+def test_token_bucket_expiry_via_grpc(cluster, loop_thread):
+    with uclock.freeze() as clk:
+        peer = cluster.get_random_peer()
+        req = dict(
+            name="test_token_bucket_grpc",
+            unique_key="account:1234",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=100,
+            limit=2,
+            hits=1,
+        )
+        for want_rem in (1, 0):
+            rl = grpc_call(loop_thread, peer, [req]).responses[0]
+            assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, want_rem)
+        clk.advance(200)
+        rl = grpc_call(loop_thread, peer, [req]).responses[0]
+        assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 1)
+
+
+def test_leaky_bucket_via_grpc(cluster, loop_thread):
+    with uclock.freeze() as clk:
+        peer = cluster.peer_at(0)
+        req = dict(
+            name="test_leaky_grpc",
+            unique_key="account:1234",
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=SECOND * 30,
+            limit=10,
+            hits=1,
+        )
+        rl = grpc_call(loop_thread, peer, [req]).responses[0]
+        assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 9)
+        clk.advance(3000)  # exactly one token leaks back
+        req["hits"] = 0
+        rl = grpc_call(loop_thread, peer, [req]).responses[0]
+        assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 10)
+
+
+def test_requests_route_to_owner(cluster, loop_thread):
+    """Hits sent through different daemons count against one shared
+    bucket — the ring routes every request to the owner."""
+    name, key = "test_routing", "account:routed"
+    for i, d in enumerate(cluster.daemons):
+        rl = grpc_call(
+            loop_thread,
+            d,
+            [
+                dict(
+                    name=name,
+                    unique_key=key,
+                    duration=SECOND * 9999,
+                    limit=100,
+                    hits=10,
+                )
+            ],
+        ).responses[0]
+        assert rl.error == ""
+        assert rl.remaining == 100 - 10 * (i + 1)
+    owner = cluster.find_owning_daemon(name, key)
+    non_owners = cluster.list_non_owning_daemons(name, key)
+    assert len(non_owners) == NUM_DAEMONS - 1
+    # owner's engine saw all the traffic
+    assert owner.engine.metrics.requests >= 4
+
+
+def test_missing_fields_via_grpc(cluster, loop_thread):
+    peer = cluster.get_random_peer()
+    resp = grpc_call(
+        loop_thread,
+        peer,
+        [
+            dict(name="test_missing", hits=1, limit=5, duration=10_000),
+            dict(unique_key="account:1234", hits=1, limit=5, duration=10_000),
+        ],
+    )
+    assert resp.responses[0].error == "field 'unique_key' cannot be empty"
+    assert resp.responses[1].error == "field 'namespace' cannot be empty"
+
+
+def test_batch_too_large(cluster, loop_thread):
+    peer = cluster.get_random_peer()
+    reqs = [
+        dict(name="too_large", unique_key=f"k{i}", hits=1, limit=9999, duration=9999)
+        for i in range(1001)
+    ]
+    with pytest.raises(grpc.aio.AioRpcError) as ei:
+        grpc_call(loop_thread, peer, reqs)
+    assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+
+
+def test_health_check(cluster, loop_thread):
+    for d in cluster.daemons:
+        async def call(d=d):
+            return await d.client().health_check(pb.pb.HealthCheckReq(), timeout=5)
+
+        h = loop_thread.run(call())
+        assert h.status == "healthy"
+        assert h.peer_count == NUM_DAEMONS
+
+
+def test_grpc_gateway_json(cluster, loop_thread):
+    addr = cluster.get_random_peer().http_address
+    r = requests.get(f"http://{addr}/v1/HealthCheck", timeout=5)
+    assert r.status_code == 200
+    # snake_case pin (reference TestGRPCGateway)
+    assert "peer_count" in r.text
+    assert json.loads(r.text)["peer_count"] == NUM_DAEMONS
+
+    payload = {
+        "requests": [
+            {
+                "name": "test_gateway",
+                "unique_key": "account:1234",
+                "duration": 1000,
+                "hits": 1,
+                "limit": 10,
+            }
+        ]
+    }
+    r = requests.post(f"http://{addr}/v1/GetRateLimits", json=payload, timeout=5)
+    assert r.status_code == 200
+    body = r.json()
+    assert len(body["responses"]) == 1
+    assert body["responses"][0]["status"] == "UNDER_LIMIT"
+    assert body["responses"][0]["remaining"] == "9"
+    assert "reset_time" in body["responses"][0]
+
+
+def test_metrics_endpoint(cluster, loop_thread):
+    addr = cluster.peer_at(0).http_address
+    r = requests.get(f"http://{addr}/metrics", timeout=5)
+    assert r.status_code == 200
+    for name in (
+        "gubernator_getratelimit_counter",
+        "gubernator_func_duration",
+        "gubernator_concurrent_checks_counter",
+        "gubernator_grpc_request_counts",
+        "gubernator_cache_access_count",
+        "gubernator_cache_size",
+        "gubernator_over_limit_counter",
+    ):
+        assert name in r.text, name
+    # engine counters are bridged at scrape time, not stuck at zero
+    import re
+
+    m = re.search(r'gubernator_cache_access_count\{type="miss"\} (\d+)', r.text)
+    assert m and int(m.group(1)) > 0
+
+
+def test_healthz(cluster, loop_thread):
+    addr = cluster.peer_at(0).http_address
+    r = requests.get(f"http://{addr}/healthz", timeout=5)
+    assert r.status_code == 200 and r.text == "healthy"
