@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import contextlib
 import time
 
 import grpc
@@ -16,38 +17,45 @@ _GRPC_CODES = {
 }
 
 
+@contextlib.asynccontextmanager
+async def _instrumented(metrics, method: str):
+    """Per-RPC duration + success/failed counters (the reference's
+    GRPCStatsHandler role, grpc_stats.go:41-131). Counts every outcome:
+    any exception — ApiError-driven aborts included — is 'failed'."""
+    t0 = time.perf_counter()
+    try:
+        yield
+        metrics.grpc_request_counts.labels(method, "success").inc()
+    except BaseException:
+        metrics.grpc_request_counts.labels(method, "failed").inc()
+        raise
+    finally:
+        metrics.grpc_request_duration.labels(method).observe(time.perf_counter() - t0)
+
+
+async def _abort(context, e: ApiError):
+    await context.abort(_GRPC_CODES.get(e.grpc_code, grpc.StatusCode.INTERNAL), str(e))
+
+
 class V1Servicer:
     def __init__(self, svc: V1Service):
         self.svc = svc
 
     async def GetRateLimits(self, request, context):
-        m = self.svc.metrics
-        t0 = time.perf_counter()
-        try:
+        async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/GetRateLimits"):
             reqs = [pb.req_from_pb(r) for r in request.requests]
             try:
                 out = await self.svc.get_rate_limits(reqs)
             except ApiError as e:
-                m.grpc_request_counts.labels("/pb.gubernator.V1/GetRateLimits", "failed").inc()
-                await context.abort(
-                    _GRPC_CODES.get(e.grpc_code, grpc.StatusCode.INTERNAL), str(e)
-                )
+                await _abort(context, e)
             resp = pb.pb.GetRateLimitsResp()
             for r in out:
                 resp.responses.append(pb.resp_to_pb(r))
-            m.grpc_request_counts.labels("/pb.gubernator.V1/GetRateLimits", "success").inc()
             return resp
-        finally:
-            m.grpc_request_duration.labels("/pb.gubernator.V1/GetRateLimits").observe(
-                time.perf_counter() - t0
-            )
 
     async def HealthCheck(self, request, context):
-        h = await self.svc.health_check()
-        self.svc.metrics.grpc_request_counts.labels(
-            "/pb.gubernator.V1/HealthCheck", "success"
-        ).inc()
-        return pb.health_to_pb(h)
+        async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/HealthCheck"):
+            return pb.health_to_pb(await self.svc.health_check())
 
 
 class PeersV1Servicer:
@@ -55,20 +63,24 @@ class PeersV1Servicer:
         self.svc = svc
 
     async def GetPeerRateLimits(self, request, context):
-        try:
+        async with _instrumented(
+            self.svc.metrics, "/pb.gubernator.PeersV1/GetPeerRateLimits"
+        ):
             reqs = [pb.req_from_pb(r) for r in request.requests]
-            out = await self.svc.get_peer_rate_limits(reqs)
-        except ApiError as e:
-            await context.abort(
-                _GRPC_CODES.get(e.grpc_code, grpc.StatusCode.INTERNAL), str(e)
-            )
-        resp = pb.peers_pb.GetPeerRateLimitsResp()
-        for r in out:
-            resp.rate_limits.append(pb.resp_to_pb(r))
-        return resp
+            try:
+                out = await self.svc.get_peer_rate_limits(reqs)
+            except ApiError as e:
+                await _abort(context, e)
+            resp = pb.peers_pb.GetPeerRateLimitsResp()
+            for r in out:
+                resp.rate_limits.append(pb.resp_to_pb(r))
+            return resp
 
     async def UpdatePeerGlobals(self, request, context):
-        await self.svc.update_peer_globals(
-            [pb.global_from_pb(g) for g in request.globals]
-        )
-        return pb.peers_pb.UpdatePeerGlobalsResp()
+        async with _instrumented(
+            self.svc.metrics, "/pb.gubernator.PeersV1/UpdatePeerGlobals"
+        ):
+            await self.svc.update_peer_globals(
+                [pb.global_from_pb(g) for g in request.globals]
+            )
+            return pb.peers_pb.UpdatePeerGlobalsResp()

@@ -1,0 +1,196 @@
+"""GLOBAL behavior integration tests — the reference's consistency
+contract, verified by polling Prometheus metrics exactly the way the
+reference suite does (functional_test.go:1690-2149; SURVEY.md §3.3):
+
+- hits given to the owner produce broadcast only, no hit-update
+- hits on one non-owner produce exactly one hit-update to the owner and
+  one broadcast
+- after one sync interval every peer returns the same remaining
+"""
+
+import re
+import time
+
+import pytest
+import requests
+
+from gubernator_tpu.api.types import Algorithm, Behavior, Status, MINUTE
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig
+
+NUM_DAEMONS = 4
+LIMIT = 1000
+
+
+@pytest.fixture(scope="module")
+def cluster(loop_thread):
+    c = loop_thread.run(
+        Cluster.start(
+            NUM_DAEMONS,
+            behaviors=BehaviorConfig(global_sync_wait_s=0.1),
+        ),
+        timeout=120,
+    )
+    yield c
+    loop_thread.run(c.stop())
+
+
+def metric_value(daemon, sample: str) -> float:
+    """Fetch one sample value from a daemon's /metrics text. `sample` may
+    include a label selector, e.g. name{method="..."}."""
+    text = requests.get(f"http://{daemon.http_address}/metrics", timeout=5).text
+    pat = re.escape(sample) + r"(?:\{\})?" + r"\s+([0-9.e+-]+)"
+    m = re.search(pat, text)
+    return float(m.group(1)) if m else 0.0
+
+
+def wait_until(fn, timeout=3.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def wait_for_idle(cluster, timeout=3.0):
+    def idle():
+        for d in cluster.daemons:
+            if (
+                metric_value(d, "gubernator_global_queue_length") != 0
+                or metric_value(d, "gubernator_global_send_queue_length") != 0
+            ):
+                return False
+        return True
+
+    assert wait_until(idle, timeout), "cluster did not go idle"
+
+
+def send_hit(loop_thread, daemon, name, key, hits, behavior=Behavior.GLOBAL):
+    async def call():
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(
+            pb.pb.RateLimitReq(
+                name=name,
+                unique_key=key,
+                algorithm=Algorithm.TOKEN_BUCKET,
+                behavior=int(behavior),
+                duration=3 * MINUTE,
+                limit=LIMIT,
+                hits=hits,
+            )
+        )
+        return (await daemon.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+    return loop_thread.run(call())
+
+
+def snapshot_counters(cluster, sample):
+    return {d.grpc_address: metric_value(d, sample) for d in cluster.daemons}
+
+
+BCAST = "gubernator_broadcast_duration_count"
+SEND = "gubernator_global_send_duration_count"
+UPG = 'gubernator_grpc_request_duration_count{method="/pb.gubernator.PeersV1/UpdatePeerGlobals"}'
+
+
+def test_hits_on_owner_broadcast_only(cluster, loop_thread):
+    name, key = "test_global_owner", "account:gowner1"
+    owner = cluster.find_owning_daemon(name, key)
+    peers = cluster.list_non_owning_daemons(name, key)
+    wait_for_idle(cluster)
+
+    bcast0 = snapshot_counters(cluster, BCAST)
+    send0 = snapshot_counters(cluster, SEND)
+    upg0 = snapshot_counters(cluster, UPG)
+
+    rl = send_hit(loop_thread, owner, name, key, 1)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, LIMIT - 1)
+
+    # Exactly one broadcast from the owner...
+    assert wait_until(
+        lambda: metric_value(owner, BCAST) == bcast0[owner.grpc_address] + 1
+    ), "owner did not broadcast"
+    # ...and from nobody else; no hit-updates from anyone.
+    time.sleep(0.3)
+    for p in peers:
+        assert metric_value(p, BCAST) == bcast0[p.grpc_address], "non-owner broadcast"
+    for d in cluster.daemons:
+        assert metric_value(d, SEND) == send0[d.grpc_address], "unexpected hit-update"
+    # UpdatePeerGlobals called exactly once on each non-owner, never on owner.
+    for p in peers:
+        assert metric_value(p, UPG) == upg0[p.grpc_address] + 1
+    assert metric_value(owner, UPG) == upg0[owner.grpc_address]
+
+
+def test_hits_on_non_owner_one_update_one_broadcast(cluster, loop_thread):
+    name, key = "test_global_nonowner", "account:gno1"
+    owner = cluster.find_owning_daemon(name, key)
+    peers = cluster.list_non_owning_daemons(name, key)
+    hitter = peers[0]
+    wait_for_idle(cluster)
+
+    bcast0 = snapshot_counters(cluster, BCAST)
+    send0 = snapshot_counters(cluster, SEND)
+
+    rl = send_hit(loop_thread, hitter, name, key, 10)
+    # Served from the hitter's local replica (fresh bucket)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, LIMIT - 10)
+    assert rl.metadata["owner"] == owner.grpc_address
+
+    # Exactly one hit-update from the hitter to the owner...
+    assert wait_until(
+        lambda: metric_value(hitter, SEND) == send0[hitter.grpc_address] + 1
+    ), "hitter did not send hit-update"
+    # ...followed by one broadcast from the owner.
+    assert wait_until(
+        lambda: metric_value(owner, BCAST) == bcast0[owner.grpc_address] + 1
+    ), "owner did not broadcast"
+    time.sleep(0.3)
+    for d in cluster.daemons:
+        if d is not hitter:
+            assert metric_value(d, SEND) == send0[d.grpc_address]
+        if d is not owner:
+            assert metric_value(d, BCAST) == bcast0[d.grpc_address]
+
+
+def test_global_convergence_across_peers(cluster, loop_thread):
+    """After one sync interval every peer reports the same remaining
+    (reference functional_test.go:1815-1821)."""
+    name, key = "test_global_converge", "account:gconv1"
+    wait_for_idle(cluster)
+
+    total = 0
+    for i, d in enumerate(cluster.daemons):
+        send_hit(loop_thread, d, name, key, i + 1)
+        total += i + 1
+
+    def converged():
+        values = {
+            send_hit(loop_thread, d, name, key, 0).remaining
+            for d in cluster.daemons
+        }
+        return values == {LIMIT - total}
+
+    assert wait_until(converged, timeout=5.0), "peers did not converge"
+
+
+def test_global_over_limit_drains_on_owner(cluster, loop_thread):
+    """Relayed GLOBAL hits force DRAIN_OVER_LIMIT on the owner
+    (reference gubernator.go:510-512)."""
+    name, key = "test_global_drain", "account:gdrain1"
+    owner = cluster.find_owning_daemon(name, key)
+    hitter = cluster.list_non_owning_daemons(name, key)[0]
+    wait_for_idle(cluster)
+
+    # Overshoot the limit from a non-owner replica.
+    send_hit(loop_thread, hitter, name, key, LIMIT + 5)
+    # The replica's local answer was OVER_LIMIT (fresh bucket, hits>limit).
+    # After the hit-update reaches the owner, the owner's state is drained
+    # to zero (DRAIN_OVER_LIMIT forced on relayed GLOBAL hits).
+    def drained():
+        rl = send_hit(loop_thread, owner, name, key, 0)
+        return rl.remaining == 0
+
+    assert wait_until(drained, timeout=5.0), "owner did not drain"
