@@ -191,7 +191,20 @@ def test_grpc_gateway_json(cluster, loop_thread):
 
 
 def test_metrics_endpoint(cluster, loop_thread):
-    addr = cluster.peer_at(0).http_address
+    # Drive a key OWNED by daemon 0 so its engine counters are non-zero
+    # (ownership depends on the randomly bound ports, so search for one).
+    d0 = cluster.peer_at(0)
+    key = next(
+        f"acct:m{i}"
+        for i in range(1000)
+        if cluster.find_owning_daemon("test_metrics", f"acct:m{i}") is d0
+    )
+    grpc_call(
+        loop_thread,
+        d0,
+        [dict(name="test_metrics", unique_key=key, duration=60_000, limit=5, hits=1)],
+    )
+    addr = d0.http_address
     r = requests.get(f"http://{addr}/metrics", timeout=5)
     assert r.status_code == 200
     for name in (
