@@ -5,6 +5,13 @@ compatible: 512 virtual replicas per peer, replica hash =
 fnv1_64(str(i) + md5hex(grpc_address)), key hash = fnv1_64(hash_key),
 owner = first replica clockwise (binary search, wraparound). The hash
 function is pluggable (fnv1/fnv1a, reference config.go:421-443).
+
+Known (inherited) behavior: FNV-1 clusters keys that differ only in a
+short suffix — trailing bytes see few multiplications, so sequential
+keys ("acct:1".."acct:999") land in a narrow band of the ring and skew
+ownership badly. The reference's own distribution test tolerates ~±10%
+on well-spread keys. Pass hash_fn=fnv1a_64 (or xxhash) for better
+spread if drop-in ownership parity with reference clusters isn't needed.
 """
 
 from __future__ import annotations

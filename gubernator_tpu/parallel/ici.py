@@ -1,0 +1,242 @@
+"""GLOBAL behavior over ICI collectives: per-chip replicas + psum'd deltas.
+
+The TPU-native replacement for the reference globalManager's two gRPC
+legs (reference global.go:91-283; SURVEY.md §2.3 row 4). Within one pod,
+the "peers" are mesh devices:
+
+- Every device holds a full REPLICA of the GLOBAL counter table and
+  answers its share of requests locally (the reference's
+  getGlobalRateLimit replica path, gubernator.go:395-421), accumulating
+  each non-owned hit into a per-device `pending` delta table.
+- Each sync tick (GlobalSyncWait cadence, 100ms default) ONE jitted
+  collective step replaces both network legs: hit deltas flow to owner
+  shards via psum (the async-hits leg), owners apply them with drain
+  semantics (the GetPeerRateLimits apply), and the authoritative state
+  is rebroadcast to every replica via a second masked psum (the
+  UpdatePeerGlobals leg).
+
+Geometry: ICI tables use ways=1 (slot = group = hash mod N) so a key
+occupies the SAME slot on every device and the merge is pure per-slot
+arithmetic — no cross-device key matching. The trade-off is direct-mapped
+collision behavior (colliding keys evict each other); provision ≥4x
+headroom. Cross-device safety holds anyway: every merge is key-checked,
+so a slot whose replicas hold different keys never mixes their counters.
+
+Consistency contract preserved (validated in tests/test_mesh.py): hits
+on a replica appear on every other replica after one sync; owner hits
+need no delta leg; over-limit relays drain.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.api.types import Behavior
+from gubernator_tpu.models.bucket import FIXED_SHIFT
+from gubernator_tpu.ops.decide import _decide_impl
+from gubernator_tpu.ops.layout import RequestBatch, SlotTable
+
+AXIS = "owners"
+I64 = jnp.int64
+
+
+class IciState(NamedTuple):
+    """Per-device replica tables + pending hit deltas.
+
+    Every SlotTable leaf is stacked (D, N) and sharded on the device
+    axis; `pending` is (D, N) int64 hit deltas awaiting the next sync.
+    """
+
+    table: SlotTable
+    pending: jnp.ndarray
+
+
+def create_ici_state(mesh: Mesh, num_slots: int) -> IciState:
+    n_dev = mesh.devices.size
+    assert num_slots % n_dev == 0, "num_slots must divide by mesh size"
+    sharding = NamedSharding(mesh, P(AXIS))
+    table = SlotTable.create(num_slots, ways=1)
+    stacked = jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.broadcast_to(x[None], (n_dev,) + x.shape), sharding
+        ),
+        table,
+    )
+    pending = jax.device_put(
+        jnp.zeros((n_dev, num_slots), dtype=I64), sharding
+    )
+    return IciState(table=stacked, pending=pending)
+
+
+def _squeeze(tree):
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _unsqueeze(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def make_replica_decide(mesh: Mesh, num_slots: int):
+    """decide(state, batch, home, now): lane i is answered by device
+    home[i]'s replica (the node the request arrived at); non-owned GLOBAL
+    hits are accumulated into that device's pending deltas."""
+    n_dev = mesh.devices.size
+    slots_per = num_slots // n_dev
+
+    def local(state: IciState, batch: RequestBatch, home, now):
+        dev = jax.lax.axis_index(AXIS).astype(I64)
+        tbl = _squeeze(state.table)
+        pending = state.pending[0]
+
+        mine = batch.active & (home == dev)
+        local_batch = batch._replace(active=mine)
+        slot = batch.group.astype(I64)  # ways=1: slot == group
+
+        # If this request replaces a DIFFERENT key at its slot
+        # (direct-mapped eviction), the old key's un-synced pending hits
+        # must not be credited to the new key — drop them.
+        prev_other = (
+            mine
+            & tbl.used[slot]
+            & ((tbl.key_hi[slot] != batch.key_hi) | (tbl.key_lo[slot] != batch.key_lo))
+        )
+
+        tbl, out = _decide_impl(tbl, local_batch, now, ways=1)
+
+        evict_idx = jnp.where(prev_other, slot, num_slots)
+        pending = pending.at[evict_idx].set(0, mode="drop")
+
+        # Accumulate deltas for lanes I answered but do not own
+        # (reference globalManager.QueueHit, global.go:74-78).
+        owned = (slot // slots_per) == dev
+        is_global = (batch.behavior & int(Behavior.GLOBAL)) != 0
+        pend_mask = mine & ~owned & is_global & (batch.hits != 0)
+        idx = jnp.where(pend_mask, slot, num_slots)
+        pending = pending.at[idx].add(batch.hits, mode="drop")
+
+        out = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
+        return IciState(table=_unsqueeze(tbl), pending=pending[None]), out
+
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(), P(), P()),
+        out_specs=(P(AXIS), P()),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def decide_fn(state: IciState, batch: RequestBatch, home, now):
+        return sharded(
+            state, batch, jnp.asarray(home, I64), jnp.asarray(now, I64)
+        )
+
+    return decide_fn
+
+
+def make_sync_step(mesh: Mesh, num_slots: int):
+    """One collective sync tick: deltas -> owners -> authoritative apply ->
+    replica rebroadcast. Replaces both gRPC legs of the reference's
+    globalManager with ~20 psums over ICI."""
+    n_dev = mesh.devices.size
+    slots_per = num_slots // n_dev
+
+    def local(state: IciState, now):
+        dev = jax.lax.axis_index(AXIS).astype(I64)
+        t = _squeeze(state.table)
+        pending = state.pending[0]
+        psum = lambda x: jax.lax.psum(x, AXIS)  # noqa: E731
+
+        slot_ids = jnp.arange(num_slots, dtype=I64)
+        own = (slot_ids // slots_per) == dev
+        live = t.used & (t.expire_at >= now)
+
+        # Phase A: owner identity per slot (replicated after psum).
+        owner_live = psum((own & live).astype(I64)) > 0
+        owner_key_hi = psum(jnp.where(own & live, t.key_hi, 0))
+        owner_key_lo = psum(jnp.where(own & live, t.key_lo, 0))
+
+        # Phase B: deltas that match the owner's key (key-checked so a
+        # colliding replica entry never pollutes another key's counter).
+        key_match = live & (t.key_hi == owner_key_hi) & (t.key_lo == owner_key_lo)
+        inc_match = psum(jnp.where(key_match, pending, 0))
+
+        # Adoption: owner has no live entry but a replica does and has
+        # pending hits (the relayed request would have created the entry
+        # at the owner in the reference). Lowest device index wins.
+        cand = live & (pending != 0)
+        sel = jax.lax.pmin(jnp.where(cand, dev, n_dev), AXIS)
+        is_sel = cand & (dev == sel)
+        adopted_key_hi = psum(jnp.where(is_sel, t.key_hi, 0))
+        adopted_key_lo = psum(jnp.where(is_sel, t.key_lo, 0))
+        match2 = live & (t.key_hi == adopted_key_hi) & (t.key_lo == adopted_key_lo)
+        inc_adopt = psum(jnp.where(match2, pending, 0))
+        pending_sel = psum(jnp.where(is_sel, pending, 0))
+
+        def adopt(field):
+            return psum(jnp.where(is_sel, field.astype(I64), 0)).astype(field.dtype)
+
+        adopt_ok = sel < n_dev
+
+        # Merge my owned region: authoritative base + incoming deltas.
+        use_mine = owner_live
+        use_adopt = ~owner_live & adopt_ok
+
+        def merged(field_mine, field_adopted):
+            return jnp.where(
+                use_mine, field_mine, jnp.where(use_adopt, field_adopted, 0)
+            )
+
+        inc = jnp.where(
+            use_mine, inc_match, jnp.where(use_adopt, inc_adopt - pending_sel, 0)
+        )
+
+        base = {f: merged(getattr(t, f), adopt(getattr(t, f))) for f in t._fields}
+        base_used = jnp.where(use_mine, live, use_adopt)
+
+        # Apply deltas with drain semantics (relayed GLOBAL hits force
+        # DRAIN_OVER_LIMIT at the owner, reference gubernator.go:510-512).
+        is_leaky = base["algo"] == 1
+        rem = base["remaining"]
+        rem_tok = jnp.maximum(rem - inc, 0)
+        rem_lky = jnp.maximum(rem - (inc << FIXED_SHIFT), 0)
+        new_rem = jnp.where(base_used & (inc != 0), jnp.where(is_leaky, rem_lky, rem_tok), rem)
+
+        # Rebroadcast: each device contributes only its owned region; the
+        # psum IS the UpdatePeerGlobals fan-out.
+        def bcast(val):
+            out = psum(jnp.where(own & base_used, val.astype(I64), 0))
+            return out.astype(val.dtype)
+
+        new_table = SlotTable(
+            key_hi=bcast(base["key_hi"]),
+            key_lo=bcast(base["key_lo"]),
+            used=psum(jnp.where(own & base_used, 1, 0)) > 0,
+            algo=bcast(base["algo"]),
+            status=bcast(base["status"]),
+            limit=bcast(base["limit"]),
+            duration=bcast(base["duration"]),
+            remaining=bcast(jnp.where(base_used, new_rem, 0)),
+            stamp=bcast(base["stamp"]),
+            expire_at=bcast(base["expire_at"]),
+            invalid_at=bcast(base["invalid_at"]),
+            burst=bcast(base["burst"]),
+            lru=bcast(base["lru"]),
+        )
+        return IciState(
+            table=_unsqueeze(new_table), pending=jnp.zeros_like(pending)[None]
+        )
+
+    sharded = jax.shard_map(
+        local, mesh=mesh, in_specs=(P(AXIS), P()), out_specs=P(AXIS)
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def sync_fn(state: IciState, now):
+        return sharded(state, jnp.asarray(now, I64))
+
+    return sync_fn

@@ -1,0 +1,82 @@
+"""Owner-sharded decide over a device mesh.
+
+The TPU-native replacement for peer forwarding (SURVEY.md §2.3 row 1):
+instead of hashing keys to *hosts* and relaying batches over gRPC, the
+slot table is sharded across the devices of a jax.sharding.Mesh — each
+device owns a contiguous range of slot groups — and ONE jitted SPMD call
+decides the whole batch: every device masks the batch lanes whose group
+falls in its shard, runs the same decide kernel on its local table shard,
+and lane results are combined with a psum over the mesh axis (each lane
+is answered by exactly one owner device, so the sum is the answer).
+
+"Forwarding" therefore costs one replicated batch broadcast plus one
+(B,)-sized psum over ICI — no per-peer RPCs, no retries, no batching
+timers — while ownership semantics (exactly one authoritative counter
+per key) are identical to the reference's hash ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.ops.decide import _decide_impl
+from gubernator_tpu.ops.layout import DecideOutput, RequestBatch, SlotTable
+
+AXIS = "owners"
+
+
+def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices).reshape(-1), (axis,))
+
+
+def create_sharded_table(mesh: Mesh, num_groups: int, ways: int = 8) -> SlotTable:
+    """SlotTable sharded along the slot axis; contiguous groups per device
+    (num_groups must divide evenly by mesh size)."""
+    n_dev = mesh.devices.size
+    assert num_groups % n_dev == 0, "num_groups must be divisible by mesh size"
+    sharding = NamedSharding(mesh, P(AXIS))
+    table = SlotTable.create(num_groups, ways)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), table)
+
+
+def make_sharded_decide(mesh: Mesh, num_groups: int, ways: int = 8):
+    """Builds decide(table, batch, now) -> (table', DecideOutput) where the
+    table is sharded over `mesh` and the batch is replicated."""
+    n_dev = mesh.devices.size
+    groups_per = num_groups // n_dev
+
+    def local_decide(table: SlotTable, batch: RequestBatch, now):
+        dev = jax.lax.axis_index(AXIS)
+        g0 = dev.astype(jnp.int64) * groups_per
+        local_grp = batch.group.astype(jnp.int64) - g0
+        mine = (local_grp >= 0) & (local_grp < groups_per) & batch.active
+        local_batch = batch._replace(
+            group=jnp.where(mine, local_grp, 0).astype(batch.group.dtype),
+            active=mine,
+        )
+        table, out = _decide_impl(table, local_batch, now, ways=ways)
+        # Inactive lanes produce zeros, so a psum over owners yields each
+        # lane's single authoritative answer; scalar metrics sum naturally.
+        out = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
+        return table, out
+
+    sharded = jax.shard_map(
+        local_decide,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P()),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def decide_fn(table: SlotTable, batch: RequestBatch, now):
+        now = jnp.asarray(now, dtype=jnp.int64)
+        return sharded(table, batch, now)
+
+    return decide_fn

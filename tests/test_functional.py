@@ -193,11 +193,18 @@ def test_grpc_gateway_json(cluster, loop_thread):
 def test_metrics_endpoint(cluster, loop_thread):
     # Drive a key OWNED by daemon 0 so its engine counters are non-zero
     # (ownership depends on the randomly bound ports, so search for one).
+    # NOTE: keys must be well-spread — fnv1 clusters sequential suffixes
+    # into a narrow ring band (inherited reference hashing behavior).
+    import hashlib
+
     d0 = cluster.peer_at(0)
     key = next(
-        f"acct:m{i}"
-        for i in range(1000)
-        if cluster.find_owning_daemon("test_metrics", f"acct:m{i}") is d0
+        k
+        for k in (
+            "acct:" + hashlib.md5(str(i).encode()).hexdigest()[:12]
+            for i in range(4096)
+        )
+        if cluster.find_owning_daemon("test_metrics", k) is d0
     )
     grpc_call(
         loop_thread,
