@@ -1,0 +1,80 @@
+"""Load-generating client CLI: `python -m gubernator_tpu.cmd.cli`
+(reference cmd/gubernator-cli/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import string
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="gubernator-tpu client CLI")
+    p.add_argument("address", help="daemon gRPC address host:port")
+    p.add_argument("--rate", type=int, default=100, help="requests/s")
+    p.add_argument("--duration", type=float, default=5.0, help="seconds")
+    p.add_argument("--concurrency", type=int, default=10)
+    p.add_argument("--keys", type=int, default=100, help="unique key count")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--algorithm", type=int, default=0, choices=(0, 1))
+    p.add_argument("--behavior", type=int, default=0)
+    args = p.parse_args()
+
+    import grpc
+
+    from gubernator_tpu.service import pb
+    from gubernator_tpu.service.rpc import V1Stub
+
+    name = "cli_" + "".join(random.choices(string.ascii_lowercase, k=6))
+
+    async def run() -> None:
+        channel = grpc.aio.insecure_channel(args.address)
+        stub = V1Stub(channel)
+        stats = {"ok": 0, "over": 0, "err": 0}
+        deadline = time.monotonic() + args.duration
+        interval = args.concurrency / max(args.rate, 1)
+
+        async def worker():
+            while time.monotonic() < deadline:
+                msg = pb.pb.GetRateLimitsReq()
+                msg.requests.append(
+                    pb.pb.RateLimitReq(
+                        name=name,
+                        unique_key=f"key:{random.randrange(args.keys)}",
+                        algorithm=args.algorithm,
+                        behavior=args.behavior,
+                        duration=10_000,
+                        limit=args.limit,
+                        hits=1,
+                    )
+                )
+                try:
+                    resp = await stub.get_rate_limits(msg, timeout=5)
+                    r = resp.responses[0]
+                    if r.error:
+                        stats["err"] += 1
+                    elif r.status == 1:
+                        stats["over"] += 1
+                    else:
+                        stats["ok"] += 1
+                except Exception:
+                    stats["err"] += 1
+                await asyncio.sleep(interval)
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(worker() for _ in range(args.concurrency)))
+        dt = time.monotonic() - t0
+        total = sum(stats.values())
+        print(
+            f"{total} requests in {dt:.2f}s ({total / dt:.0f}/s): "
+            f"{stats['ok']} under, {stats['over']} over, {stats['err']} errors"
+        )
+        await channel.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

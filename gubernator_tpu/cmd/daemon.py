@@ -1,0 +1,49 @@
+"""Daemon entry point: `python -m gubernator_tpu.cmd.daemon [--config f]`
+(reference cmd/gubernator/main.go:41-100)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="gubernator-tpu daemon")
+    parser.add_argument("--config", default=None, help="KEY=VALUE config file")
+    parser.add_argument("--debug", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    from gubernator_tpu.utils.platform import honor_env_platforms
+
+    honor_env_platforms()
+
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.envconfig import setup_daemon_config
+
+    conf = setup_daemon_config(args.config)
+
+    async def run() -> None:
+        d = await Daemon.spawn(conf)
+        logging.info(
+            "gubernator-tpu listening: grpc=%s http=%s", d.grpc_address, d.http_address
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logging.info("shutting down")
+        await d.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Container healthcheck probe: `python -m gubernator_tpu.cmd.healthcheck`
+(reference cmd/healthcheck/main.go): GET /v1/HealthCheck, exit 0 iff
+healthy."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--url",
+        default=f"http://{os.environ.get('GUBER_HTTP_ADDRESS', '127.0.0.1:80')}/v1/HealthCheck",
+    )
+    args = p.parse_args()
+    try:
+        with urllib.request.urlopen(args.url, timeout=5) as resp:
+            body = json.loads(resp.read())
+    except Exception as e:
+        print(f"unhealthy: {e}", file=sys.stderr)
+        return 1
+    if body.get("status") != "healthy":
+        print(f"unhealthy: {body}", file=sys.stderr)
+        return 1
+    print("healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

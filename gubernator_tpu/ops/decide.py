@@ -326,6 +326,7 @@ def _decide_impl(table: SlotTable, batch: RequestBatch, now, *, ways: int):
         stamp=table.stamp[slot],
         expire_at=table.expire_at[slot],
         burst=table.burst[slot],
+        invalid_at=table.invalid_at[slot],
     )
     # Fresh lanes must not see stale values in arithmetic that could
     # overflow; zero them out (semantically they're ignored anyway).
@@ -370,7 +371,13 @@ def _decide_impl(table: SlotTable, batch: RequestBatch, now, *, ways: int):
         remaining=upd(table.remaining, new_state["remaining"]),
         stamp=upd(table.stamp, new_state["stamp"]),
         expire_at=upd(table.expire_at, new_state["expire_at"]),
-        invalid_at=upd(table.invalid_at, jnp.zeros_like(batch.key_hi)),
+        # The store's invalidation mark survives updates on a live entry
+        # (reference: algorithms never touch CacheItem.InvalidAt); fresh
+        # inserts and freed slots clear it.
+        invalid_at=upd(
+            table.invalid_at,
+            jnp.where(exists & ~freed, st["invalid_at"], jnp.zeros_like(batch.key_hi)),
+        ),
         burst=upd(table.burst, new_state["burst"]),
         lru=upd(table.lru, jnp.broadcast_to(now, idx.shape)),
     )
@@ -381,6 +388,7 @@ def _decide_impl(table: SlotTable, batch: RequestBatch, now, *, ways: int):
         limit=jnp.where(act, batch.limit, 0),
         remaining=jnp.where(act, resp["remaining"], 0),
         reset_time=jnp.where(act, resp["reset_time"], 0),
+        slot=idx,
         hits=jnp.sum(act & exists),
         misses=jnp.sum(act & ~exists),
         unexpired_evictions=jnp.sum(evicts_live),
@@ -398,6 +406,21 @@ def decide(table: SlotTable, batch: RequestBatch, now, ways: int = 8):
 def make_decide(ways: int = 8):
     """Returns a decide fn closed over `ways` (for engines/benchmarks)."""
     return functools.partial(decide, ways=ways)
+
+
+@jax.jit
+def gather_rows(table: SlotTable, slots):
+    """Post-decide row readback for the Store write-behind seam: returns
+    each slot's full state (padding slots index N -> zeros via clip+mask)."""
+    n = table.num_slots
+    safe = jnp.clip(slots, 0, n - 1)
+    valid = slots < n
+
+    def g(arr):
+        v = arr[safe]
+        return jnp.where(valid, v, jnp.zeros_like(v))
+
+    return SlotTable(*[g(getattr(table, f)) for f in SlotTable._fields])
 
 
 @functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))

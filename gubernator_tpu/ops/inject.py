@@ -37,6 +37,7 @@ class InjectBatch(NamedTuple):
     remaining: jnp.ndarray  # (B,) int64 (already Q44.20 for leaky)
     stamp: jnp.ndarray  # (B,) int64
     expire_at: jnp.ndarray  # (B,) int64
+    invalid_at: jnp.ndarray  # (B,) int64
     burst: jnp.ndarray  # (B,) int64
     active: jnp.ndarray  # (B,) bool
 
@@ -54,6 +55,7 @@ class InjectBatch(NamedTuple):
             remaining=i64(),
             stamp=i64(),
             expire_at=i64(),
+            invalid_at=i64(),
             burst=i64(),
             active=np.zeros((b,), dtype=bool),
         )
@@ -98,7 +100,7 @@ def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
         remaining=upd(table.remaining, items.remaining),
         stamp=upd(table.stamp, items.stamp),
         expire_at=upd(table.expire_at, items.expire_at),
-        invalid_at=upd(table.invalid_at, jnp.zeros_like(items.key_hi)),
+        invalid_at=upd(table.invalid_at, items.invalid_at),
         burst=upd(table.burst, items.burst),
         lru=upd(table.lru, jnp.broadcast_to(now, idx.shape)),
     )

@@ -138,6 +138,7 @@ class DecideOutput(NamedTuple):
     limit: jnp.ndarray  # (B,) int64
     remaining: jnp.ndarray  # (B,) int64
     reset_time: jnp.ndarray  # (B,) int64
+    slot: jnp.ndarray  # (B,) int64 slot each lane touched (N for padding)
     # metrics (scalars): cache hits, misses, unexpired evictions, over-limit
     hits: jnp.ndarray
     misses: jnp.ndarray

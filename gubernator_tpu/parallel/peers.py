@@ -44,10 +44,17 @@ _ERROR_TTL_S = 300.0  # reference: 5-minute TTL error cache
 class Peer:
     """Client handle for one peer (self included)."""
 
-    def __init__(self, info: PeerInfo, behaviors: BehaviorConfig, metrics=None):
+    def __init__(
+        self,
+        info: PeerInfo,
+        behaviors: BehaviorConfig,
+        metrics=None,
+        credentials=None,
+    ):
         self.info = info
         self.behaviors = behaviors
         self.metrics = metrics
+        self.credentials = credentials  # grpc.ChannelCredentials for mTLS
         self._channel: Optional[grpc.aio.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._queue: Optional[asyncio.Queue] = None
@@ -58,7 +65,13 @@ class Peer:
 
     def _ensure_stub(self) -> PeersV1Stub:
         if self._stub is None:
-            self._channel = grpc.aio.insecure_channel(self.info.grpc_address)
+            if self.credentials is not None:
+                creds, options = self.credentials
+                self._channel = grpc.aio.secure_channel(
+                    self.info.grpc_address, creds, options=options or None
+                )
+            else:
+                self._channel = grpc.aio.insecure_channel(self.info.grpc_address)
             self._stub = PeersV1Stub(self._channel)
         return self._stub
 
@@ -184,11 +197,27 @@ def _clone_exc(e: Exception) -> Exception:
 class PeerMesh:
     """PeerPicker + forwarder + membership (the V1Service seams)."""
 
-    def __init__(self, svc, behaviors: BehaviorConfig):
+    def __init__(
+        self,
+        svc,
+        behaviors: BehaviorConfig,
+        hash_name: str = "fnv1",
+        replicas: int = 512,
+        credentials=None,
+    ):
+        from gubernator_tpu.parallel.hash_ring import HASHES
+
+        if hash_name not in HASHES:
+            raise ValueError(
+                f"unknown peer picker hash {hash_name!r}; "
+                f"supported: {sorted(HASHES)}"
+            )
+        hash_fn = HASHES[hash_name]
         self.svc = svc
         self.behaviors = behaviors
-        self.local_ring = ReplicatedConsistentHash()
-        self.region_picker = RegionPicker()
+        self.credentials = credentials
+        self.local_ring = ReplicatedConsistentHash(hash_fn, replicas)
+        self.region_picker = RegionPicker(ReplicatedConsistentHash(hash_fn, replicas))
         self._all: Dict[str, Peer] = {}
         self._errors: List[tuple] = []  # (ts, message)
 
@@ -214,7 +243,12 @@ class PeerMesh:
                 existing.info = info
                 peer = existing
             else:
-                peer = Peer(info, self.behaviors, metrics=self.svc.metrics)
+                peer = Peer(
+                    info,
+                    self.behaviors,
+                    metrics=self.svc.metrics,
+                    credentials=self.credentials,
+                )
             keep[info.grpc_address] = peer
             if not info.data_center or info.data_center == local_info.data_center:
                 new_local.add(peer)
@@ -274,8 +308,26 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     """Attach the peer mesh + GLOBAL manager to a daemon's service."""
     from gubernator_tpu.parallel.global_sync import GlobalManager
 
+    conf = daemon.conf
     svc = daemon.svc
-    mesh = PeerMesh(svc, daemon.conf.behaviors)
+    credentials = None
+    if getattr(conf, "tls", None) is not None:
+        from gubernator_tpu.service.tls import (
+            client_channel_options,
+            client_credentials,
+        )
+
+        credentials = (
+            client_credentials(conf.tls, client_cert=True),
+            client_channel_options(conf.tls),
+        )
+    mesh = PeerMesh(
+        svc,
+        conf.behaviors,
+        hash_name=getattr(conf, "peer_picker_hash", "fnv1"),
+        replicas=getattr(conf, "hash_replicas", 512),
+        credentials=credentials,
+    )
     svc.picker = mesh
     svc.forwarder = mesh
-    svc.global_mgr = GlobalManager(svc, daemon.conf.behaviors, mode=global_mode)
+    svc.global_mgr = GlobalManager(svc, conf.behaviors, mode=global_mode)

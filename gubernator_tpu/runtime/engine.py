@@ -137,8 +137,12 @@ class DeviceEngine:
         self.cfg = config
         self.now_fn = now_fn
         self.metrics = EngineMetrics()
+        self.store = None  # optional Store plugin (gubernator_tpu.store)
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._key_strings: Dict[Tuple[int, int], str] = {}
+        # key -> invalid_at deadline; drives store re-fetch after a
+        # store-set invalidation (reference cache.go:35-47)
+        self._invalid_at: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()  # guards table swap (load/restore)
 
         dev = config.device
@@ -257,6 +261,25 @@ class DeviceEngine:
         cfg = self.cfg
         B = cfg.batch_size
 
+        # Read-through: consult the store for keys this process has never
+        # seen, or whose store-set invalid_at deadline has passed
+        # (reference algorithms.go:45-51 cache-miss path + cache.go:35-47
+        # invalidation contract, batched).
+        if self.store is not None and cfg.keep_key_strings:
+            fetched = []
+            for req, _ in items:
+                hi, lo = key_hash128(req.hash_key())
+                inv = self._invalid_at.get((hi, lo))
+                if (hi, lo) not in self._key_strings or (
+                    inv is not None and inv != 0 and inv < now
+                ):
+                    snap = self.store.get(req)
+                    if snap is not None:
+                        fetched.append(snap)
+                    self._invalid_at.pop((hi, lo), None)
+            if fetched:
+                self.inject_snapshots(fetched)
+
         asm = _WaveAssembler(RequestBatch.zeros, B)
         placements: List[Optional[Tuple[int, int]]] = []
 
@@ -273,7 +296,7 @@ class DeviceEngine:
                 placements.append(None)
                 continue
             asm.commit(w, grp)
-            placements.append((w, lane))
+            placements.append((w, lane, hi, lo))
         waves = asm.waves
 
         # Execute waves sequentially against the (donated) table.
@@ -305,10 +328,16 @@ class DeviceEngine:
             time.perf_counter() - t0,
         )
 
+        # Write-behind BEFORE resolving futures, so a caller that observed
+        # its response can rely on the store reflecting it (the reference's
+        # OnChange runs within the request, algorithms.go:149-153).
+        if self.store is not None:
+            self._store_write_behind(items, placements, outs)
+
         for (req, fut), place in zip(items, placements):
             if place is None:
                 continue  # already resolved (encode error)
-            w, lane = place
+            w, lane = place[0], place[1]
             st, rem, rst, lim = host[w][0], host[w][1], host[w][2], host[w][3]
             fut.set_result(
                 RateLimitResp(
@@ -319,6 +348,44 @@ class DeviceEngine:
                 )
             )
 
+    def _store_write_behind(self, items, placements, outs) -> None:
+        from gubernator_tpu.ops.decide import gather_rows
+        from gubernator_tpu.store.store import ItemSnapshot
+
+        rows = [gather_rows(self.table, o.slot) for o in outs]
+        rows = [jax.tree.map(np.asarray, r) for r in rows]
+        changes = []
+        for (req, _), place in zip(items, placements):
+            if place is None:
+                continue
+            w, lane, hi, lo = place
+            r = rows[w]
+            key = req.hash_key()
+            # Rows are gathered from the final post-all-waves table: a slot
+            # freed in an early wave may have been reused by a DIFFERENT
+            # key in a later wave of the same flush. Only rows still
+            # holding OUR key are writable; anything else means our entry
+            # is gone (RESET_REMAINING free or same-flush eviction).
+            if not bool(r.used[lane]) or int(r.key_hi[lane]) != hi or int(r.key_lo[lane]) != lo:
+                self.store.remove(key)
+                continue
+            changes.append(
+                ItemSnapshot(
+                    key=key,
+                    algorithm=int(r.algo[lane]),
+                    status=int(r.status[lane]),
+                    limit=int(r.limit[lane]),
+                    duration=int(r.duration[lane]),
+                    remaining=int(r.remaining[lane]),
+                    stamp=int(r.stamp[lane]),
+                    expire_at=int(r.expire_at[lane]),
+                    invalid_at=int(r.invalid_at[lane]),
+                    burst=int(r.burst[lane]),
+                )
+            )
+        if changes:
+            self.store.on_change(changes)
+
     # ---- direct state injection (AddCacheItem analog) ----------------------
 
     def inject_globals(self, globals_: Sequence) -> None:
@@ -327,35 +394,65 @@ class DeviceEngine:
         stamp=now, expire=status.reset_time, leaky burst=limit)."""
         from gubernator_tpu.api.types import Algorithm
         from gubernator_tpu.models.bucket import FIXED_SHIFT
+        from gubernator_tpu.store.store import ItemSnapshot
+
+        now = self.now_fn()
+        snaps = []
+        for g in globals_:
+            leaky = int(g.algorithm) == int(Algorithm.LEAKY_BUCKET)
+            snaps.append(
+                ItemSnapshot(
+                    key=g.key,
+                    algorithm=int(g.algorithm),
+                    status=int(g.status.status),
+                    limit=g.status.limit,
+                    duration=g.duration,
+                    remaining=(
+                        g.status.remaining << FIXED_SHIFT
+                        if leaky
+                        else g.status.remaining
+                    ),
+                    stamp=now,
+                    expire_at=g.status.reset_time,
+                    burst=g.status.limit if leaky else 0,
+                )
+            )
+        self.inject_snapshots(snaps)
+
+    def inject_snapshots(self, items: Sequence) -> None:
+        """Write raw per-key state rows into the table (Loader restore and
+        Store read-through feed; reference workers.go:537-580)."""
         from gubernator_tpu.ops.inject import InjectBatch, inject
 
-        if not globals_:
+        if not items:
             return
         now = self.now_fn()
         cfg = self.cfg
-        B = cfg.batch_size
 
-        asm = _WaveAssembler(InjectBatch.zeros, B)
-        for g in globals_:
-            hi, lo = key_hash128(g.key)
+        asm = _WaveAssembler(InjectBatch.zeros, cfg.batch_size)
+        for s in items:
+            hi, lo = key_hash128(s.key)
             if cfg.keep_key_strings:
-                self._key_strings[(hi, lo)] = g.key
+                self._key_strings[(hi, lo)] = s.key
+            inv = int(getattr(s, "invalid_at", 0))
+            if inv:
+                self._invalid_at[(hi, lo)] = inv
+            else:
+                self._invalid_at.pop((hi, lo), None)
             grp = group_of(lo, cfg.num_groups)
             ib, w, lane = asm.place(grp)
-            leaky = int(g.algorithm) == int(Algorithm.LEAKY_BUCKET)
             ib.key_hi[lane] = hi
             ib.key_lo[lane] = lo
             ib.group[lane] = grp
-            ib.algo[lane] = int(g.algorithm)
-            ib.status[lane] = int(g.status.status)
-            ib.limit[lane] = g.status.limit
-            ib.duration[lane] = g.duration
-            ib.remaining[lane] = (
-                g.status.remaining << FIXED_SHIFT if leaky else g.status.remaining
-            )
-            ib.stamp[lane] = now
-            ib.expire_at[lane] = g.status.reset_time
-            ib.burst[lane] = g.status.limit if leaky else 0
+            ib.algo[lane] = int(s.algorithm)
+            ib.status[lane] = int(s.status)
+            ib.limit[lane] = s.limit
+            ib.duration[lane] = s.duration
+            ib.remaining[lane] = s.remaining
+            ib.stamp[lane] = s.stamp
+            ib.expire_at[lane] = s.expire_at
+            ib.invalid_at[lane] = getattr(s, "invalid_at", 0)
+            ib.burst[lane] = s.burst
             ib.active[lane] = True
             asm.commit(w, grp)
 

@@ -52,6 +52,18 @@ class DaemonConfig:
     # "ici" (single-process multi-device collective mode)
     global_mode: str = "grpc"
 
+    # Discovery backend: static | dns | etcd | k8s | member-list
+    discovery: str = "static"
+    dns_fqdn: str = ""
+    dns_interval_s: float = 300.0
+
+    # Peer picker tuning (reference config.go:421-443)
+    peer_picker_hash: str = "fnv1"
+    hash_replicas: int = 512
+
+    # Optional TLS (service.tls.TlsConfig); None = plaintext
+    tls: Optional[object] = None
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine

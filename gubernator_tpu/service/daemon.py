@@ -63,20 +63,34 @@ class Daemon:
         self.grpc_server.add_generic_rpc_handlers(
             (rpc.v1_handler(V1Servicer(self.svc)), rpc.peers_handler(PeersV1Servicer(self.svc)))
         )
-        port = self.grpc_server.add_insecure_port(conf.grpc_listen_address)
         host = conf.grpc_listen_address.rsplit(":", 1)[0]
+        if conf.tls is not None:
+            from gubernator_tpu.service.tls import server_credentials, setup_tls
+
+            setup_tls(conf.tls, hosts=[host if host not in ("0.0.0.0", "::") else "localhost", "127.0.0.1"])
+            port = self.grpc_server.add_secure_port(
+                conf.grpc_listen_address, server_credentials(conf.tls)
+            )
+        else:
+            port = self.grpc_server.add_insecure_port(conf.grpc_listen_address)
         self.grpc_address = f"{host}:{port}"
         await self.grpc_server.start()
 
         # Local identity must be known before peers are set
         advertise = conf.advertise_address or self.grpc_address
 
-        # HTTP gateway + metrics (reference daemon.go:251-299)
+        # HTTP gateway + metrics (reference daemon.go:251-299); serves TLS
+        # with the same certs as the gRPC listener when configured.
         app = build_app(self.svc)
         self.http_runner = web.AppRunner(app)
         await self.http_runner.setup()
         hhost, hport = conf.http_listen_address.rsplit(":", 1)
-        site = web.TCPSite(self.http_runner, hhost, int(hport))
+        ssl_ctx = None
+        if conf.tls is not None:
+            from gubernator_tpu.service.tls import http_ssl_context
+
+            ssl_ctx = http_ssl_context(conf.tls)
+        site = web.TCPSite(self.http_runner, hhost, int(hport), ssl_context=ssl_ctx)
         await site.start()
         actual = site._server.sockets[0].getsockname()
         self.http_address = f"{hhost}:{actual[1]}"
@@ -93,10 +107,34 @@ class Daemon:
         from gubernator_tpu.parallel.peers import wire_peers
 
         wire_peers(self, global_mode=conf.global_mode)
-        if conf.peers:
-            self.set_peers(conf.peers)
+
+        # Discovery pool pushes membership through set_peers
+        # (reference daemon.go:208-243). Unknown/unavailable backends fail
+        # fast rather than silently serving as a cluster of one.
+        from gubernator_tpu.service.discovery import POOLS, DnsPool, StaticPool
+
+        self._pool = None
+        if conf.discovery == "dns":
+            if not conf.dns_fqdn:
+                raise ValueError("dns discovery requires GUBER_DNS_FQDN")
+            self._pool = DnsPool(
+                conf.dns_fqdn,
+                self.set_peers,
+                interval_s=conf.dns_interval_s,
+                own_address=advertise,
+            )
+        elif conf.discovery == "static":
+            if conf.peers:
+                self._pool = StaticPool(conf.peers, self.set_peers)
+        elif conf.discovery in POOLS:
+            # gated backends (etcd/k8s/member-list) raise a clear error
+            self._pool = POOLS[conf.discovery](on_update=self.set_peers)
+        else:
+            raise ValueError(f"unknown peer discovery type: {conf.discovery!r}")
 
     async def close(self) -> None:
+        if getattr(self, "_pool", None) is not None:
+            self._pool.close()
         if self.svc is not None and self.svc.global_mgr is not None:
             await self.svc.global_mgr.close()
         if self.svc is not None and self.svc.forwarder is not None:
@@ -118,7 +156,9 @@ class Daemon:
         local = self.svc.local_info
         normalized: List[PeerInfo] = []
         for p in peers:
-            is_self = p.grpc_address == local.grpc_address
+            # Self-detection: advertise-address equality, or a discovery
+            # backend that already marked this entry as us (DnsPool).
+            is_self = p.is_owner or p.grpc_address == local.grpc_address
             normalized.append(
                 PeerInfo(
                     grpc_address=p.grpc_address,
@@ -136,7 +176,17 @@ class Daemon:
 
     def client(self) -> rpc.V1Stub:
         if self._channel is None:
-            self._channel = grpc.aio.insecure_channel(self.grpc_address)
+            if self.conf.tls is not None:
+                from gubernator_tpu.service.tls import client_credentials
+
+                target = self.grpc_address.replace("0.0.0.0", "localhost")
+                self._channel = grpc.aio.secure_channel(
+                    target,
+                    client_credentials(self.conf.tls, client_cert=True),
+                    options=(("grpc.ssl_target_name_override", "localhost"),),
+                )
+            else:
+                self._channel = grpc.aio.insecure_channel(self.grpc_address)
         return rpc.V1Stub(self._channel)
 
     async def must_client(self) -> rpc.V1Stub:

@@ -1,0 +1,126 @@
+"""Peer discovery pools.
+
+The reference ships four backends (etcd lease+watch, kubernetes informer,
+SWIM gossip via memberlist, DNS polling — reference etcd.go,
+kubernetes.go, memberlist.go, dns.go), each of which pushes a full
+PeerInfo list through one callback into SetPeers (reference
+daemon.go:208-243). Same shape here:
+
+- StaticPool: fixed peer list (tests, config-driven clusters).
+- DnsPool: polls A/AAAA records via the stdlib resolver on an interval;
+  each address becomes a peer at fixed ports (reference dns.go:130-218).
+- EtcdPool / K8sPool / MemberListPool: gated — their client libraries
+  are not in this image; constructing one raises a clear error naming
+  the missing dependency. The watch/lease/gossip protocols are
+  documented seams for when the dependency is available.
+
+The JAX device mesh is static per process, so discovery governs the
+*host* layer only; a mesh reconfiguration is a restart/resharding event
+(SURVEY.md §2.3 membership row).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+from typing import Callable, List, Optional, Sequence
+
+from gubernator_tpu.api.types import PeerInfo
+
+OnUpdate = Callable[[List[PeerInfo]], None]
+
+
+class StaticPool:
+    """Immediately pushes a fixed peer list (the cluster fixture's path)."""
+
+    def __init__(self, peers: Sequence[PeerInfo], on_update: OnUpdate):
+        self._peers = list(peers)
+        on_update(self._peers)
+
+    def close(self) -> None:
+        pass
+
+
+class DnsPool:
+    """Resolves an FQDN on an interval; every address becomes a peer
+    (reference dns.go:130-218; fixed-port convention dns.go:187-195)."""
+
+    def __init__(
+        self,
+        fqdn: str,
+        on_update: OnUpdate,
+        grpc_port: int = 81,
+        http_port: int = 80,
+        interval_s: float = 300.0,
+        own_address: str = "",
+        resolver=None,
+    ):
+        self.fqdn = fqdn
+        self.on_update = on_update
+        self.grpc_port = grpc_port
+        self.http_port = http_port
+        self.interval_s = interval_s
+        self.own_address = own_address
+        self._resolver = resolver or self._system_resolve
+        self._task: Optional[asyncio.Task] = None
+        self._running = True
+        self._task = asyncio.ensure_future(self._poll())
+
+    @staticmethod
+    def _system_resolve(fqdn: str) -> List[str]:
+        infos = socket.getaddrinfo(fqdn, None, proto=socket.IPPROTO_TCP)
+        return sorted({i[4][0] for i in infos})
+
+    async def _poll(self) -> None:
+        loop = asyncio.get_running_loop()
+        while self._running:
+            try:
+                ips = await loop.run_in_executor(None, self._resolver, self.fqdn)
+                peers = [
+                    PeerInfo(
+                        grpc_address=f"{ip}:{self.grpc_port}",
+                        http_address=f"{ip}:{self.http_port}",
+                        # self-detection by advertise-address equality
+                        # (reference dns.go self marking)
+                        is_owner=f"{ip}:{self.grpc_port}" == self.own_address,
+                    )
+                    for ip in ips
+                ]
+                if peers:
+                    self.on_update(peers)
+            except Exception:
+                pass  # transient resolver failures: keep the old peer set
+            await asyncio.sleep(self.interval_s)
+
+    def close(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+
+
+def _gated(name: str, dep: str):
+    class _Gated:
+        def __init__(self, *a, **kw):
+            raise RuntimeError(
+                f"{name} discovery requires the '{dep}' package, which is "
+                f"not available in this environment. Use 'static' or 'dns' "
+                f"discovery, or install {dep}."
+            )
+
+    _Gated.__name__ = name
+    return _Gated
+
+
+# Gated backends (reference etcd.go:42-352, kubernetes.go:35-247,
+# memberlist.go:38-299): same OnUpdate contract once their deps exist.
+EtcdPool = _gated("EtcdPool", "etcd3")
+K8sPool = _gated("K8sPool", "kubernetes")
+MemberListPool = _gated("MemberListPool", "memberlist/SWIM")
+
+POOLS = {
+    "static": StaticPool,
+    "dns": DnsPool,
+    "etcd": EtcdPool,
+    "k8s": K8sPool,
+    "member-list": MemberListPool,
+}

@@ -1,0 +1,150 @@
+"""Environment-driven daemon configuration (reference config.go:270-479).
+
+Same model as the reference: an optional `--config file` of KEY=VALUE
+lines is injected into the environment first, then ~GUBER_* variables are
+read with defaults (reference config.go:268-283, 633-658). Library users
+skip this entirely and fill DaemonConfig directly.
+
+Duration values accept Go-style suffixes (ns/us/ms/s/m/h) like the
+reference's `500ms` / `500ns` examples in example.conf.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.service.tls import TlsConfig
+
+_DUR_RE = re.compile(r"([0-9.]+)(ns|us|µs|ms|s|m|h)")
+_DUR_SCALE = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+
+def parse_duration_s(v: str, default: float) -> float:
+    """Go-style duration string -> seconds."""
+    v = v.strip()
+    if not v:
+        return default
+    total, matched = 0.0, False
+    for m in _DUR_RE.finditer(v):
+        total += float(m.group(1)) * _DUR_SCALE[m.group(2)]
+        matched = True
+    if not matched:
+        try:
+            return float(v)
+        except ValueError:
+            return default
+    return total
+
+
+def load_config_file(path: str) -> None:
+    """Inject KEY=VALUE lines into the environment (values already set in
+    the env win, matching the reference's precedence)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            os.environ.setdefault(k.strip(), v.strip())
+
+
+def _env(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
+    if config_file:
+        load_config_file(config_file)
+
+    behaviors = BehaviorConfig(
+        batch_timeout_s=parse_duration_s(_env("GUBER_BATCH_TIMEOUT"), 0.5),
+        batch_wait_s=parse_duration_s(_env("GUBER_BATCH_WAIT"), 500e-6),
+        batch_limit=_env_int("GUBER_BATCH_LIMIT", 1000),
+        global_timeout_s=parse_duration_s(_env("GUBER_GLOBAL_TIMEOUT"), 0.5),
+        global_sync_wait_s=parse_duration_s(_env("GUBER_GLOBAL_SYNC_WAIT"), 0.1),
+        global_batch_limit=_env_int("GUBER_GLOBAL_BATCH_LIMIT", 1000),
+        global_peer_requests_concurrency=_env_int(
+            "GUBER_GLOBAL_PEER_REQUESTS_CONCURRENCY", 100
+        ),
+        force_global=_env_bool("GUBER_FORCE_GLOBAL"),
+    )
+
+    conf = DaemonConfig(
+        grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "127.0.0.1:81"),
+        http_listen_address=_env("GUBER_HTTP_ADDRESS", "127.0.0.1:80"),
+        advertise_address=_env("GUBER_ADVERTISE_ADDRESS", ""),
+        data_center=_env("GUBER_DATA_CENTER", ""),
+        cache_size=_env_int("GUBER_CACHE_SIZE", 50_000),
+        behaviors=behaviors,
+        global_mode=_env("GUBER_GLOBAL_MODE", "grpc"),
+    )
+
+    # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2
+    static = _env("GUBER_STATIC_PEERS")
+    if static:
+        peers: List[PeerInfo] = []
+        for part in static.split(","):
+            fields = part.split("|")
+            peers.append(
+                PeerInfo(
+                    grpc_address=fields[0],
+                    http_address=fields[1] if len(fields) > 1 else "",
+                    data_center=fields[2] if len(fields) > 2 else "",
+                )
+            )
+        conf.peers = peers
+
+    conf.discovery = _env("GUBER_PEER_DISCOVERY_TYPE", "static")
+    conf.dns_fqdn = _env("GUBER_DNS_FQDN", "")
+    conf.dns_interval_s = parse_duration_s(_env("GUBER_DNS_POLL_INTERVAL"), 300.0)
+
+    conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1")
+    conf.hash_replicas = _env_int("GUBER_REPLICATED_HASH_REPLICAS", 512)
+
+    tls = TlsConfig(
+        ca_file=_env("GUBER_TLS_CA"),
+        ca_key_file=_env("GUBER_TLS_CA_KEY"),
+        cert_file=_env("GUBER_TLS_CERT"),
+        key_file=_env("GUBER_TLS_KEY"),
+        auto_tls=_env_bool("GUBER_TLS_AUTO"),
+        client_auth_ca_file=_env("GUBER_TLS_CLIENT_AUTH_CA_CERT"),
+        client_auth={
+            "": "none",
+            "request": "request",
+            "require": "require",
+            "require-and-verify": "require",
+        }.get(_env("GUBER_TLS_CLIENT_AUTH"), "none"),
+        insecure_skip_verify=_env_bool("GUBER_TLS_INSECURE_SKIP_VERIFY"),
+    )
+    conf.tls = (
+        tls
+        if (tls.ca_file or tls.cert_file or tls.auto_tls)
+        else None
+    )
+    return conf

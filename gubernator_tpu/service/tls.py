@@ -1,0 +1,182 @@
+"""TLS for the gRPC and HTTP listeners (reference tls.go:46-443).
+
+Capabilities mirrored from the reference:
+- load CA / server cert / key from files or PEM blobs,
+- AutoTLS: generate a self-signed CA + server certificate on the fly,
+- client-auth (mTLS) modes, and client-side configs for dialing peers.
+
+Implementation uses the `cryptography` package for generation and
+ssl/grpc credentials for serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import ipaddress
+import ssl
+from typing import List, Optional, Tuple
+
+import grpc
+
+
+@dataclasses.dataclass
+class TlsConfig:
+    ca_file: str = ""
+    ca_key_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    ca_pem: bytes = b""
+    ca_key_pem: bytes = b""
+    cert_pem: bytes = b""
+    key_pem: bytes = b""
+    auto_tls: bool = False
+    # 'none' | 'request' | 'require' (reference client-auth modes)
+    client_auth: str = "none"
+    client_auth_ca_file: str = ""
+    client_auth_ca_pem: bytes = b""
+    insecure_skip_verify: bool = False
+    min_version: int = ssl.TLSVersion.TLSv1_2
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def generate_self_signed(
+    hosts: List[str],
+) -> Tuple[bytes, bytes, bytes, bytes]:
+    """AutoTLS: returns (ca_pem, ca_key_pem, cert_pem, key_pem)
+    (reference tls.go self-signed generation)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "gubernator-tpu AutoTLS CA")]
+    )
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    sans = []
+    for h in hosts:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hosts[0] if hosts else "localhost")])
+        )
+        .issuer_name(ca_name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    pem = serialization.Encoding.PEM
+    pk8 = serialization.PrivateFormat.TraditionalOpenSSL
+    noenc = serialization.NoEncryption()
+    return (
+        ca_cert.public_bytes(pem),
+        ca_key.private_bytes(pem, pk8, noenc),
+        cert.public_bytes(pem),
+        key.private_bytes(pem, pk8, noenc),
+    )
+
+
+def setup_tls(conf: TlsConfig, hosts: Optional[List[str]] = None) -> TlsConfig:
+    """Resolve files/AutoTLS into in-memory PEM blobs
+    (reference SetupTLS flow)."""
+    if conf.ca_file:
+        conf.ca_pem = _read(conf.ca_file)
+    if conf.ca_key_file:
+        conf.ca_key_pem = _read(conf.ca_key_file)
+    if conf.cert_file:
+        conf.cert_pem = _read(conf.cert_file)
+    if conf.key_file:
+        conf.key_pem = _read(conf.key_file)
+    if conf.client_auth_ca_file:
+        conf.client_auth_ca_pem = _read(conf.client_auth_ca_file)
+    if conf.auto_tls and not conf.cert_pem:
+        ca, ca_key, cert, key = generate_self_signed(hosts or ["localhost", "127.0.0.1"])
+        if not conf.ca_pem:
+            conf.ca_pem = ca
+            conf.ca_key_pem = ca_key
+        conf.cert_pem = cert
+        conf.key_pem = key
+    return conf
+
+
+def server_credentials(conf: TlsConfig) -> grpc.ServerCredentials:
+    require = conf.client_auth == "require"
+    # Client certs verify against a dedicated client-auth CA when set
+    # (reference GUBER_TLS_CLIENT_AUTH_CA_CERT), else the server CA.
+    client_ca = conf.client_auth_ca_pem or conf.ca_pem
+    return grpc.ssl_server_credentials(
+        [(conf.key_pem, conf.cert_pem)],
+        root_certificates=client_ca if conf.client_auth != "none" else None,
+        require_client_auth=require,
+    )
+
+
+def client_credentials(
+    conf: TlsConfig, client_cert: bool = False
+) -> grpc.ChannelCredentials:
+    return grpc.ssl_channel_credentials(
+        root_certificates=conf.ca_pem or None,
+        private_key=conf.key_pem if client_cert else None,
+        certificate_chain=conf.cert_pem if client_cert else None,
+    )
+
+
+def client_channel_options(conf: TlsConfig, host: str = "") -> tuple:
+    """Channel options for dialing with this config.
+
+    insecure_skip_verify note: grpc-python cannot disable chain
+    validation; the supported relaxation is overriding the expected
+    server name (covers the common self-signed/SAN-mismatch case). The
+    chain must still anchor at ca_pem or the system roots.
+    """
+    if conf.insecure_skip_verify:
+        return (("grpc.ssl_target_name_override", "localhost"),)
+    return ()
+
+
+def http_ssl_context(conf: TlsConfig) -> ssl.SSLContext:
+    """Server-side context for the aiohttp gateway listener."""
+    import tempfile
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = conf.min_version
+    with tempfile.NamedTemporaryFile(suffix=".pem") as cf, tempfile.NamedTemporaryFile(
+        suffix=".pem"
+    ) as kf:
+        cf.write(conf.cert_pem)
+        cf.flush()
+        kf.write(conf.key_pem)
+        kf.flush()
+        ctx.load_cert_chain(cf.name, kf.name)
+    if conf.client_auth == "require":
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(cadata=conf.ca_pem.decode())
+    return ctx

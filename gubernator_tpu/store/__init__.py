@@ -1,0 +1,19 @@
+from gubernator_tpu.store.store import (
+    Loader,
+    MemoryLoader,
+    MemoryStore,
+    Store,
+    attach_store,
+    load_engine,
+    save_engine,
+)
+
+__all__ = [
+    "Loader",
+    "MemoryLoader",
+    "MemoryStore",
+    "Store",
+    "attach_store",
+    "load_engine",
+    "save_engine",
+]

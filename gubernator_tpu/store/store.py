@@ -1,0 +1,162 @@
+"""Persistence seams: Loader (checkpoint/restore) and Store (durability).
+
+The reference defines two plugin interfaces (reference store.go:49-78):
+- Loader: bulk Load() at startup, Save() at shutdown — exactly
+  checkpoint/resume (SURVEY.md §5).
+- Store: OnChange after every update (write-behind) + Get on cache miss
+  (read-through) + Remove.
+
+TPU adaptation (SURVEY.md §7): hooks fire at *batch* granularity. After
+each decide batch the engine gathers the touched rows from the device
+(ops.decide.gather_rows — exact raw state, fixed-point leaky fraction
+included) and hands them to Store.on_change; read-through consults the
+store for keys this process has never seen before dispatching them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterable, List, Optional, Protocol
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq
+
+
+@dataclasses.dataclass
+class ItemSnapshot:
+    """One key's raw counter state — the portable form of a slot row
+    (the reference's CacheItem + bucket struct, store.go:29-43)."""
+
+    key: str  # hash_key (name + "_" + unique_key)
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    status: int = 0
+    limit: int = 0
+    duration: int = 0
+    remaining: int = 0  # raw: whole tokens (token) / Q44.20 (leaky)
+    stamp: int = 0  # created_at (token) / updated_at (leaky)
+    expire_at: int = 0
+    invalid_at: int = 0
+    burst: int = 0
+
+
+class Store(Protocol):
+    """Write-behind + read-through durability plugin
+    (reference store.go:49-65, batch-granular here)."""
+
+    def on_change(self, items: List[ItemSnapshot]) -> None: ...
+
+    def get(self, req: RateLimitReq) -> Optional[ItemSnapshot]: ...
+
+    def remove(self, key: str) -> None: ...
+
+
+class Loader(Protocol):
+    """Bulk checkpoint/restore plugin (reference store.go:69-78)."""
+
+    def load(self) -> Iterable[ItemSnapshot]: ...
+
+    def save(self, items: Iterable[ItemSnapshot]) -> None: ...
+
+
+class MemoryStore:
+    """Dict-backed Store (the reference's exported MockStore analog,
+    store.go:80-112) — usable in tests and as a template."""
+
+    def __init__(self):
+        self.data: Dict[str, ItemSnapshot] = {}
+        self.lock = threading.Lock()
+        self.get_calls = 0
+        self.change_calls = 0
+
+    def on_change(self, items: List[ItemSnapshot]) -> None:
+        with self.lock:
+            self.change_calls += 1
+            for it in items:
+                self.data[it.key] = dataclasses.replace(it)
+
+    def get(self, req: RateLimitReq) -> Optional[ItemSnapshot]:
+        with self.lock:
+            self.get_calls += 1
+            it = self.data.get(req.hash_key())
+            return dataclasses.replace(it) if it is not None else None
+
+    def remove(self, key: str) -> None:
+        with self.lock:
+            self.data.pop(key, None)
+
+
+class MemoryLoader:
+    """List-backed Loader (reference MockLoader analog, store.go:114-150)."""
+
+    def __init__(self, items: Optional[List[ItemSnapshot]] = None):
+        self.items: List[ItemSnapshot] = list(items or [])
+        self.called_load = 0
+        self.called_save = 0
+
+    def load(self) -> Iterable[ItemSnapshot]:
+        self.called_load += 1
+        return list(self.items)
+
+    def save(self, items: Iterable[ItemSnapshot]) -> None:
+        self.called_save += 1
+        self.items = list(items)
+
+
+# ---- engine glue -----------------------------------------------------------
+
+
+def snapshots_from_engine(engine) -> List[ItemSnapshot]:
+    """Drain the engine's table into portable snapshots (Loader.Save feed;
+    reference workers.go:451-534)."""
+    import numpy as np
+
+    snap = engine.snapshot()
+    keys = snap["key_strings"]
+    used = np.asarray(snap["used"])
+    out: List[ItemSnapshot] = []
+    idx = np.nonzero(used)[0]
+    for i in idx:
+        hi, lo = int(snap["key_hi"][i]), int(snap["key_lo"][i])
+        key = keys.get((hi, lo))
+        if key is None:
+            continue  # anonymous row (key dictionary disabled)
+        out.append(
+            ItemSnapshot(
+                key=key,
+                algorithm=int(snap["algo"][i]),
+                status=int(snap["status"][i]),
+                limit=int(snap["limit"][i]),
+                duration=int(snap["duration"][i]),
+                remaining=int(snap["remaining"][i]),
+                stamp=int(snap["stamp"][i]),
+                expire_at=int(snap["expire_at"][i]),
+                invalid_at=int(snap["invalid_at"][i]),
+                burst=int(snap["burst"][i]),
+            )
+        )
+    return out
+
+
+def save_engine(engine, loader: Loader) -> int:
+    items = snapshots_from_engine(engine)
+    loader.save(items)
+    return len(items)
+
+
+def load_engine(engine, loader: Loader) -> int:
+    """Stream loader items into the engine table before serving
+    (reference gubernator.go:138-148 -> workers.go:329-446)."""
+    items = list(loader.load())
+    engine.inject_snapshots(items)
+    return len(items)
+
+
+def attach_store(engine, store: Store) -> None:
+    """Enable read-through + write-behind on a DeviceEngine."""
+    if not engine.cfg.keep_key_strings:
+        raise ValueError(
+            "attach_store requires EngineConfig.keep_key_strings=True: the "
+            "read-through gate tracks known keys host-side; without it every "
+            "request would hit the store."
+        )
+    engine.store = store

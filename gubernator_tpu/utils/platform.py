@@ -1,0 +1,23 @@
+"""JAX platform-selection hygiene.
+
+In some images a sitecustomize hook imports jax at interpreter startup
+and overrides jax.config.jax_platforms (e.g. to "axon,cpu" for a
+tunneled TPU), ignoring the JAX_PLATFORMS the launching process set.
+Entry points call honor_env_platforms() so an operator's explicit
+JAX_PLATFORMS choice wins; when unset, whatever the environment
+configured (the TPU) is used untouched.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_env_platforms() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    import jax
+
+    if jax.config.jax_platforms != env:
+        jax.config.update("jax_platforms", env)

@@ -1,0 +1,81 @@
+"""CLI binaries smoke tests: cluster binary + healthcheck + client CLI,
+spawned as real subprocesses (the reference's cross-language test pattern,
+python/tests/test_client.py:25-60)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cluster_proc():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cluster", "-n", "2",
+         "--cache-size", "2048"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        cwd=REPO,
+        text=True,
+    )
+    line = ""
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if line.startswith("READY"):
+            break
+    else:
+        p.kill()
+        pytest.fail(f"cluster did not come up: {p.stderr.read()[:2000]}")
+    info = json.loads(line[len("READY "):])
+    yield p, info
+    p.send_signal(signal.SIGTERM)
+    try:
+        p.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        p.kill()
+
+
+def test_cluster_binary_serves(cluster_proc):
+    _, info = cluster_proc
+    r = requests.get(f"http://{info[0]['http']}/v1/HealthCheck", timeout=5)
+    assert r.status_code == 200
+    assert r.json()["peer_count"] == 2
+
+
+def test_healthcheck_binary(cluster_proc):
+    _, info = cluster_proc
+    out = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.healthcheck",
+         "--url", f"http://{info[0]['http']}/v1/HealthCheck"],
+        capture_output=True, text=True, cwd=REPO, timeout=30,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "healthy" in out.stdout
+
+
+def test_cli_load_generator(cluster_proc):
+    _, info = cluster_proc
+    out = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cli", info[0]["grpc"],
+         "--rate", "200", "--duration", "1.5", "--concurrency", "4",
+         "--keys", "10", "--limit", "50"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "requests in" in out.stdout
+    # should have produced at least some decisions
+    total = int(out.stdout.split(" ")[0])
+    assert total > 50
+    assert " 0 errors" in out.stdout

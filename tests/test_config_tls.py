@@ -1,0 +1,112 @@
+"""Env config parsing (reference config_test.go style) and TLS clusters
+(reference tls_test.go:73-343 style)."""
+
+import os
+
+import pytest
+
+from gubernator_tpu.api.types import Status
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.service.envconfig import parse_duration_s, setup_daemon_config
+from gubernator_tpu.service.tls import TlsConfig, generate_self_signed
+
+
+def test_parse_duration():
+    assert parse_duration_s("500ms", 0) == pytest.approx(0.5)
+    assert parse_duration_s("500ns", 0) == pytest.approx(5e-7)
+    assert parse_duration_s("1.5s", 0) == pytest.approx(1.5)
+    assert parse_duration_s("2m", 0) == pytest.approx(120)
+    assert parse_duration_s("1h30m", 0) == pytest.approx(5400)
+    assert parse_duration_s("", 0.25) == 0.25
+    assert parse_duration_s("0.75", 0) == 0.75  # bare number = seconds
+
+
+def test_setup_daemon_config_env(monkeypatch):
+    monkeypatch.setenv("GUBER_GRPC_ADDRESS", "127.0.0.1:9990")
+    monkeypatch.setenv("GUBER_HTTP_ADDRESS", "127.0.0.1:9980")
+    monkeypatch.setenv("GUBER_CACHE_SIZE", "10000")
+    monkeypatch.setenv("GUBER_DATA_CENTER", "dc-1")
+    monkeypatch.setenv("GUBER_BATCH_WAIT", "250us")
+    monkeypatch.setenv("GUBER_GLOBAL_SYNC_WAIT", "50ms")
+    monkeypatch.setenv("GUBER_BATCH_LIMIT", "500")
+    monkeypatch.setenv("GUBER_PEER_PICKER_HASH", "fnv1a")
+    monkeypatch.setenv(
+        "GUBER_STATIC_PEERS", "127.0.0.1:9990|127.0.0.1:9980|dc-1,127.0.0.1:9991||"
+    )
+    conf = setup_daemon_config()
+    assert conf.grpc_listen_address == "127.0.0.1:9990"
+    assert conf.cache_size == 10_000
+    assert conf.data_center == "dc-1"
+    assert conf.behaviors.batch_wait_s == pytest.approx(250e-6)
+    assert conf.behaviors.global_sync_wait_s == pytest.approx(0.05)
+    assert conf.behaviors.batch_limit == 500
+    assert conf.peer_picker_hash == "fnv1a"
+    assert len(conf.peers) == 2
+    assert conf.peers[0].data_center == "dc-1"
+    assert conf.tls is None
+
+
+def test_config_file_injection(tmp_path, monkeypatch):
+    f = tmp_path / "guber.conf"
+    f.write_text("GUBER_CACHE_SIZE=777\n# comment\nGUBER_DATA_CENTER=filedc\n")
+    monkeypatch.delenv("GUBER_CACHE_SIZE", raising=False)
+    monkeypatch.setenv("GUBER_DATA_CENTER", "envdc")  # env wins over file
+    conf = setup_daemon_config(str(f))
+    assert conf.cache_size == 777
+    assert conf.data_center == "envdc"
+    monkeypatch.delenv("GUBER_CACHE_SIZE", raising=False)
+
+
+def shared_tls():
+    """One CA + cert shared by every daemon in a TLS cluster."""
+    ca, ca_key, cert, key = generate_self_signed(["localhost", "127.0.0.1"])
+    return TlsConfig(
+        ca_pem=ca, ca_key_pem=ca_key, cert_pem=cert, key_pem=key,
+        client_auth="require",
+    )
+
+
+def test_tls_cluster_end_to_end(loop_thread):
+    """mTLS daemons: client and peer-to-peer forwarding both ride TLS."""
+    tls = shared_tls()
+
+    async def start():
+        c = Cluster()
+        for _ in range(3):
+            conf = DaemonConfig(
+                cache_size=4096, behaviors=BehaviorConfig(), tls=shared_tls_copy(tls)
+            )
+            from gubernator_tpu.service.daemon import Daemon
+
+            c.daemons.append(await Daemon.spawn(conf))
+        c.rewire()
+        return c
+
+    def shared_tls_copy(t):
+        import dataclasses
+
+        return dataclasses.replace(t)
+
+    c = loop_thread.run(start(), timeout=120)
+    try:
+        # Drive every daemon; a shared key must route (over TLS) to one owner
+        async def call(d, hits):
+            msg = pb.pb.GetRateLimitsReq()
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name="tls_test", unique_key="account:tls", duration=600_000,
+                    limit=100, hits=hits,
+                )
+            )
+            return (await d.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+        seen = []
+        for d in c.daemons:
+            rl = loop_thread.run(call(d, 10))
+            assert rl.error == ""
+            seen.append(rl.remaining)
+        assert seen == [90, 80, 70]
+    finally:
+        loop_thread.run(c.stop())

@@ -1,0 +1,191 @@
+"""Store/Loader seams: checkpoint round-trip, write-behind, read-through
+(ports of the reference's TestLoader/TestStore, store_test.go:76-127)."""
+
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, Status
+from gubernator_tpu.models.bucket import FIXED_SHIFT
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.store import (
+    MemoryLoader,
+    MemoryStore,
+    attach_store,
+    load_engine,
+    save_engine,
+)
+from gubernator_tpu.store.store import ItemSnapshot
+
+NOW = 1_753_700_000_000
+
+
+def new_engine(now):
+    clock = {"now": now}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 10, batch_size=32, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    eng._clock = clock
+    return eng
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+def test_loader_save_restore_roundtrip():
+    """Like the reference TestLoader: hits before shutdown are visible
+    after a restart through the Loader."""
+    eng = new_engine(NOW)
+    try:
+        eng.check_batch([mk(key="a", hits=3), mk(key="b", hits=7, algorithm=Algorithm.LEAKY_BUCKET)])
+        loader = MemoryLoader()
+        n = save_engine(eng, loader)
+        assert n == 2 and loader.called_save == 1
+    finally:
+        eng.close()
+
+    eng2 = new_engine(NOW + 10)
+    try:
+        assert load_engine(eng2, loader) == 2
+        rl = eng2.check_batch([mk(key="a", hits=0)])[0]
+        assert rl.remaining == 7
+        rl = eng2.check_batch([mk(key="b", hits=0, algorithm=Algorithm.LEAKY_BUCKET)])[0]
+        assert rl.remaining == 3
+    finally:
+        eng2.close()
+
+
+def test_loader_preserves_leaky_fraction():
+    eng = new_engine(NOW)
+    try:
+        eng.check_batch([mk(key="frac", algorithm=Algorithm.LEAKY_BUCKET, hits=3)])
+        eng._clock["now"] = NOW + 4500  # leak 1.5 tokens @ 3s/token
+        eng.check_batch([mk(key="frac", algorithm=Algorithm.LEAKY_BUCKET, hits=0, duration=30_000)])
+        loader = MemoryLoader()
+        save_engine(eng, loader)
+        item = next(i for i in loader.items if i.key == "t_frac")
+        # remaining is raw Q44.20: 7 + 1.5 tokens
+        assert item.remaining == (8 << FIXED_SHIFT) + (1 << (FIXED_SHIFT - 1))
+    finally:
+        eng.close()
+
+
+def test_store_write_behind_and_remove():
+    eng = new_engine(NOW)
+    store = MemoryStore()
+    attach_store(eng, store)
+    try:
+        eng.check_batch([mk(key="w", hits=4)])
+        assert store.data["t_w"].remaining == 6
+        assert store.data["t_w"].algorithm == Algorithm.TOKEN_BUCKET
+        eng.check_batch([mk(key="w", hits=1)])
+        assert store.data["t_w"].remaining == 5
+        # RESET_REMAINING frees the slot -> store.remove
+        eng.check_batch([mk(key="w", hits=0, behavior=Behavior.RESET_REMAINING)])
+        assert "t_w" not in store.data
+    finally:
+        eng.close()
+
+
+def test_store_read_through():
+    """A fresh engine consults the store for unknown keys
+    (reference TestStore first-hit path)."""
+    store = MemoryStore()
+    store.data["t_r"] = ItemSnapshot(
+        key="t_r",
+        algorithm=Algorithm.TOKEN_BUCKET,
+        limit=10,
+        duration=60_000,
+        remaining=2,
+        stamp=NOW - 1000,
+        expire_at=NOW + 59_000,
+    )
+    eng = new_engine(NOW)
+    attach_store(eng, store)
+    try:
+        rl = eng.check_batch([mk(key="r", hits=1)])[0]
+        # continues from the stored remaining=2, not a fresh bucket
+        assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 1)
+        assert store.get_calls >= 1
+    finally:
+        eng.close()
+
+
+def test_store_invalid_at_forces_refetch():
+    """InvalidAt lets the store force a re-fetch of authoritative state
+    (reference cache.go:35-47 invalidation contract)."""
+    store = MemoryStore()
+    store.data["t_i"] = ItemSnapshot(
+        key="t_i",
+        algorithm=Algorithm.TOKEN_BUCKET,
+        limit=10,
+        duration=60_000,
+        remaining=5,
+        stamp=NOW,
+        expire_at=NOW + 60_000,
+        invalid_at=NOW + 100,  # invalidate 100ms in
+    )
+    eng = new_engine(NOW)
+    attach_store(eng, store)
+    try:
+        rl = eng.check_batch([mk(key="i", hits=1)])[0]
+        assert rl.remaining == 4
+        # An external writer updates the store's authoritative copy.
+        store.data["t_i"] = ItemSnapshot(
+            key="t_i",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            limit=10,
+            duration=60_000,
+            remaining=2,
+            stamp=NOW,
+            expire_at=NOW + 60_000,
+            invalid_at=0,
+        )
+        gets_before = store.get_calls
+        # After invalid_at passes, the engine re-fetches instead of
+        # rebuilding a fresh bucket.
+        eng._clock["now"] = NOW + 500
+        rl = eng.check_batch([mk(key="i", hits=1)])[0]
+        assert store.get_calls > gets_before
+        assert rl.remaining == 1  # continues from the store's remaining=2
+    finally:
+        eng.close()
+
+
+def test_store_reset_then_reuse_same_flush_does_not_corrupt():
+    """A slot freed by RESET_REMAINING and reused by another key in the
+    same flush must not write the new key's counters under the old key."""
+    from gubernator_tpu.api.keys import group_of, key_hash128
+
+    # find two keys sharing a slot group (forces same-slot reuse pressure)
+    ng = 1 << 10
+    by_group = {}
+    pair = None
+    for i in range(100_000):
+        k = f"g{i}"
+        g = group_of(key_hash128("t_" + k)[1], ng)
+        if g in by_group and by_group[g] != k:
+            pair = (by_group[g], k)
+            break
+        by_group[g] = k
+    assert pair
+    ka, kb = pair
+
+    store = MemoryStore()
+    eng = new_engine(NOW)
+    attach_store(eng, store)
+    try:
+        eng.check_batch([mk(key=ka, hits=2)])
+        assert store.data[f"t_{ka}"].remaining == 8
+        # One flush: reset A (frees its slot), then B lands in the group.
+        eng.check_batch(
+            [mk(key=ka, hits=0, behavior=Behavior.RESET_REMAINING), mk(key=kb, hits=3)]
+        )
+        assert f"t_{ka}" not in store.data
+        assert store.data[f"t_{kb}"].remaining == 7
+    finally:
+        eng.close()
