@@ -26,6 +26,10 @@ import numpy as np
 
 
 def main() -> None:
+    from gubernator_tpu.utils.platform import honor_env_platforms
+
+    honor_env_platforms()
+
     import jax
 
     from gubernator_tpu.ops import SlotTable, decide, decide_scan

@@ -12,8 +12,10 @@ debugging); the device never sees strings.
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import List, Tuple
 
+import numpy as np
 import xxhash
 
 _M64 = (1 << 64) - 1
@@ -28,18 +30,55 @@ def _to_unsigned(v: int) -> int:
     return v & _M64
 
 
+# The in-process identity hash is swappable: the native C++ batch hasher
+# (gubernator_tpu.native, MurmurHash3 x64-128) when it builds, else
+# Python xxh3. Static per process, so hashes stay self-consistent.
+_native = None
+if os.environ.get("GUBER_DISABLE_NATIVE_HASH", "") not in ("1", "true"):
+    try:
+        from gubernator_tpu import native as _native_mod
+
+        _native = _native_mod if _native_mod.available() else None
+    except Exception:
+        _native = None
+
+
+def native_enabled() -> bool:
+    return _native is not None
+
+
 def key_hash128(hash_key: str) -> Tuple[int, int]:
     """128-bit identity of a rate-limit key, as two signed int64 halves.
 
     (0, 0) is reserved as the empty-slot sentinel; the astronomically
     unlikely all-zero digest is nudged.
     """
+    if _native is not None:
+        return _native.hash128(hash_key)
     d = xxhash.xxh3_128_intdigest(hash_key.encode("utf-8"))
     hi = (d >> 64) & _M64
     lo = d & _M64
     if hi == 0 and lo == 0:
         lo = 1
     return _to_signed(hi), _to_signed(lo)
+
+
+def key_hash128_batch(
+    keys: List[str], num_groups: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Batch form: (hi int64[n], lo int64[n], group int32[n]). One native
+    call when available; the assembler hot loop uses this."""
+    if _native is not None:
+        return _native.hash128_batch(keys, num_groups)
+    n = len(keys)
+    hi = np.empty(n, dtype=np.int64)
+    lo = np.empty(n, dtype=np.int64)
+    grp = np.empty(n, dtype=np.int32)
+    for i, k in enumerate(keys):
+        h, l = key_hash128(k)
+        hi[i], lo[i] = h, l
+        grp[i] = _to_unsigned(l) % num_groups
+    return hi, lo, grp
 
 
 def group_of(key_lo: int, num_groups: int) -> int:

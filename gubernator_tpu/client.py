@@ -1,0 +1,155 @@
+"""Client library (reference client.go:39-105 + python/ client package).
+
+The canonical way to talk to a gubernator-tpu daemon from Python:
+
+    async with GubernatorClient("localhost:1051") as c:
+        resp = await c.get_rate_limits([RateLimitReq(...)])
+
+or synchronously:
+
+    with SyncGubernatorClient("localhost:1051") as c:
+        resps = c.get_rate_limits([RateLimitReq(...)])
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import string
+import threading
+from typing import List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.api.types import (
+    HealthCheckResp,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+)
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.rpc import V1Stub
+from gubernator_tpu.utils import tracing
+
+
+def hash_key(name: str, unique_key: str) -> str:
+    """The canonical cache/ownership key (reference client.go:39-41)."""
+    return name + "_" + unique_key
+
+
+def random_string(n: int = 10, prefix: str = "") -> str:
+    """Test-data helper (reference client.go RandomString)."""
+    return prefix + "".join(random.choices(string.ascii_lowercase + string.digits, k=n))
+
+
+def random_peer(peers: Sequence[PeerInfo]) -> PeerInfo:
+    return random.choice(list(peers))
+
+
+def to_timestamp_ms(dt) -> int:
+    """datetime -> epoch ms (reference timestamp converters)."""
+    return int(dt.timestamp() * 1000)
+
+
+def from_timestamp_ms(ms: int):
+    import datetime
+
+    return datetime.datetime.fromtimestamp(ms / 1000.0, tz=datetime.timezone.utc)
+
+
+class GubernatorClient:
+    """Async gRPC client (reference DialV1Server, client.go:44-65)."""
+
+    def __init__(
+        self,
+        address: str,
+        tls=None,  # optional service.tls.TlsConfig
+        default_timeout: float = 10.0,
+    ):
+        self.address = address
+        self.default_timeout = default_timeout
+        if tls is not None:
+            from gubernator_tpu.service.tls import (
+                client_channel_options,
+                client_credentials,
+            )
+
+            self.channel = grpc.aio.secure_channel(
+                address,
+                client_credentials(tls, client_cert=bool(tls.cert_pem)),
+                options=client_channel_options(tls) or None,
+            )
+        else:
+            self.channel = grpc.aio.insecure_channel(address)
+        self.stub = V1Stub(self.channel)
+
+    async def __aenter__(self) -> "GubernatorClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+    async def get_rate_limits(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
+        msg = pb.pb.GetRateLimitsReq()
+        for r in reqs:
+            tracing.propagate_inject(r.metadata)
+            msg.requests.append(pb.req_to_pb(r))
+        resp = await self.stub.get_rate_limits(
+            msg, timeout=timeout or self.default_timeout
+        )
+        return [pb.resp_from_pb(r) for r in resp.responses]
+
+    async def health_check(self, timeout: Optional[float] = None) -> HealthCheckResp:
+        h = await self.stub.health_check(
+            pb.pb.HealthCheckReq(), timeout=timeout or self.default_timeout
+        )
+        return HealthCheckResp(status=h.status, message=h.message, peer_count=h.peer_count)
+
+
+class SyncGubernatorClient:
+    """Blocking facade over GubernatorClient (runs its own event loop
+    thread), for scripts and non-async applications."""
+
+    def __init__(self, address: str, tls=None, default_timeout: float = 10.0):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._client: GubernatorClient = self._call(
+            self._make(address, tls, default_timeout)
+        )
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    async def _make(self, address, tls, timeout) -> GubernatorClient:
+        return GubernatorClient(address, tls=tls, default_timeout=timeout)
+
+    def _call(self, coro, timeout: float = 30.0):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def __enter__(self) -> "SyncGubernatorClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def get_rate_limits(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
+        return self._call(self._client.get_rate_limits(reqs, timeout))
+
+    def health_check(self, timeout: Optional[float] = None) -> HealthCheckResp:
+        return self._call(self._client.health_check(timeout))
+
+    def close(self) -> None:
+        try:
+            self._call(self._client.close())
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)

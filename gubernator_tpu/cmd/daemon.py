@@ -10,15 +10,41 @@ import signal
 
 
 def main() -> None:
+    import json
+    import os
+
     parser = argparse.ArgumentParser(description="gubernator-tpu daemon")
     parser.add_argument("--config", default=None, help="KEY=VALUE config file")
     parser.add_argument("--debug", action="store_true")
     args = parser.parse_args()
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    # GUBER_LOG_LEVEL / GUBER_LOG_FORMAT=json (reference config.go:286-310)
+    level_name = os.environ.get("GUBER_LOG_LEVEL", "").upper()
+    level = (
+        logging.DEBUG
+        if args.debug
+        else getattr(logging, level_name, logging.INFO)
     )
+    if os.environ.get("GUBER_LOG_FORMAT", "").lower() == "json":
+
+        class _Json(logging.Formatter):
+            def format(self, record):
+                return json.dumps(
+                    {
+                        "ts": self.formatTime(record),
+                        "level": record.levelname.lower(),
+                        "logger": record.name,
+                        "msg": record.getMessage(),
+                    }
+                )
+
+        handler = logging.StreamHandler()
+        handler.setFormatter(_Json())
+        logging.basicConfig(level=level, handlers=[handler])
+    else:
+        logging.basicConfig(
+            level=level, format="%(asctime)s %(levelname)s %(name)s %(message)s"
+        )
 
     from gubernator_tpu.utils.platform import honor_env_platforms
 

@@ -37,6 +37,7 @@ from gubernator_tpu.parallel.region import RegionPicker
 from gubernator_tpu.service import pb
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.rpc import PeersV1Stub
+from gubernator_tpu.utils import tracing
 
 _ERROR_TTL_S = 300.0  # reference: 5-minute TTL error cache
 
@@ -106,6 +107,9 @@ class Peer:
         stub = self._ensure_stub()
         msg = pb.peers_pb.GetPeerRateLimitsReq()
         for r in reqs:
+            # Trace context rides inside each item's metadata
+            # (reference peer_client.go:358-360)
+            tracing.propagate_inject(r.metadata)
             msg.requests.append(pb.req_to_pb(r))
         resp = await stub.get_peer_rate_limits(
             msg, timeout=timeout or self.behaviors.batch_timeout_s
@@ -132,24 +136,32 @@ class Peer:
     async def _run_batch(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._closed:
-            item = await self._queue.get()
-            if item is None:
-                break
-            batch = [item]
-            deadline = loop.time() + self.behaviors.batch_wait_s
-            while len(batch) < self.behaviors.batch_limit:
-                remaining = deadline - loop.time()
-                if remaining <= 0:
+            batch = []
+            try:
+                item = await self._queue.get()
+                if item is None:
                     break
-                try:
-                    nxt = await asyncio.wait_for(self._queue.get(), remaining)
-                except asyncio.TimeoutError:
-                    break
-                if nxt is None:
-                    self._closed = True
-                    break
-                batch.append(nxt)
-            await self._send_batch([b for b in batch if b is not None])
+                batch = [item]
+                deadline = loop.time() + self.behaviors.batch_wait_s
+                while len(batch) < self.behaviors.batch_limit:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = await asyncio.wait_for(self._queue.get(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+                    if nxt is None:
+                        self._closed = True
+                        break
+                    batch.append(nxt)
+                await self._send_batch([b for b in batch if b is not None])
+            except asyncio.CancelledError:
+                # Pump cancelled mid-batch (shutdown): fail, don't drop.
+                for b in batch:
+                    if b is not None and not b[1].done():
+                        b[1].set_exception(RuntimeError("peer client shutdown"))
+                raise
 
     async def _send_batch(self, batch) -> None:
         if not batch:

@@ -32,7 +32,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from gubernator_tpu.api.keys import group_of, key_hash128
+from gubernator_tpu.api.keys import group_of, key_hash128, key_hash128_batch
 from gubernator_tpu.api.types import (
     Behavior,
     RateLimitReq,
@@ -261,14 +261,20 @@ class DeviceEngine:
         cfg = self.cfg
         B = cfg.batch_size
 
+        # One native batch-hash call for the whole flush (assembler hot
+        # loop; gubernator_tpu.native).
+        hashes = key_hash128_batch(
+            [req.hash_key() for req, _ in items], cfg.num_groups
+        )
+
         # Read-through: consult the store for keys this process has never
         # seen, or whose store-set invalid_at deadline has passed
         # (reference algorithms.go:45-51 cache-miss path + cache.go:35-47
         # invalidation contract, batched).
         if self.store is not None and cfg.keep_key_strings:
             fetched = []
-            for req, _ in items:
-                hi, lo = key_hash128(req.hash_key())
+            for i, (req, _) in enumerate(items):
+                hi, lo = int(hashes[0][i]), int(hashes[1][i])
                 inv = self._invalid_at.get((hi, lo))
                 if (hi, lo) not in self._key_strings or (
                     inv is not None and inv != 0 and inv < now
@@ -283,11 +289,11 @@ class DeviceEngine:
         asm = _WaveAssembler(RequestBatch.zeros, B)
         placements: List[Optional[Tuple[int, int]]] = []
 
-        for req, fut in items:
-            hi, lo = key_hash128(req.hash_key())
+        for i, (req, fut) in enumerate(items):
+            hi, lo = int(hashes[0][i]), int(hashes[1][i])
             if cfg.keep_key_strings:
                 self._key_strings[(hi, lo)] = req.hash_key()
-            grp = group_of(lo, cfg.num_groups)
+            grp = int(hashes[2][i])
             wb, w, lane = asm.place(grp)
             try:
                 encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))

@@ -64,6 +64,10 @@ class DaemonConfig:
     # Optional TLS (service.tls.TlsConfig); None = plaintext
     tls: Optional[object] = None
 
+    # Optional OS/runtime Prometheus collectors: ["os", "golang"]
+    # (reference flags.go:19-57; 'golang' maps to the Python runtime)
+    metric_flags: List[str] = dataclasses.field(default_factory=list)
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine

@@ -52,6 +52,18 @@ class Daemon:
 
         metrics.add_sync(engine_sync(self.engine))
 
+        # Optional OS/runtime collectors (reference daemon.go:276-287)
+        flags = getattr(conf, "metric_flags", [])
+        if "os" in flags:
+            from prometheus_client import ProcessCollector
+
+            ProcessCollector(registry=metrics.registry)
+        if "golang" in flags:  # runtime collectors; Python GC here
+            from prometheus_client import GCCollector, PlatformCollector
+
+            PlatformCollector(registry=metrics.registry)
+            GCCollector(registry=metrics.registry)
+
         self.svc = V1Service(
             self.engine,
             metrics=metrics,

@@ -127,6 +127,12 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1")
     conf.hash_replicas = _env_int("GUBER_REPLICATED_HASH_REPLICAS", 512)
 
+    # Optional process/runtime collectors (reference flags.go:19-57,
+    # GUBER_METRIC_FLAGS=os,golang; 'golang' maps to Python runtime/GC)
+    conf.metric_flags = [
+        f.strip() for f in _env("GUBER_METRIC_FLAGS").split(",") if f.strip()
+    ]
+
     tls = TlsConfig(
         ca_file=_env("GUBER_TLS_CA"),
         ca_key_file=_env("GUBER_TLS_CA_KEY"),

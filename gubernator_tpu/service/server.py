@@ -185,15 +185,24 @@ class V1Service:
                 f"'PeerRequest.rate_limits' list too large; max size is '{MAX_BATCH_SIZE}'",
                 grpc_code="OUT_OF_RANGE",
             )
+        from gubernator_tpu.utils import tracing
+
         futs = []
         for req in reqs:
+            # Extract the forwarding peer's trace context from the item's
+            # metadata (reference gubernator.go:503-504).
+            ctx = tracing.propagate_extract(req.metadata)
             if has_behavior(req.behavior, Behavior.GLOBAL):
                 # Owner handling a relayed GLOBAL hit always drains
                 # (reference gubernator.go:510-512) and queues a broadcast.
                 req.behavior |= Behavior.DRAIN_OVER_LIMIT
             if req.created_at is None or req.created_at == 0:
                 req.created_at = self.now_fn()
-            futs.append(asyncio.wrap_future(self.engine.check_async(req)))
+            with tracing.attached(ctx):
+                with tracing.span(
+                    "V1Instance.getLocalRateLimit", key=req.hash_key()
+                ):
+                    futs.append(asyncio.wrap_future(self.engine.check_async(req)))
             if self.global_mgr is not None and has_behavior(req.behavior, Behavior.GLOBAL):
                 self.global_mgr.queue_update(req)
         out = []

@@ -1,0 +1,31 @@
+"""Re-armable one-shot ticker (reference interval.go:29-72).
+
+`next()` arms the timer; `wait()` resolves one interval after the most
+recent arm. Multiple arms before a tick coalesce, exactly like the
+reference's channel-based Interval. Used by batch-flush loops that only
+want a tick when there is pending work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+
+class Interval:
+    def __init__(self, duration_s: float):
+        self.duration_s = duration_s
+        self._armed = asyncio.Event()
+
+    def next(self) -> None:
+        """Arm the next tick; redundant arms before the tick coalesce."""
+        self._armed.set()
+
+    async def wait(self) -> None:
+        """Block until one duration after an arm."""
+        await self._armed.wait()
+        self._armed.clear()
+        await asyncio.sleep(self.duration_s)
+
+    def stop(self) -> None:
+        self._armed.set()  # release any waiter; caller stops looping

@@ -1,0 +1,46 @@
+"""Network utilities (reference net.go:28-122)."""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Tuple
+
+
+def split_host_port(address: str) -> Tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host, int(port)
+
+
+def discover_ip() -> str:
+    """A non-loopback interface IP usable as an advertise address."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # No packets are sent; this just selects a route.
+        s.connect(("198.51.100.1", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def resolve_host_ip(address: str) -> str:
+    """Expand a wildcard listen address (0.0.0.0 / ::) into a concrete
+    interface IP for advertising (reference ResolveHostIP, net.go:28)."""
+    host, port = split_host_port(address)
+    if host in ("0.0.0.0", "::", ""):
+        return f"{discover_ip()}:{port}"
+    return address
+
+
+def local_addresses() -> List[str]:
+    """All local interface addresses (for TLS SANs, reference net.go:86)."""
+    out = {"127.0.0.1", "localhost"}
+    try:
+        hostname = socket.gethostname()
+        out.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            out.add(info[4][0])
+    except OSError:
+        pass
+    return sorted(out)

@@ -1,0 +1,81 @@
+"""Tracing: OpenTelemetry spans + cross-peer context propagation.
+
+The reference instruments every significant function with OTel spans and
+rides trace context across peers inside each rate limit's metadata map
+via a TextMapCarrier (reference metadata_carrier.go:19-40,
+peer_client.go:358-360 inject, gubernator.go:503-504 extract). Same
+model here:
+
+- The OTel *API* is used for spans; without an SDK configured they are
+  no-ops (the reference similarly only exports when OTEL_* env vars
+  configure an exporter, docs/tracing.md:10-41).
+- propagate_inject/extract move W3C traceparent through the request's
+  metadata dict, so spans stitch across the peer-forwarding hop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+try:
+    from opentelemetry import context as _otel_context
+    from opentelemetry import trace as _otel_trace
+    from opentelemetry.propagate import extract as _extract
+    from opentelemetry.propagate import inject as _inject
+
+    _TRACER = _otel_trace.get_tracer("gubernator_tpu")
+    _OTEL = True
+except Exception:  # pragma: no cover - otel not installed
+    _OTEL = False
+    _TRACER = None
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """Named scope (the reference's tracing.StartNamedScope analog)."""
+    if not _OTEL:
+        yield None
+        return
+    with _TRACER.start_as_current_span(name) as s:
+        for k, v in attributes.items():
+            try:
+                s.set_attribute(k, v)
+            except Exception:
+                pass
+        yield s
+
+
+def propagate_inject(metadata: Dict[str, str]) -> Dict[str, str]:
+    """Inject current trace context into a rate limit's metadata map
+    (reference MetadataCarrier inject side)."""
+    if _OTEL:
+        try:
+            _inject(metadata)
+        except Exception:
+            pass
+    return metadata
+
+
+def propagate_extract(metadata: Dict[str, str]):
+    """Extract trace context from a forwarded rate limit's metadata
+    (reference MetadataCarrier extract side). Returns an attachable
+    context or None."""
+    if not _OTEL or not metadata:
+        return None
+    try:
+        return _extract(metadata)
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def attached(ctx):
+    if not _OTEL or ctx is None:
+        yield
+        return
+    token = _otel_context.attach(ctx)
+    try:
+        yield
+    finally:
+        _otel_context.detach(token)

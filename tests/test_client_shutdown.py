@@ -1,0 +1,101 @@
+"""Client library + concurrency/shutdown behavior (reference
+peer_client_test.go:33-103 hammer-during-shutdown pattern)."""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, PeerInfo, RateLimitReq, Status
+from gubernator_tpu.client import (
+    GubernatorClient,
+    SyncGubernatorClient,
+    hash_key,
+    random_string,
+)
+from gubernator_tpu.cluster import Cluster
+
+NUM = 2
+
+
+@pytest.fixture(scope="module")
+def cluster(loop_thread):
+    c = loop_thread.run(Cluster.start(NUM), timeout=120)
+    yield c
+    loop_thread.run(c.stop())
+
+
+def test_hash_key_convention():
+    assert hash_key("requests_per_sec", "account:1234") == "requests_per_sec_account:1234"
+    assert len(random_string(12)) == 12
+
+
+def test_async_client(cluster, loop_thread):
+    async def run():
+        async with GubernatorClient(cluster.peer_at(0).grpc_address) as c:
+            rls = await c.get_rate_limits(
+                [
+                    RateLimitReq(
+                        name="client_lib", unique_key="k1", duration=60_000,
+                        limit=5, hits=2,
+                    )
+                ]
+            )
+            h = await c.health_check()
+            return rls, h
+
+    rls, h = loop_thread.run(run())
+    assert (rls[0].status, rls[0].remaining) == (Status.UNDER_LIMIT, 3)
+    assert h.status == "healthy" and h.peer_count == NUM
+
+
+def test_sync_client(cluster):
+    with SyncGubernatorClient(cluster.peer_at(1).grpc_address) as c:
+        rls = c.get_rate_limits(
+            [
+                RateLimitReq(
+                    name="client_lib_sync", unique_key="k1", duration=60_000,
+                    limit=5, hits=1,
+                )
+            ]
+        )
+        assert rls[0].remaining == 4
+        assert c.health_check().peer_count == NUM
+
+
+def test_peer_shutdown_under_load(cluster, loop_thread):
+    """Hammer a Peer handle with concurrent requests while shutting it
+    down: every request must resolve (result or error), never hang
+    (reference peer_client_test.go TestPeerClientShutdown)."""
+
+    async def run():
+        from gubernator_tpu.parallel.peers import Peer
+        from gubernator_tpu.service.config import BehaviorConfig
+
+        target = cluster.peer_at(0)
+        for behavior in (0, Behavior.NO_BATCHING):
+            peer = Peer(
+                PeerInfo(grpc_address=target.grpc_address),
+                BehaviorConfig(batch_wait_s=0.002),
+            )
+
+            async def hammer(i):
+                try:
+                    return await peer.get_peer_rate_limit(
+                        RateLimitReq(
+                            name="shutdown_race", unique_key=f"k{i}",
+                            behavior=behavior, duration=60_000, limit=100, hits=1,
+                        )
+                    )
+                except BaseException as e:  # noqa: BLE001 - must not hang
+                    return e
+
+            tasks = [asyncio.ensure_future(hammer(i)) for i in range(50)]
+            await asyncio.sleep(0.001)  # let some land in the queue
+            await peer.shutdown()
+            results = await asyncio.wait_for(
+                asyncio.gather(*tasks, return_exceptions=True), timeout=10
+            )
+            assert len(results) == 50  # nothing hung
+        return True
+
+    assert loop_thread.run(run(), timeout=60)

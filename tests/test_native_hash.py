@@ -1,0 +1,109 @@
+"""Native C++ batch hasher: build, correctness vs reference murmur3
+implementation, batch/single consistency, and fallback behavior."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import native
+from gubernator_tpu.api import keys
+
+
+def test_native_builds_and_loads():
+    assert native.available(), "native hasher failed to build/load"
+
+
+def test_single_vs_batch_consistency():
+    if not native.available():
+        pytest.skip("native unavailable")
+    ks = [f"t_acct:{i}" for i in range(100)] + ["", "é¥≈ unicode", "x" * 1000]
+    hi, lo, grp = native.hash128_batch(ks, 1 << 10)
+    for i, k in enumerate(ks):
+        shi, slo = native.hash128(k)
+        assert (shi, slo) == (int(hi[i]), int(lo[i])), k
+        assert int(grp[i]) == keys.group_of(slo, 1 << 10)
+
+
+def test_murmur3_reference_vectors():
+    """Pin the algorithm against an independent pure-Python murmur3
+    x64-128 implementation on a few inputs."""
+    if not native.available():
+        pytest.skip("native unavailable")
+
+    def mm3_py(data: bytes, seed=0):
+        # independent implementation of the published algorithm
+        M = (1 << 64) - 1
+
+        def rotl(x, r):
+            return ((x << r) | (x >> (64 - r))) & M
+
+        def fmix(k):
+            k ^= k >> 33
+            k = (k * 0xFF51AFD7ED558CCD) & M
+            k ^= k >> 33
+            k = (k * 0xC4CEB9FE1A85EC53) & M
+            k ^= k >> 33
+            return k
+
+        c1, c2 = 0x87C37B91114253D5, 0x4CF5AD432745937F
+        h1 = h2 = seed
+        n = len(data) // 16
+        for i in range(n):
+            k1 = int.from_bytes(data[i * 16 : i * 16 + 8], "little")
+            k2 = int.from_bytes(data[i * 16 + 8 : i * 16 + 16], "little")
+            k1 = (k1 * c1) & M
+            k1 = rotl(k1, 31)
+            k1 = (k1 * c2) & M
+            h1 ^= k1
+            h1 = rotl(h1, 27)
+            h1 = (h1 + h2) & M
+            h1 = (h1 * 5 + 0x52DCE729) & M
+            k2 = (k2 * c2) & M
+            k2 = rotl(k2, 33)
+            k2 = (k2 * c1) & M
+            h2 ^= k2
+            h2 = rotl(h2, 31)
+            h2 = (h2 + h1) & M
+            h2 = (h2 * 5 + 0x38495AB5) & M
+        tail = data[n * 16 :]
+        k1 = k2 = 0
+        for i in range(len(tail) - 1, 7, -1):
+            k2 |= tail[i] << (8 * (i - 8))
+        for i in range(min(len(tail), 8) - 1, -1, -1):
+            k1 |= tail[i] << (8 * i)
+        if len(tail) > 8:
+            k2 = (k2 * c2) & M
+            k2 = rotl(k2, 33)
+            k2 = (k2 * c1) & M
+            h2 ^= k2
+        if len(tail) > 0:
+            k1 = (k1 * c1) & M
+            k1 = rotl(k1, 31)
+            k1 = (k1 * c2) & M
+            h1 ^= k1
+        h1 ^= len(data)
+        h2 ^= len(data)
+        h1 = (h1 + h2) & M
+        h2 = (h2 + h1) & M
+        h1 = fmix(h1)
+        h2 = fmix(h2)
+        h1 = (h1 + h2) & M
+        h2 = (h2 + h1) & M
+        return h1, h2
+
+    def to_signed(v):
+        return v - (1 << 64) if v >= (1 << 63) else v
+
+    for s in ["", "a", "hello world", "t_acct:1234", "x" * 33, "abcdefghijklmnop"]:
+        want = mm3_py(s.encode())
+        want = (to_signed(want[0]), to_signed(want[1]))
+        if want == (0, 0):
+            want = (0, 1)
+        assert native.hash128(s) == want, s
+
+
+def test_keys_module_batch_matches_single():
+    ks = [f"k{i}" for i in range(50)]
+    hi, lo, grp = keys.key_hash128_batch(ks, 256)
+    for i, k in enumerate(ks):
+        assert keys.key_hash128(k) == (int(hi[i]), int(lo[i]))
+        assert int(grp[i]) == keys.group_of(int(lo[i]), 256)
