@@ -342,4 +342,8 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     )
     svc.picker = mesh
     svc.forwarder = mesh
-    svc.global_mgr = GlobalManager(svc, conf.behaviors, mode=global_mode)
+    # In "ici" mode the engine's collective sync thread replaces the
+    # gRPC global manager (runtime/ici_engine.py).
+    svc.global_mgr = (
+        None if global_mode == "ici" else GlobalManager(svc, conf.behaviors)
+    )

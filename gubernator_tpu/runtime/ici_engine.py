@@ -1,0 +1,320 @@
+"""IciEngine: a servable engine over a multi-device mesh.
+
+Where DeviceEngine owns one chip, IciEngine owns a whole
+jax.sharding.Mesh and replaces the host-level peer mesh *inside* the
+process (SURVEY.md §2.3):
+
+- Non-GLOBAL traffic runs through the owner-sharded decide
+  (parallel/mesh.py): the table shards across devices, one SPMD call per
+  wave answers every lane at its owner. This is the collective analog of
+  peer forwarding.
+- GLOBAL traffic runs through per-device replicas (parallel/ici.py):
+  lanes are assigned a home device round-robin (modeling which "node"
+  the request hit), answered locally from that device's replica, and a
+  background sync thread runs the collective delta/rebroadcast tick on
+  the GlobalSyncWait cadence — the globalManager with psums instead of
+  gRPC.
+
+The public surface matches DeviceEngine (check_async/check_batch/close),
+so V1Service and the daemon can use either; a daemon configured with
+global_mode="ici" serves a whole pod as one process with no intra-pod
+RPCs.
+
+Wave rules differ per path: sharded lanes split on slot-group conflicts
+(scatter disjointness per device); replica lanes split on (home, slot)
+conflicts (same key on the same replica must serialize, but the same key
+on different replicas is exactly multi-node GLOBAL behavior and may
+share a wave).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from gubernator_tpu.api.keys import key_hash128_batch
+from gubernator_tpu.api.types import (
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    has_behavior,
+    validate_request,
+)
+from gubernator_tpu.ops.encode import EncodeError, encode_one
+from gubernator_tpu.ops.layout import RequestBatch
+from gubernator_tpu.parallel import ici
+from gubernator_tpu.parallel import mesh as pmesh
+from gubernator_tpu.runtime.engine import EngineMetrics, _WaveAssembler, _FLUSH, _STOP
+from gubernator_tpu.utils import clock as _clock
+
+
+@dataclasses.dataclass
+class IciEngineConfig:
+    devices: Optional[list] = None  # default: all jax.devices()
+    num_groups: int = 1 << 12  # sharded-table groups (divisible by n_dev)
+    ways: int = 8
+    num_slots: int = 1 << 14  # replica-table slots (ways=1 geometry)
+    batch_size: int = 1024
+    batch_limit: int = 1000
+    batch_wait_s: float = 500e-6
+    max_flush_items: int = 8192
+    sync_wait_s: float = 0.1  # GLOBAL sync cadence (reference 100ms)
+
+
+class IciEngine:
+    def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):
+        cfg = config
+        devices = cfg.devices or jax.devices()
+        if cfg.num_groups % len(devices) or cfg.num_slots % len(devices):
+            raise ValueError("num_groups/num_slots must divide by device count")
+        self.cfg = cfg
+        self.now_fn = now_fn
+        self.n_dev = len(devices)
+        self.mesh = pmesh.make_mesh(devices)
+        self.metrics = EngineMetrics()
+
+        # Owner-sharded authoritative path
+        self.table = pmesh.create_sharded_table(self.mesh, cfg.num_groups, cfg.ways)
+        self._decide = pmesh.make_sharded_decide(self.mesh, cfg.num_groups, cfg.ways)
+
+        # GLOBAL replica path
+        self.ici_state = ici.create_ici_state(self.mesh, cfg.num_slots)
+        self._replica = ici.make_replica_decide(self.mesh, cfg.num_slots)
+        self._sync = ici.make_sync_step(self.mesh, cfg.num_slots)
+
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._home_rr = 0
+
+        self._warmup()
+        self._running = True
+        self._thread = threading.Thread(target=self._pump, daemon=True, name="ici-engine")
+        self._thread.start()
+        self._sync_thread = threading.Thread(
+            target=self._sync_loop, daemon=True, name="ici-sync"
+        )
+        self._sync_thread.start()
+
+    # -- public API (DeviceEngine-compatible) --------------------------------
+
+    def check_async(self, req: RateLimitReq) -> "Future[RateLimitResp]":
+        fut: Future = Future()
+        err = validate_request(req)
+        if err is not None:
+            fut.set_result(RateLimitResp(error=err))
+            return fut
+        if req.created_at is None:
+            req.created_at = self.now_fn()
+        self._queue.put((req, fut))
+        return fut
+
+    def check_batch(self, reqs) -> List[RateLimitResp]:
+        futs = [self.check_async(r) for r in reqs]
+        return [f.result() for f in futs]
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def live_count(self) -> int:
+        """Occupied slots: sharded table + each replica's owned region."""
+        with self._lock:
+            sharded = int(jax.numpy.sum(self.table.used))
+            replica = int(jax.numpy.sum(self.ici_state.table.used)) // max(self.n_dev, 1)
+        return sharded + replica
+
+    def sync_now(self) -> None:
+        """Run one GLOBAL sync tick immediately (tests/benchmarks)."""
+        now = self.now_fn()
+        with self._lock:
+            self.ici_state = self._sync(self.ici_state, now)
+            jax.block_until_ready(self.ici_state.pending)
+
+    def close(self) -> None:
+        self._running = False
+        self._queue.put(_STOP)
+        self._thread.join(timeout=5)
+        self._sync_thread.join(timeout=5)
+
+    # -- warmup / loops ------------------------------------------------------
+
+    def _warmup(self) -> None:
+        now = self.now_fn()
+        wb = RequestBatch.zeros(self.cfg.batch_size)
+        self.table, out = self._decide(self.table, wb, now)
+        np.asarray(out.status)
+        home = np.zeros(self.cfg.batch_size, dtype=np.int64)
+        self.ici_state, out2 = self._replica(self.ici_state, wb, home, now)
+        np.asarray(out2.status)
+        self.ici_state = self._sync(self.ici_state, now)
+        jax.block_until_ready(self.ici_state.pending)
+
+    def _sync_loop(self) -> None:
+        while self._running:
+            time.sleep(self.cfg.sync_wait_s)
+            try:
+                self.sync_now()
+            except Exception:
+                pass
+
+    def _pump(self) -> None:
+        while self._running:
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _STOP:
+                break
+            batch = []
+            flush = item is _FLUSH
+            if not flush:
+                batch.append(item)
+                flush = has_behavior(item[0].behavior, Behavior.NO_BATCHING)
+            deadline = time.monotonic() + self.cfg.batch_wait_s
+            while not flush and len(batch) < self.cfg.max_flush_items:
+                remaining = deadline - time.monotonic()
+                if len(batch) >= self.cfg.batch_limit or remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    self._running = False
+                    break
+                if nxt is _FLUSH:
+                    break
+                batch.append(nxt)
+                if has_behavior(nxt[0].behavior, Behavior.NO_BATCHING):
+                    break
+            if batch:
+                try:
+                    self._process(batch)
+                except Exception as e:
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_result(RateLimitResp(error=str(e)))
+
+    # -- flush processing ----------------------------------------------------
+
+    def _process(self, items) -> None:
+        t0 = time.perf_counter()
+        now = self.now_fn()
+        cfg = self.cfg
+        B = cfg.batch_size
+
+        is_global = [
+            has_behavior(req.behavior, Behavior.GLOBAL) for req, _ in items
+        ]
+        keys = [req.hash_key() for req, _ in items]
+        # Hash once against each path's geometry.
+        sh = key_hash128_batch(keys, cfg.num_groups)
+        rh = key_hash128_batch(keys, cfg.num_slots)
+
+        sharded_asm = _WaveAssembler(RequestBatch.zeros, B)
+        replica_asm = _WaveAssembler(RequestBatch.zeros, B)
+        replica_homes: List[np.ndarray] = []
+        replica_seen: List[set] = []
+        placements: List[Optional[Tuple[str, int, int]]] = []
+
+        for i, (req, fut) in enumerate(items):
+            try:
+                if not is_global[i]:
+                    grp = int(sh[2][i])
+                    wb, w, lane = sharded_asm.place(grp)
+                    encode_one(
+                        wb, lane, req, now, cfg.num_groups,
+                        key=(int(sh[0][i]), int(sh[1][i])),
+                    )
+                    sharded_asm.commit(w, grp)
+                    placements.append(("s", w, lane))
+                else:
+                    # Home assignment round-robin; wave key = (home, slot).
+                    slot = int(rh[2][i])
+                    home = self._home_rr % self.n_dev
+                    self._home_rr += 1
+                    w = 0
+                    while True:
+                        if w == len(replica_asm.waves):
+                            replica_asm.waves.append(RequestBatch.zeros(B))
+                            replica_asm._groups.append(set())
+                            replica_asm._fill.append(0)
+                            replica_homes.append(np.zeros(B, dtype=np.int64))
+                            replica_seen.append(set())
+                        if (home, slot) not in replica_seen[w] and replica_asm._fill[w] < B:
+                            break
+                        w += 1
+                    lane = replica_asm._fill[w]
+                    encode_one(
+                        replica_asm.waves[w], lane, req, now, cfg.num_slots,
+                        key=(int(rh[0][i]), int(rh[1][i])),
+                    )
+                    replica_homes[w][lane] = home
+                    replica_seen[w].add((home, slot))
+                    replica_asm._fill[w] += 1
+                    placements.append(("r", w, lane))
+            except EncodeError as e:
+                fut.set_result(RateLimitResp(error=str(e)))
+                placements.append(None)
+                continue
+
+        # Execute: sharded waves then replica waves.
+        s_out, r_out = [], []
+        with self._lock:
+            table = self.table
+            for wb in sharded_asm.waves:
+                table, out = self._decide(table, wb, now)
+                s_out.append(out)
+            self.table = table
+            state = self.ici_state
+            for wb, hm in zip(replica_asm.waves, replica_homes):
+                state, out = self._replica(state, wb, hm, now)
+                r_out.append(out)
+            self.ici_state = state
+
+        host = {
+            "s": [
+                (np.asarray(o.status), np.asarray(o.remaining),
+                 np.asarray(o.reset_time), np.asarray(o.limit),
+                 int(o.hits), int(o.misses), int(o.unexpired_evictions),
+                 int(o.over_limit))
+                for o in s_out
+            ],
+            "r": [
+                (np.asarray(o.status), np.asarray(o.remaining),
+                 np.asarray(o.reset_time), np.asarray(o.limit),
+                 int(o.hits), int(o.misses), int(o.unexpired_evictions),
+                 int(o.over_limit))
+                for o in r_out
+            ],
+        }
+        tots = [0, 0, 0, 0]
+        for path in host.values():
+            for h in path:
+                for j in range(4):
+                    tots[j] += h[4 + j]
+        self.metrics.observe(
+            tots[0], tots[1], tots[2], tots[3],
+            len(sharded_asm.waves) + len(replica_asm.waves), len(items),
+            time.perf_counter() - t0,
+        )
+
+        for (req, fut), place in zip(items, placements):
+            if place is None:
+                continue
+            path, w, lane = place
+            st, rem, rst, lim = host[path][w][0], host[path][w][1], host[path][w][2], host[path][w][3]
+            fut.set_result(
+                RateLimitResp(
+                    status=int(st[lane]),
+                    limit=int(lim[lane]),
+                    remaining=int(rem[lane]),
+                    reset_time=int(rst[lane]),
+                )
+            )

@@ -49,8 +49,10 @@ class DaemonConfig:
     peers: List[PeerInfo] = dataclasses.field(default_factory=list)
 
     # GLOBAL sync transport: "grpc" (cross-host, reference-compatible) or
-    # "ici" (single-process multi-device collective mode)
+    # "ici" (multi-device collective mode: the daemon serves a whole
+    # device mesh as one process; see runtime/ici_engine.py)
     global_mode: str = "grpc"
+    ici: Optional[object] = None  # runtime.ici_engine.IciEngineConfig
 
     # Discovery backend: static | dns | etcd | k8s | member-list
     discovery: str = "static"

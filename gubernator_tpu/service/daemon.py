@@ -46,7 +46,12 @@ class Daemon:
 
     async def start(self) -> None:
         conf = self.conf
-        self.engine = DeviceEngine(conf.engine_config())
+        if conf.global_mode == "ici":
+            from gubernator_tpu.runtime.ici_engine import IciEngine, IciEngineConfig
+
+            self.engine = IciEngine(conf.ici or IciEngineConfig())
+        else:
+            self.engine = DeviceEngine(conf.engine_config())
         metrics = Metrics()
         from gubernator_tpu.metrics import engine_sync
 
