@@ -18,6 +18,7 @@ pollute the device measurement; table stays resident with donated
 buffers. Latency is measured separately on single decide() round trips.
 """
 
+import argparse
 import json
 import sys
 import time
@@ -25,10 +26,68 @@ import time
 import numpy as np
 
 
+def bench_engine() -> dict:
+    """End-to-end DeviceEngine throughput: string keys, host hashing and
+    wave assembly, kernel, response demux — the serving path minus the
+    network (BASELINE configs 1/2 shape, scaled up)."""
+    from gubernator_tpu.api.types import Algorithm, RateLimitReq
+    from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    eng = DeviceEngine(
+        EngineConfig(
+            num_groups=1 << 15, batch_size=2048, batch_limit=2048,
+            batch_wait_s=200e-6, max_flush_items=1 << 14,
+            keep_key_strings=False,
+        )
+    )
+    rng = np.random.default_rng(3)
+    n_keys = 10_000
+    reqs = [
+        RateLimitReq(
+            name="bench", unique_key=f"acct:{i}",
+            algorithm=Algorithm.LEAKY_BUCKET if i % 4 == 0 else Algorithm.TOKEN_BUCKET,
+            duration=60_000, limit=100_000, hits=1,
+        )
+        for i in rng.integers(0, n_keys, 40_000)
+    ]
+    # warm
+    eng.check_batch(reqs[:2048])
+    t0 = time.perf_counter()
+    # client-shaped submission: batches of 1000 (the API's max batch)
+    futs = [
+        eng.check_bulk(reqs[i : i + 1000]) for i in range(0, len(reqs), 1000)
+    ]
+    for f in futs:
+        f.result()
+    dt = time.perf_counter() - t0
+    eng.close()
+    tput = len(reqs) / dt
+    return {
+        "metric": f"end-to-end engine decisions/sec ({platform}, 10k keys, host assembly incl.)",
+        "value": round(tput, 0),
+        "unit": "decisions/s",
+        "vs_baseline": round(tput / 4000.0, 1),
+    }
+
+
 def main() -> None:
     from gubernator_tpu.utils.platform import honor_env_platforms
 
     honor_env_platforms()
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--mode", default="kernel", choices=("kernel", "engine"),
+        help="kernel: device decide throughput @1M keys (headline); "
+        "engine: end-to-end host+device serving path",
+    )
+    args, _ = parser.parse_known_args()
+    if args.mode == "engine":
+        print(json.dumps(bench_engine()))
+        return
 
     import jax
 

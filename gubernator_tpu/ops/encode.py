@@ -41,7 +41,7 @@ def encode_one(
     error from GregorianExpiration the same way, algorithms.go:128-131).
     """
     hi, lo = key if key is not None else key_hash128(r.hash_key())
-    is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
+    is_greg = bool(r.behavior & Behavior.DURATION_IS_GREGORIAN)
 
     duration = min(max(int(r.duration), 0), MAX_DURATION_MS) if not is_greg else int(r.duration)
     if is_greg:

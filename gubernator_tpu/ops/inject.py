@@ -61,8 +61,7 @@ class InjectBatch(NamedTuple):
         )
 
 
-@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
-def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
+def _inject_impl(table: SlotTable, items: InjectBatch, now, ways: int = 8):
     now = jnp.asarray(now, dtype=I64)
     # Reuse decide's probe by viewing the inject batch as a request batch
     # (only key/group fields are read by _choose_slot).
@@ -104,3 +103,9 @@ def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
         burst=upd(table.burst, items.burst),
         lru=upd(table.lru, jnp.broadcast_to(now, idx.shape)),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
+    """Jitted entry with donated table buffers."""
+    return _inject_impl(table, items, now, ways=ways)

@@ -138,6 +138,29 @@ def make_replica_decide(mesh: Mesh, num_slots: int):
     return decide_fn
 
 
+def make_inject_replicas(mesh: Mesh, num_slots: int):
+    """Apply authoritative state rows to EVERY device's replica — the
+    landing side of a cross-pod UpdatePeerGlobals push (the intra-pod
+    sync uses make_sync_step's rebroadcast instead)."""
+    from gubernator_tpu.ops.inject import InjectBatch, inject
+
+    def local(state: IciState, items: InjectBatch, now):
+        tbl = _squeeze(state.table)
+        from gubernator_tpu.ops.inject import _inject_impl
+        tbl = _inject_impl(tbl, items, now, ways=1)
+        return IciState(table=_unsqueeze(tbl), pending=state.pending)
+
+    sharded = jax.shard_map(
+        local, mesh=mesh, in_specs=(P(AXIS), P(), P()), out_specs=P(AXIS)
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def inject_fn(state: IciState, items: InjectBatch, now):
+        return sharded(state, items, jnp.asarray(now, I64))
+
+    return inject_fn
+
+
 def make_sync_step(mesh: Mesh, num_slots: int):
     """One collective sync tick: deltas -> owners -> authoritative apply ->
     replica rebroadcast. Replaces both gRPC legs of the reference's

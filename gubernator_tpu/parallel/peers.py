@@ -271,7 +271,14 @@ class PeerMesh:
         self.region_picker = new_region
         self._all = keep
         for p in orphans:
-            asyncio.ensure_future(p.shutdown())
+            p._closed = True  # immediate: new requests bounce to re-resolution
+            try:
+                asyncio.get_running_loop()
+                asyncio.ensure_future(p.shutdown())
+            except RuntimeError:
+                # Called outside the event loop (tests, sync callers):
+                # the handle is marked closed; channel cleanup happens on GC.
+                pass
 
     # -- forwarder interface (reference gubernator.go:311-391) ---------------
 

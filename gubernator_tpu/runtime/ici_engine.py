@@ -15,10 +15,10 @@ process (SURVEY.md §2.3):
   the GlobalSyncWait cadence — the globalManager with psums instead of
   gRPC.
 
-The public surface matches DeviceEngine (check_async/check_batch/close),
-so V1Service and the daemon can use either; a daemon configured with
-global_mode="ici" serves a whole pod as one process with no intra-pod
-RPCs.
+The public surface matches DeviceEngine (check_async/check_bulk/
+check_batch/close/inject_globals), so V1Service and the daemon can use
+either; a daemon configured with global_mode="ici" serves a whole pod as
+one process with no intra-pod RPCs.
 
 Wave rules differ per path: sharded lanes split on slot-group conflicts
 (scatter disjointness per device); replica lanes split on (home, slot)
@@ -30,29 +30,28 @@ share a wave).
 from __future__ import annotations
 
 import dataclasses
-import queue
+import logging
 import threading
 import time
-from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
 import jax
 import numpy as np
 
-from gubernator_tpu.api.keys import key_hash128_batch
-from gubernator_tpu.api.types import (
-    Behavior,
-    RateLimitReq,
-    RateLimitResp,
-    has_behavior,
-    validate_request,
-)
+from gubernator_tpu.api.keys import group_of, key_hash128_batch
+from gubernator_tpu.api.types import Behavior, RateLimitResp
 from gubernator_tpu.ops.encode import EncodeError, encode_one
 from gubernator_tpu.ops.layout import RequestBatch
 from gubernator_tpu.parallel import ici
 from gubernator_tpu.parallel import mesh as pmesh
-from gubernator_tpu.runtime.engine import EngineMetrics, _WaveAssembler, _FLUSH, _STOP
+from gubernator_tpu.runtime.engine import (
+    EngineBase,
+    EngineMetrics,
+    _WaveAssembler,
+)
 from gubernator_tpu.utils import clock as _clock
+
+log = logging.getLogger("gubernator_tpu.ici")
 
 
 @dataclasses.dataclass
@@ -68,7 +67,7 @@ class IciEngineConfig:
     sync_wait_s: float = 0.1  # GLOBAL sync cadence (reference 100ms)
 
 
-class IciEngine:
+class IciEngine(EngineBase):
     def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):
         cfg = config
         devices = cfg.devices or jax.devices()
@@ -88,36 +87,67 @@ class IciEngine:
         self.ici_state = ici.create_ici_state(self.mesh, cfg.num_slots)
         self._replica = ici.make_replica_decide(self.mesh, cfg.num_slots)
         self._sync = ici.make_sync_step(self.mesh, cfg.num_slots)
+        self._inject_replicas = ici.make_inject_replicas(self.mesh, cfg.num_slots)
 
-        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._lock = threading.Lock()
         self._home_rr = 0
+        self._sync_errors = 0
 
         self._warmup()
-        self._running = True
-        self._thread = threading.Thread(target=self._pump, daemon=True, name="ici-engine")
-        self._thread.start()
+        self._init_base("ici-engine")
+        self._stop_sync = threading.Event()
         self._sync_thread = threading.Thread(
             target=self._sync_loop, daemon=True, name="ici-sync"
         )
         self._sync_thread.start()
 
-    # -- public API (DeviceEngine-compatible) --------------------------------
+    # -- public additions over EngineBase ------------------------------------
 
-    def check_async(self, req: RateLimitReq) -> "Future[RateLimitResp]":
-        fut: Future = Future()
-        err = validate_request(req)
-        if err is not None:
-            fut.set_result(RateLimitResp(error=err))
-            return fut
-        if req.created_at is None:
-            req.created_at = self.now_fn()
-        self._queue.put((req, fut))
-        return fut
+    def sync_now(self) -> None:
+        """Run one GLOBAL sync tick immediately (tests/benchmarks)."""
+        now = self.now_fn()
+        with self._lock:
+            self.ici_state = self._sync(self.ici_state, now)
+            jax.block_until_ready(self.ici_state.pending)
 
-    def check_batch(self, reqs) -> List[RateLimitResp]:
-        futs = [self.check_async(r) for r in reqs]
-        return [f.result() for f in futs]
+    def inject_globals(self, globals_) -> None:
+        """Apply an authoritative UpdatePeerGlobals push to every replica
+        (the cross-pod/DCN leg landing on an ici-mode daemon)."""
+        from gubernator_tpu.models.bucket import FIXED_SHIFT
+        from gubernator_tpu.ops.inject import InjectBatch
+
+        if not globals_:
+            return
+        now = self.now_fn()
+        cfg = self.cfg
+        asm = _WaveAssembler(InjectBatch.zeros, cfg.batch_size)
+        hi_a, lo_a, slot_a = key_hash128_batch(
+            [g.key for g in globals_], cfg.num_slots
+        )
+        for i, g in enumerate(globals_):
+            slot = int(slot_a[i])
+            ib, w, lane = asm.place(slot)
+            leaky = int(g.algorithm) == 1
+            ib.key_hi[lane] = int(hi_a[i])
+            ib.key_lo[lane] = int(lo_a[i])
+            ib.group[lane] = slot
+            ib.algo[lane] = int(g.algorithm)
+            ib.status[lane] = int(g.status.status)
+            ib.limit[lane] = g.status.limit
+            ib.duration[lane] = g.duration
+            ib.remaining[lane] = (
+                g.status.remaining << FIXED_SHIFT if leaky else g.status.remaining
+            )
+            ib.stamp[lane] = now
+            ib.expire_at[lane] = g.status.reset_time
+            ib.burst[lane] = g.status.limit if leaky else 0
+            ib.active[lane] = True
+            asm.commit(w, slot)
+        with self._lock:
+            state = self.ici_state
+            for ib in asm.waves:
+                state = self._inject_replicas(state, ib, now)
+            self.ici_state = state
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
@@ -129,20 +159,12 @@ class IciEngine:
             replica = int(jax.numpy.sum(self.ici_state.table.used)) // max(self.n_dev, 1)
         return sharded + replica
 
-    def sync_now(self) -> None:
-        """Run one GLOBAL sync tick immediately (tests/benchmarks)."""
-        now = self.now_fn()
-        with self._lock:
-            self.ici_state = self._sync(self.ici_state, now)
-            jax.block_until_ready(self.ici_state.pending)
-
     def close(self) -> None:
-        self._running = False
-        self._queue.put(_STOP)
-        self._thread.join(timeout=5)
+        self._stop_sync.set()
+        super().close()
         self._sync_thread.join(timeout=5)
 
-    # -- warmup / loops ------------------------------------------------------
+    # -- warmup / sync loop --------------------------------------------------
 
     def _warmup(self) -> None:
         now = self.now_fn()
@@ -156,50 +178,19 @@ class IciEngine:
         jax.block_until_ready(self.ici_state.pending)
 
     def _sync_loop(self) -> None:
-        while self._running:
-            time.sleep(self.cfg.sync_wait_s)
+        while not self._stop_sync.wait(self.cfg.sync_wait_s):
             try:
                 self.sync_now()
+                self._sync_errors = 0
             except Exception:
-                pass
-
-    def _pump(self) -> None:
-        while self._running:
-            try:
-                item = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            if item is _STOP:
-                break
-            batch = []
-            flush = item is _FLUSH
-            if not flush:
-                batch.append(item)
-                flush = has_behavior(item[0].behavior, Behavior.NO_BATCHING)
-            deadline = time.monotonic() + self.cfg.batch_wait_s
-            while not flush and len(batch) < self.cfg.max_flush_items:
-                remaining = deadline - time.monotonic()
-                if len(batch) >= self.cfg.batch_limit or remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is _STOP:
-                    self._running = False
-                    break
-                if nxt is _FLUSH:
-                    break
-                batch.append(nxt)
-                if has_behavior(nxt[0].behavior, Behavior.NO_BATCHING):
-                    break
-            if batch:
-                try:
-                    self._process(batch)
-                except Exception as e:
-                    for _, fut in batch:
-                        if not fut.done():
-                            fut.set_result(RateLimitResp(error=str(e)))
+                # Surface persistent failures: without sync, replicas stop
+                # converging and GLOBAL limits silently stop aggregating.
+                self._sync_errors += 1
+                if self._sync_errors in (1, 10) or self._sync_errors % 100 == 0:
+                    log.exception(
+                        "GLOBAL ICI sync tick failed (%d consecutive)",
+                        self._sync_errors,
+                    )
 
     # -- flush processing ----------------------------------------------------
 
@@ -208,56 +199,37 @@ class IciEngine:
         now = self.now_fn()
         cfg = self.cfg
         B = cfg.batch_size
+        GLOBAL = int(Behavior.GLOBAL)
 
-        is_global = [
-            has_behavior(req.behavior, Behavior.GLOBAL) for req, _ in items
-        ]
+        # Hash once; derive each path's index from lo (group/slot are just
+        # lo mod geometry).
         keys = [req.hash_key() for req, _ in items]
-        # Hash once against each path's geometry.
-        sh = key_hash128_batch(keys, cfg.num_groups)
-        rh = key_hash128_batch(keys, cfg.num_slots)
+        hi_a, lo_a, grp_a = key_hash128_batch(keys, cfg.num_groups)
 
         sharded_asm = _WaveAssembler(RequestBatch.zeros, B)
         replica_asm = _WaveAssembler(RequestBatch.zeros, B)
         replica_homes: List[np.ndarray] = []
-        replica_seen: List[set] = []
         placements: List[Optional[Tuple[str, int, int]]] = []
 
         for i, (req, fut) in enumerate(items):
+            hi, lo = int(hi_a[i]), int(lo_a[i])
             try:
-                if not is_global[i]:
-                    grp = int(sh[2][i])
+                if not (req.behavior & GLOBAL):
+                    grp = int(grp_a[i])
                     wb, w, lane = sharded_asm.place(grp)
-                    encode_one(
-                        wb, lane, req, now, cfg.num_groups,
-                        key=(int(sh[0][i]), int(sh[1][i])),
-                    )
+                    encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
                     sharded_asm.commit(w, grp)
                     placements.append(("s", w, lane))
                 else:
-                    # Home assignment round-robin; wave key = (home, slot).
-                    slot = int(rh[2][i])
+                    slot = group_of(lo, cfg.num_slots)
                     home = self._home_rr % self.n_dev
                     self._home_rr += 1
-                    w = 0
-                    while True:
-                        if w == len(replica_asm.waves):
-                            replica_asm.waves.append(RequestBatch.zeros(B))
-                            replica_asm._groups.append(set())
-                            replica_asm._fill.append(0)
-                            replica_homes.append(np.zeros(B, dtype=np.int64))
-                            replica_seen.append(set())
-                        if (home, slot) not in replica_seen[w] and replica_asm._fill[w] < B:
-                            break
-                        w += 1
-                    lane = replica_asm._fill[w]
-                    encode_one(
-                        replica_asm.waves[w], lane, req, now, cfg.num_slots,
-                        key=(int(rh[0][i]), int(rh[1][i])),
-                    )
+                    wb, w, lane = replica_asm.place((home, slot))
+                    encode_one(wb, lane, req, now, cfg.num_slots, key=(hi, lo))
+                    while len(replica_homes) < len(replica_asm.waves):
+                        replica_homes.append(np.zeros(B, dtype=np.int64))
                     replica_homes[w][lane] = home
-                    replica_seen[w].add((home, slot))
-                    replica_asm._fill[w] += 1
+                    replica_asm.commit(w, (home, slot))
                     placements.append(("r", w, lane))
             except EncodeError as e:
                 fut.set_result(RateLimitResp(error=str(e)))
@@ -278,22 +250,16 @@ class IciEngine:
                 r_out.append(out)
             self.ici_state = state
 
-        host = {
-            "s": [
+        def host_rows(outs):
+            return [
                 (np.asarray(o.status), np.asarray(o.remaining),
                  np.asarray(o.reset_time), np.asarray(o.limit),
                  int(o.hits), int(o.misses), int(o.unexpired_evictions),
                  int(o.over_limit))
-                for o in s_out
-            ],
-            "r": [
-                (np.asarray(o.status), np.asarray(o.remaining),
-                 np.asarray(o.reset_time), np.asarray(o.limit),
-                 int(o.hits), int(o.misses), int(o.unexpired_evictions),
-                 int(o.over_limit))
-                for o in r_out
-            ],
-        }
+                for o in outs
+            ]
+
+        host = {"s": host_rows(s_out), "r": host_rows(r_out)}
         tots = [0, 0, 0, 0]
         for path in host.values():
             for h in path:
@@ -309,7 +275,7 @@ class IciEngine:
             if place is None:
                 continue
             path, w, lane = place
-            st, rem, rst, lim = host[path][w][0], host[path][w][1], host[path][w][2], host[path][w][3]
+            st, rem, rst, lim = host[path][w][:4]
             fut.set_result(
                 RateLimitResp(
                     status=int(st[lane]),

@@ -83,6 +83,7 @@ class V1Service:
         now = self.now_fn()
         n = len(reqs)
         responses: List[Optional[RateLimitResp]] = [None] * n
+        local_items: List[tuple] = []  # (idx, req) -> bulk engine submit
         local_idx: List[int] = []
         local_futs = []
         forward_tasks = []
@@ -112,8 +113,7 @@ class V1Service:
 
             if peer.info.is_owner:
                 m.getratelimit_counter.labels("local").inc()
-                local_idx.append(i)
-                local_futs.append(asyncio.wrap_future(self.engine.check_async(req)))
+                local_items.append((i, req))
                 if self.global_mgr is not None and has_behavior(
                     req.behavior, Behavior.GLOBAL
                 ):
@@ -133,6 +133,19 @@ class V1Service:
                 forward_tasks.append(
                     (i, asyncio.ensure_future(self._forward(peer, req)))
                 )
+
+        # One bulk submission (one queue entry, one future) for all
+        # owner-path items
+        if local_items:
+            try:
+                results = await asyncio.wrap_future(
+                    self.engine.check_bulk([r for _, r in local_items])
+                )
+                for (i, _), resp in zip(local_items, results):
+                    responses[i] = resp
+            except Exception as e:
+                for i, _ in local_items:
+                    responses[i] = RateLimitResp(error=str(e))
 
         for i, fut in zip(local_idx, local_futs):
             try:
@@ -187,31 +200,28 @@ class V1Service:
             )
         from gubernator_tpu.utils import tracing
 
-        futs = []
         for req in reqs:
             # Extract the forwarding peer's trace context from the item's
             # metadata (reference gubernator.go:503-504).
             ctx = tracing.propagate_extract(req.metadata)
+            if ctx is not None:
+                with tracing.attached(ctx):
+                    with tracing.span(
+                        "V1Instance.getLocalRateLimit", key=req.hash_key()
+                    ):
+                        pass
             if has_behavior(req.behavior, Behavior.GLOBAL):
                 # Owner handling a relayed GLOBAL hit always drains
                 # (reference gubernator.go:510-512) and queues a broadcast.
                 req.behavior |= Behavior.DRAIN_OVER_LIMIT
             if req.created_at is None or req.created_at == 0:
                 req.created_at = self.now_fn()
-            with tracing.attached(ctx):
-                with tracing.span(
-                    "V1Instance.getLocalRateLimit", key=req.hash_key()
-                ):
-                    futs.append(asyncio.wrap_future(self.engine.check_async(req)))
             if self.global_mgr is not None and has_behavior(req.behavior, Behavior.GLOBAL):
                 self.global_mgr.queue_update(req)
-        out = []
-        for f in futs:
-            try:
-                out.append(await f)
-            except Exception as e:
-                out.append(RateLimitResp(error=str(e)))
-        return out
+        try:
+            return await asyncio.wrap_future(self.engine.check_bulk(list(reqs)))
+        except Exception as e:
+            return [RateLimitResp(error=str(e)) for _ in reqs]
 
     # ---- PeersV1.UpdatePeerGlobals (reference gubernator.go:425-459) -------
 

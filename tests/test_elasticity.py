@@ -1,0 +1,120 @@
+"""Membership churn and failure detection: SetPeers swaps rings and
+re-owns keys mid-flight; HealthCheck degrades on peer errors
+(reference gubernator.go:616-711, 542-586; SURVEY.md §5 failure
+detection)."""
+
+import time
+
+import pytest
+import requests
+
+from gubernator_tpu.api.types import PeerInfo, RateLimitReq, Status
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+
+
+@pytest.fixture(scope="module")
+def cluster(loop_thread):
+    c = loop_thread.run(Cluster.start(3), timeout=120)
+    yield c
+    loop_thread.run(c.stop())
+
+
+def call(loop_thread, daemon, name, key, hits, timeout=10):
+    async def run():
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(
+            pb.pb.RateLimitReq(
+                name=name, unique_key=key, duration=600_000, limit=100, hits=hits
+            )
+        )
+        return (await daemon.client().get_rate_limits(msg, timeout=timeout)).responses[0]
+
+    return loop_thread.run(run())
+
+
+def test_set_peers_reowns_keys(cluster, loop_thread):
+    """Shrinking the peer set moves ownership; the cluster keeps serving."""
+    name, key = "elastic", "account:move"
+    rl = call(loop_thread, cluster.peer_at(0), name, key, 10)
+    assert rl.error == "" and rl.remaining == 90
+
+    # Remove one NON-owner daemon from everyone's view, then keep serving.
+    owner = cluster.find_owning_daemon(name, key)
+    keep = [d for d in cluster.daemons if d is not cluster.list_non_owning_daemons(name, key)[0]]
+    peers = [
+        PeerInfo(grpc_address=d.grpc_address, http_address=d.http_address)
+        for d in keep
+    ]
+    for d in keep:
+        d.set_peers(peers)
+
+    rl = call(loop_thread, keep[0], name, key, 10)
+    assert rl.error == ""
+    # owner unchanged (still present in the ring) => count continued
+    assert rl.remaining == 80
+
+    # Restore full membership for subsequent tests.
+    cluster.rewire()
+
+
+def test_removed_owner_state_is_lost_but_service_continues(cluster, loop_thread):
+    """If the owner leaves the ring, its keys get a new owner with fresh
+    state (the reference's accepted cache-loss semantics)."""
+    name, key = "elastic2", "account:lost"
+    call(loop_thread, cluster.peer_at(0), name, key, 30)
+    owner = cluster.find_owning_daemon(name, key)
+    survivors = [d for d in cluster.daemons if d is not owner]
+    peers = [
+        PeerInfo(grpc_address=d.grpc_address, http_address=d.http_address)
+        for d in survivors
+    ]
+    for d in survivors:
+        d.set_peers(peers)
+
+    rl = call(loop_thread, survivors[0], name, key, 10)
+    assert rl.error == ""
+    assert rl.remaining == 90  # fresh bucket at the new owner
+
+    cluster.rewire()
+
+
+def test_health_degrades_on_peer_failure(cluster, loop_thread):
+    """Requests to a dead peer record errors; HealthCheck reports
+    unhealthy until the TTL'd error log drains."""
+    name, key = "elastic3", "account:dead"
+    # Point every daemon at a peer set including a dead address, making
+    # some keys route to it.
+    dead = PeerInfo(grpc_address="127.0.0.1:1", http_address="127.0.0.1:1")
+    peers = [
+        PeerInfo(grpc_address=d.grpc_address, http_address=d.http_address)
+        for d in cluster.daemons
+    ] + [dead]
+    for d in cluster.daemons:
+        d.set_peers(peers)
+
+    # Find a key owned by the dead peer and hit it via a live daemon.
+    import hashlib
+
+    probe = cluster.peer_at(0)
+    owner_addr = None
+    for i in range(4096):
+        # spread keys: fnv1 clusters sequential suffixes (see hash_ring)
+        k = "dk" + hashlib.md5(str(i).encode()).hexdigest()[:10]
+        p = probe.svc.picker.get(f"{name}_{k}")
+        if p.info.grpc_address == dead.grpc_address:
+            owner_addr = k
+            break
+    assert owner_addr is not None
+    rl = call(loop_thread, probe, name, owner_addr, 1, timeout=30)
+    assert rl.error != ""  # forwarding to the dead peer failed after retries
+
+    h = requests.get(f"http://{probe.http_address}/v1/HealthCheck", timeout=5).json()
+    assert h["status"] == "unhealthy"
+
+    cluster.rewire()
+    # errors are TTL'd, not instantly cleared — health stays degraded
+    # until the log drains (reference 5-minute TTL); just confirm the
+    # service itself still works.
+    rl = call(loop_thread, probe, name, "after-heal", 1)
+    assert rl.error == ""
