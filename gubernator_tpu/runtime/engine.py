@@ -40,7 +40,7 @@ from gubernator_tpu.api.types import (
     has_behavior,
     validate_request,
 )
-from gubernator_tpu.ops.encode import EncodeError, encode_one
+from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
 from gubernator_tpu.ops.decide import decide
 from gubernator_tpu.utils import clock as _clock
@@ -359,21 +359,37 @@ class DeviceEngine(EngineBase):
 
         asm = _WaveAssembler(RequestBatch.zeros, B)
         placements: List[Optional[Tuple[int, int]]] = []
+        wave_rows: List[list] = []  # per-wave (req, hi, lo, grp) for bulk fill
+        wave_lanes: List[list] = []
+        GREG = int(Behavior.DURATION_IS_GREGORIAN)
+        keep = cfg.keep_key_strings
 
         for i, (req, fut) in enumerate(items):
             hi, lo = int(hashes[0][i]), int(hashes[1][i])
-            if cfg.keep_key_strings:
+            if keep:
                 self._key_strings[(hi, lo)] = req.hash_key()
             grp = int(hashes[2][i])
             wb, w, lane = asm.place(grp)
-            try:
-                encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
-            except EncodeError as e:
-                fut.set_result(RateLimitResp(error=str(e)))
-                placements.append(None)
-                continue
+            if req.behavior & GREG:
+                # calendar resolution stays per-item (rare path)
+                try:
+                    encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
+                except EncodeError as e:
+                    fut.set_result(RateLimitResp(error=str(e)))
+                    placements.append(None)
+                    continue
+            else:
+                while len(wave_rows) < len(asm.waves):
+                    wave_rows.append([])
+                    wave_lanes.append([])
+                wave_rows[w].append((req, hi, lo, grp))
+                wave_lanes[w].append(lane)
             asm.commit(w, grp)
             placements.append((w, lane, hi, lo))
+
+        for w, rows in enumerate(wave_rows):
+            if rows:
+                encode_rows(asm.waves[w], wave_lanes[w], rows, now)
         waves = asm.waves
 
         # Execute waves sequentially against the (donated) table.
