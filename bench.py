@@ -73,6 +73,65 @@ def bench_engine() -> dict:
     }
 
 
+def bench_server() -> dict:
+    """Full service round trip: gRPC client -> daemon -> engine -> response
+    over loopback (the reference's BenchmarkServer shape; its production
+    headline is >2,000 req/s/node, README.md:129-135)."""
+    import asyncio
+
+    import jax
+
+    from gubernator_tpu.api.types import RateLimitReq
+    from gubernator_tpu.client import GubernatorClient
+    from gubernator_tpu.service.config import DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    platform = jax.devices()[0].platform
+
+    async def run():
+        d = await Daemon.spawn(DaemonConfig(cache_size=65536))
+        try:
+            async with GubernatorClient(d.grpc_address) as c:
+                reqs = [
+                    RateLimitReq(
+                        name="bench_srv", unique_key=f"k{i % 5000}",
+                        duration=60_000, limit=1_000_000, hits=1,
+                    )
+                    for i in range(500)
+                ]
+                await c.get_rate_limits(reqs[:100])  # warm
+                lat = []
+                total = 0
+                t0 = time.perf_counter()
+                # 16 concurrent clients x batched calls (batch 500)
+                async def worker(n):
+                    nonlocal total
+                    for _ in range(n):
+                        t1 = time.perf_counter()
+                        out = await c.get_rate_limits(reqs)
+                        lat.append(time.perf_counter() - t1)
+                        total += len(out)
+
+                await asyncio.gather(*(worker(6) for _ in range(16)))
+                dt = time.perf_counter() - t0
+                p50 = float(np.percentile(np.array(lat) * 1000, 50))
+                p99 = float(np.percentile(np.array(lat) * 1000, 99))
+                return total / dt, p50, p99
+        finally:
+            await d.close()
+
+    tput, p50, p99 = asyncio.run(run())
+    return {
+        "metric": (
+            f"gRPC server decisions/sec ({platform}, batch=500, 16 clients; "
+            f"p50_call={p50:.1f}ms p99_call={p99:.1f}ms)"
+        ),
+        "value": round(tput, 0),
+        "unit": "decisions/s",
+        "vs_baseline": round(tput / 4000.0, 1),
+    }
+
+
 def main() -> None:
     from gubernator_tpu.utils.platform import honor_env_platforms
 
@@ -80,13 +139,17 @@ def main() -> None:
 
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--mode", default="kernel", choices=("kernel", "engine"),
+        "--mode", default="kernel", choices=("kernel", "engine", "server"),
         help="kernel: device decide throughput @1M keys (headline); "
-        "engine: end-to-end host+device serving path",
+        "engine: end-to-end host+device serving path; "
+        "server: full gRPC round trip",
     )
     args, _ = parser.parse_known_args()
     if args.mode == "engine":
         print(json.dumps(bench_engine()))
+        return
+    if args.mode == "server":
+        print(json.dumps(bench_server()))
         return
 
     import jax

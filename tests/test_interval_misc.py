@@ -1,0 +1,69 @@
+"""Interval ticker (reference interval_test.go), force_global behavior,
+and net utilities."""
+
+import asyncio
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq, Status
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils.interval import Interval
+from gubernator_tpu.utils.net import resolve_host_ip, split_host_port
+
+
+def test_interval_ticks_after_next(loop_thread):
+    async def run():
+        iv = Interval(0.02)
+        iv.next()
+        t0 = time.monotonic()
+        await asyncio.wait_for(iv.wait(), timeout=1)
+        took = time.monotonic() - t0
+        assert took >= 0.015
+        # multiple arms coalesce into one tick
+        iv.next()
+        iv.next()
+        await asyncio.wait_for(iv.wait(), timeout=1)
+        return True
+
+    assert loop_thread.run(run())
+
+
+def test_net_utils():
+    assert split_host_port("1.2.3.4:99") == ("1.2.3.4", 99)
+    resolved = resolve_host_ip("0.0.0.0:81")
+    host, port = split_host_port(resolved)
+    assert port == 81 and host not in ("0.0.0.0", "")
+    assert resolve_host_ip("10.1.2.3:81") == "10.1.2.3:81"
+
+
+def test_force_global(loop_thread):
+    """GUBER_FORCE_GLOBAL turns every request into a GLOBAL one
+    (reference config Behaviors.ForceGlobal, gubernator.go:232-234)."""
+    c = loop_thread.run(
+        Cluster.start(
+            2, behaviors=BehaviorConfig(force_global=True, global_sync_wait_s=0.05)
+        ),
+        timeout=120,
+    )
+    try:
+        # find a daemon that does NOT own the key: forced GLOBAL must be
+        # answered from its local replica (owner metadata present)
+        non_owner = c.list_non_owning_daemons("forced", "k")[0]
+
+        async def call():
+            msg = pb.pb.GetRateLimitsReq()
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name="forced", unique_key="k", duration=60_000, limit=10, hits=1
+                )
+            )
+            return (await non_owner.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+        rl = loop_thread.run(call())
+        assert rl.status == Status.UNDER_LIMIT
+        assert "owner" in rl.metadata  # GLOBAL replica path, not forwarding
+    finally:
+        loop_thread.run(c.stop())
