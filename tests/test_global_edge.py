@@ -1,0 +1,109 @@
+"""GLOBAL behavior edge cases: leaky state broadcast reconstruction and
+RESET_REMAINING propagation through the hit-update leg (reference
+UpdatePeerGlobals reconstruction, gubernator.go:433-455; RESET flag
+merging in hit aggregation, global.go:100-106)."""
+
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, Behavior, Status, MINUTE
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig
+
+NUM = 3
+
+
+@pytest.fixture(scope="module")
+def cluster(loop_thread):
+    c = loop_thread.run(
+        Cluster.start(NUM, behaviors=BehaviorConfig(global_sync_wait_s=0.05)),
+        timeout=120,
+    )
+    yield c
+    loop_thread.run(c.stop())
+
+
+def send(loop_thread, daemon, name, key, hits, algorithm=Algorithm.TOKEN_BUCKET,
+         behavior=Behavior.GLOBAL, limit=100):
+    async def run():
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(
+            pb.pb.RateLimitReq(
+                name=name, unique_key=key, algorithm=int(algorithm),
+                behavior=int(behavior), duration=3 * MINUTE, limit=limit,
+                hits=hits,
+            )
+        )
+        return (await daemon.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+    return loop_thread.run(run())
+
+
+def wait_until(fn, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return fn()
+
+
+def test_global_leaky_broadcast_reconstruction(cluster, loop_thread):
+    """Leaky GLOBAL state pushed to replicas reconstructs a usable leaky
+    bucket (remaining, burst=limit, fresh updated_at)."""
+    name, key = "gleaky", "account:gl1"
+    owner = cluster.find_owning_daemon(name, key)
+    replicas = cluster.list_non_owning_daemons(name, key)
+
+    rl = send(loop_thread, owner, name, key, 40, algorithm=Algorithm.LEAKY_BUCKET)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 60)
+
+    def replica_sees():
+        rr = send(loop_thread, replicas[0], name, key, 0,
+                  algorithm=Algorithm.LEAKY_BUCKET)
+        return rr.remaining == 60
+
+    assert wait_until(replica_sees), "replica did not converge on leaky state"
+    # and the replica's local copy keeps working as a leaky bucket
+    rr = send(loop_thread, replicas[0], name, key, 10, algorithm=Algorithm.LEAKY_BUCKET)
+    assert (rr.status, rr.remaining) == (Status.UNDER_LIMIT, 50)
+
+
+def test_global_reset_remaining_propagates(cluster, loop_thread):
+    """A RESET_REMAINING hit at a replica reaches the owner through the
+    hit-update leg and resets the authoritative counter."""
+    name, key = "greset", "account:gr1"
+    owner = cluster.find_owning_daemon(name, key)
+    replica = cluster.list_non_owning_daemons(name, key)[0]
+
+    send(loop_thread, owner, name, key, 70)
+    def owner_at_30():
+        return send(loop_thread, owner, name, key, 0).remaining == 30
+    assert wait_until(owner_at_30)
+
+    # Replica-side RESET (with a hit so it enters the async-hits queue)
+    send(loop_thread, replica, name, key, 1,
+         behavior=Behavior.GLOBAL | Behavior.RESET_REMAINING)
+
+    def owner_reset():
+        rl = send(loop_thread, owner, name, key, 0)
+        # After RESET reaches the owner its bucket is fresh
+        return rl.remaining >= 99
+    assert wait_until(owner_reset), "RESET_REMAINING did not reach the owner"
+
+
+def test_global_over_limit_replica_rejects_after_broadcast(cluster, loop_thread):
+    """Once the owner broadcasts an exhausted bucket, replicas reject
+    locally without any forwarding."""
+    name, key = "gexhaust", "account:ge1"
+    owner = cluster.find_owning_daemon(name, key)
+    replica = cluster.list_non_owning_daemons(name, key)[0]
+
+    send(loop_thread, owner, name, key, 100)  # drain at the owner
+
+    def replica_rejects():
+        rl = send(loop_thread, replica, name, key, 1)
+        return rl.status == Status.OVER_LIMIT
+    assert wait_until(replica_rejects), "replica still admits after broadcast"

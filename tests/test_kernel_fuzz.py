@@ -153,14 +153,14 @@ def test_kernel_gregorian():
     check_seq(seq)
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
 def test_kernel_fuzz(seed):
     rng = random.Random(seed)
     keys = [f"acct:{i}" for i in range(25)]
     names = ["rl_a", "rl_b"]
     now = NOW
     seq = []
-    for _ in range(400):
+    for _ in range(700):
         behavior = 0
         if rng.random() < 0.08:
             behavior |= Behavior.RESET_REMAINING
