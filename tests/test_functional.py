@@ -231,6 +231,32 @@ def test_metrics_endpoint(cluster, loop_thread):
     assert m and int(m.group(1)) > 0
 
 
+def test_change_limit_via_grpc(cluster, loop_thread):
+    """Limit hot-change through the full service (reference
+    functional_test.go TestChangeLimit :1343)."""
+    peer = cluster.get_random_peer()
+    base = dict(name="test_change_limit_svc", unique_key="account:1234",
+                duration=60_000)
+    rl = grpc_call(loop_thread, peer, [dict(limit=100, hits=1, **base)]).responses[0]
+    assert (rl.remaining, rl.limit) == (99, 100)
+    rl = grpc_call(loop_thread, peer, [dict(limit=50, hits=1, **base)]).responses[0]
+    assert (rl.remaining, rl.limit) == (48, 50)
+    rl = grpc_call(loop_thread, peer, [dict(limit=200, hits=1, **base)]).responses[0]
+    assert (rl.remaining, rl.limit) == (197, 200)
+
+
+def test_algorithm_switch_via_grpc(cluster, loop_thread):
+    peer = cluster.get_random_peer()
+    base = dict(name="test_algo_switch_svc", unique_key="k", duration=60_000,
+                limit=10)
+    rl = grpc_call(loop_thread, peer, [dict(hits=5, **base)]).responses[0]
+    assert rl.remaining == 5
+    rl = grpc_call(
+        loop_thread, peer, [dict(hits=1, algorithm=int(Algorithm.LEAKY_BUCKET), **base)]
+    ).responses[0]
+    assert rl.remaining == 9  # fresh leaky bucket after the switch
+
+
 def test_healthz(cluster, loop_thread):
     addr = cluster.peer_at(0).http_address
     r = requests.get(f"http://{addr}/healthz", timeout=5)
