@@ -63,10 +63,29 @@ def bench_engine() -> dict:
     for f in futs:
         f.result()
     dt = time.perf_counter() - t0
-    eng.close()
     tput = len(reqs) / dt
+
+    # Single-request NO_BATCHING latency (the p99 < 2ms north star is a
+    # per-request service latency; NO_BATCHING skips the batch window).
+    from gubernator_tpu.api.types import Behavior
+
+    lat = []
+    for i in range(300):
+        r = RateLimitReq(
+            name="bench", unique_key=f"lat:{i % 100}", behavior=Behavior.NO_BATCHING,
+            duration=60_000, limit=100_000, hits=1,
+        )
+        t1 = time.perf_counter()
+        eng.check_batch([r])
+        lat.append(time.perf_counter() - t1)
+    lat_ms = np.array(lat[50:]) * 1000  # skip warm tail
+    p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
+    eng.close()
     return {
-        "metric": f"end-to-end engine decisions/sec ({platform}, 10k keys, host assembly incl.)",
+        "metric": (
+            f"end-to-end engine decisions/sec ({platform}, 10k keys, host "
+            f"assembly incl.; single-req p50={p50:.2f}ms p99={p99:.2f}ms)"
+        ),
         "value": round(tput, 0),
         "unit": "decisions/s",
         "vs_baseline": round(tput / 4000.0, 1),
