@@ -151,6 +151,35 @@ def bench_server() -> dict:
     }
 
 
+def _device_init_watchdog(timeout_s: float = 300.0):
+    """The TPU tunnel's device claim can wedge indefinitely (observed in
+    this environment when a prior holder died uncleanly). The driver needs
+    ONE JSON line no matter what, so if device init doesn't complete in
+    time we print a failure record and hard-exit."""
+    import os
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            print(
+                json.dumps(
+                    {
+                        "metric": f"device init did not complete within {timeout_s:.0f}s (TPU claim unavailable)",
+                        "value": 0,
+                        "unit": "decisions/s",
+                        "vs_baseline": 0,
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(0)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done
+
+
 def main() -> None:
     from gubernator_tpu.utils.platform import honor_env_platforms
 
@@ -164,6 +193,13 @@ def main() -> None:
         "server: full gRPC round trip",
     )
     args, _ = parser.parse_known_args()
+    init_done = _device_init_watchdog()
+
+    import jax
+
+    dev = jax.devices()[0]  # the claim — the part that can wedge
+    init_done.set()
+
     if args.mode == "engine":
         print(json.dumps(bench_engine()))
         return
@@ -171,12 +207,9 @@ def main() -> None:
         print(json.dumps(bench_server()))
         return
 
-    import jax
-
     from gubernator_tpu.ops import SlotTable, decide, decide_scan
     from gubernator_tpu.ops.layout import RequestBatch
 
-    dev = jax.devices()[0]
     platform = dev.platform
 
     NOW = 1_753_700_000_000
