@@ -70,6 +70,16 @@ class DaemonConfig:
     # (reference flags.go:19-57; 'golang' maps to the Python runtime)
     metric_flags: List[str] = dataclasses.field(default_factory=list)
 
+    # Optional persistence plugins (gubernator_tpu.store protocols):
+    # loader restores at startup / saves at close (reference
+    # gubernator.go:138-148, 151-178); store enables read-through +
+    # write-behind on the engine.
+    loader: Optional[object] = None
+    store: Optional[object] = None
+
+    # Instance identity for logs/debugging (reference GUBER_INSTANCE_ID)
+    instance_id: str = ""
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine

@@ -52,6 +52,17 @@ class Daemon:
             self.engine = IciEngine(conf.ici or IciEngineConfig())
         else:
             self.engine = DeviceEngine(conf.engine_config())
+
+        # Persistence plugins (reference gubernator.go:138-148)
+        if conf.store is not None:
+            from gubernator_tpu.store import attach_store
+
+            attach_store(self.engine, conf.store)
+        if conf.loader is not None:
+            from gubernator_tpu.store import load_engine
+
+            load_engine(self.engine, conf.loader)
+
         metrics = Metrics()
         from gubernator_tpu.metrics import engine_sync
 
@@ -150,6 +161,12 @@ class Daemon:
             raise ValueError(f"unknown peer discovery type: {conf.discovery!r}")
 
     async def close(self) -> None:
+        # Drain counters to the Loader before teardown (reference
+        # workerPool.Store at shutdown, gubernator.go:151-178)
+        if self.conf.loader is not None and self.engine is not None:
+            from gubernator_tpu.store import save_engine
+
+            save_engine(self.engine, self.conf.loader)
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
         if self.svc is not None and self.svc.global_mgr is not None:

@@ -96,6 +96,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     )
 
     conf = DaemonConfig(
+        instance_id=_env("GUBER_INSTANCE_ID", ""),
         grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "127.0.0.1:81"),
         http_listen_address=_env("GUBER_HTTP_ADDRESS", "127.0.0.1:80"),
         advertise_address=_env("GUBER_ADVERTISE_ADDRESS", ""),

@@ -1,0 +1,71 @@
+"""Daemon-level checkpoint/resume: counters survive a daemon restart
+through the Loader plugin (reference TestLoader, store_test.go:76-125)."""
+
+import pytest
+
+from gubernator_tpu.api.types import RateLimitReq, Status
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+from gubernator_tpu.store import MemoryLoader, MemoryStore
+from gubernator_tpu.utils import clock as uclock
+
+
+def test_daemon_restart_preserves_counters(loop_thread):
+    loader = MemoryLoader()
+
+    async def boot():
+        return await Daemon.spawn(
+            DaemonConfig(cache_size=4096, loader=loader)
+        )
+
+    async def hit(d, hits):
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(
+            pb.pb.RateLimitReq(
+                name="persist", unique_key="k", duration=600_000, limit=100,
+                hits=hits,
+            )
+        )
+        return (await d.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+    with uclock.freeze():
+        d1 = loop_thread.run(boot(), timeout=120)
+        try:
+            rl = loop_thread.run(hit(d1, 30))
+            assert rl.remaining == 70
+        finally:
+            loop_thread.run(d1.close())
+        assert loader.called_save == 1 and len(loader.items) == 1
+
+        d2 = loop_thread.run(boot(), timeout=120)
+        try:
+            assert loader.called_load >= 1
+            rl = loop_thread.run(hit(d2, 0))
+            assert rl.remaining == 70  # restored, not fresh
+        finally:
+            loop_thread.run(d2.close())
+
+
+def test_daemon_store_attached(loop_thread):
+    store = MemoryStore()
+
+    async def boot():
+        return await Daemon.spawn(DaemonConfig(cache_size=4096, store=store))
+
+    d = loop_thread.run(boot(), timeout=120)
+    try:
+        async def hit():
+            msg = pb.pb.GetRateLimitsReq()
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name="wb", unique_key="k", duration=600_000, limit=10, hits=3
+                )
+            )
+            return (await d.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+        rl = loop_thread.run(hit())
+        assert rl.remaining == 7
+        assert store.data["wb_k"].remaining == 7
+    finally:
+        loop_thread.run(d.close())
