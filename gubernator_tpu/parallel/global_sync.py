@@ -167,8 +167,23 @@ class GlobalManager:
     # -- broadcast to replicas (reference global.go:234-283) -----------------
 
     async def _broadcast(self, updates: Dict[str, RateLimitReq]) -> None:
+        peers = [p for p in self.svc.picker.peers() if not p.info.is_owner]
+        if not peers:
+            # Single-pod deployment: nobody to broadcast to; skip the
+            # status re-reads (and the forced sync below) entirely.
+            return
         t0 = time.perf_counter()
         try:
+            # Two-tier GLOBAL ("ici" mode): the pod's authoritative value
+            # is spread across device replicas until the collective sync
+            # merges them — force one sync so the status re-reads below
+            # broadcast the post-merge totals, not one replica's partial
+            # view. (Only when there are peers to broadcast to; the
+            # engine's own sync thread handles the steady-state cadence.)
+            if self.mode == "ici" and hasattr(self.svc.engine, "sync_now"):
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.svc.engine.sync_now
+                )
             # Enqueue ALL status reads first so the engine pump coalesces
             # them into a few waves, then await; awaiting one-by-one would
             # serialize a full micro-batch flush per key.
@@ -192,9 +207,6 @@ class GlobalManager:
                 for (key, upd), status in zip(updates.items(), statuses)
             ]
 
-            peers = [
-                p for p in self.svc.picker.peers() if not p.info.is_owner
-            ]
             sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
 
             async def push(peer):

@@ -145,10 +145,18 @@ def make_inject_replicas(mesh: Mesh, num_slots: int):
     from gubernator_tpu.ops.inject import InjectBatch, inject
 
     def local(state: IciState, items: InjectBatch, now):
-        tbl = _squeeze(state.table)
         from gubernator_tpu.ops.inject import _inject_impl
+
+        tbl = _squeeze(state.table)
+        pending = state.pending[0]
         tbl = _inject_impl(tbl, items, now, ways=1)
-        return IciState(table=_unsqueeze(tbl), pending=state.pending)
+        # The authoritative push supersedes this pod's un-synced local
+        # deltas for these slots (the host tier already carried them to
+        # the owner); leaving them would re-apply the same hits at the
+        # next sync tick and double-count.
+        idx = jnp.where(items.active, items.group.astype(I64), num_slots)
+        pending = pending.at[idx].set(0, mode="drop")
+        return IciState(table=_unsqueeze(tbl), pending=pending[None])
 
     sharded = jax.shard_map(
         local, mesh=mesh, in_specs=(P(AXIS), P(), P()), out_specs=P(AXIS)

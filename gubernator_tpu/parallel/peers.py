@@ -349,8 +349,8 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     )
     svc.picker = mesh
     svc.forwarder = mesh
-    # In "ici" mode the engine's collective sync thread replaces the
-    # gRPC global manager (runtime/ici_engine.py).
-    svc.global_mgr = (
-        None if global_mode == "ici" else GlobalManager(svc, conf.behaviors)
-    )
+    # Two-tier GLOBAL: the gRPC global manager always runs the HOST tier
+    # (pod-to-pod hit aggregation + broadcast); in "ici" mode the engine's
+    # collective sync thread additionally runs the device tier within the
+    # pod (runtime/ici_engine.py).
+    svc.global_mgr = GlobalManager(svc, conf.behaviors, mode=global_mode)

@@ -68,6 +68,10 @@ class IciEngineConfig:
 
 
 class IciEngine(EngineBase):
+    # GLOBAL-flagged requests are routed to the replica tier inside the
+    # engine; V1Service must not strip the flag (see _get_global_rate_limit)
+    routes_global_internally = True
+
     def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):
         cfg = config
         devices = cfg.devices or jax.devices()

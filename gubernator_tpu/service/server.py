@@ -175,7 +175,12 @@ class V1Service:
         import dataclasses
 
         req2 = dataclasses.replace(req, metadata=dict(req.metadata))
-        req2.behavior = (req.behavior | Behavior.NO_BATCHING) & ~Behavior.GLOBAL
+        req2.behavior = req.behavior | Behavior.NO_BATCHING
+        if not getattr(self.engine, "routes_global_internally", False):
+            # Reference semantics: answer from the local cache as if owner
+            # (gubernator.go:408-414). An IciEngine instead KEEPS the
+            # GLOBAL bit so the request lands on its replica tier.
+            req2.behavior &= ~Behavior.GLOBAL
         resp = await asyncio.wrap_future(self.engine.check_async(req2))
         if self.global_mgr is not None:
             self.global_mgr.queue_hit(req)

@@ -1,0 +1,90 @@
+"""Two-tier GLOBAL: gRPC between pods, ICI collectives within each pod.
+
+Two ici-mode daemons (each serving a full 8-device mesh) form a host
+mesh; GLOBAL hits on a non-owner pod's replica tier must reach the owner
+pod via the host-tier hit-update leg and come back to every pod via the
+broadcast leg — the DCN/ICI split SURVEY.md §2.3 calls for."""
+
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, PeerInfo, Status, MINUTE
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.runtime.ici_engine import IciEngineConfig
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+
+LIMIT = 1000
+
+
+@pytest.fixture(scope="module")
+def pods(loop_thread):
+    async def start():
+        c = Cluster()
+        for _ in range(2):
+            conf = DaemonConfig(
+                global_mode="ici",
+                behaviors=BehaviorConfig(global_sync_wait_s=0.05),
+                ici=IciEngineConfig(
+                    num_groups=1 << 9, num_slots=1 << 11, batch_size=64,
+                    batch_wait_s=0.002, sync_wait_s=0.03,
+                ),
+            )
+            c.daemons.append(await Daemon.spawn(conf))
+        c.rewire()
+        return c
+
+    c = loop_thread.run(start(), timeout=180)
+    yield c
+    loop_thread.run(c.stop())
+
+
+def send(loop_thread, daemon, name, key, hits):
+    async def run():
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(
+            pb.pb.RateLimitReq(
+                name=name, unique_key=key, behavior=int(Behavior.GLOBAL),
+                duration=3 * MINUTE, limit=LIMIT, hits=hits,
+            )
+        )
+        return (await daemon.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+    return loop_thread.run(run())
+
+
+def wait_until(fn, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.03)
+    return fn()
+
+
+def test_cross_pod_global_convergence(pods, loop_thread):
+    name, key = "ttg", "account:xpod1"
+    owner_pod = pods.find_owning_daemon(name, key)
+    other_pod = pods.list_non_owning_daemons(name, key)[0]
+
+    # Hit the NON-owner pod: answered from its replica tier immediately.
+    rl = send(loop_thread, other_pod, name, key, 25)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, LIMIT - 25)
+    assert rl.metadata["owner"] == owner_pod.grpc_address
+
+    # The hit-update leg carries the delta to the owner pod; its replica
+    # tier (the pod's authoritative GLOBAL state) reflects it.
+    def owner_sees():
+        return send(loop_thread, owner_pod, name, key, 0).remaining == LIMIT - 25
+
+    assert wait_until(owner_sees), "owner pod did not receive the hit-update"
+
+    # Hits at the owner pod broadcast back to the other pod's replicas.
+    send(loop_thread, owner_pod, name, key, 15)
+
+    def other_converges():
+        return send(loop_thread, other_pod, name, key, 0).remaining == LIMIT - 40
+
+    assert wait_until(other_converges), "non-owner pod did not converge"
