@@ -1,0 +1,143 @@
+"""More reference functional ports: TestLeakyBucketDivBug (fractional
+rates), TestMultipleAsync (mixed-owner batches), TestGetPeerRateLimits
+(direct PeersV1), TestGlobalNegativeHits."""
+
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, Behavior, Status, MINUTE
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import clock as uclock
+
+NUM = 4
+
+
+@pytest.fixture(scope="module")
+def cluster(loop_thread):
+    c = loop_thread.run(
+        Cluster.start(NUM, behaviors=BehaviorConfig(global_sync_wait_s=0.05)),
+        timeout=120,
+    )
+    yield c
+    loop_thread.run(c.stop())
+
+
+def rl_req(name, key, hits, limit=2, duration=100 * MINUTE, behavior=0,
+           algorithm=Algorithm.TOKEN_BUCKET):
+    return pb.pb.RateLimitReq(
+        name=name, unique_key=key, algorithm=int(algorithm),
+        behavior=int(behavior), duration=duration, limit=limit, hits=hits,
+    )
+
+
+def call(loop_thread, daemon, reqs):
+    async def run():
+        msg = pb.pb.GetRateLimitsReq()
+        for r in reqs:
+            msg.requests.append(r)
+        return (await daemon.client().get_rate_limits(msg, timeout=10)).responses
+
+    return loop_thread.run(run())
+
+
+def test_leaky_bucket_div_bug(cluster, loop_thread):
+    """Fractional ms-per-token rates (rate 0.5) must not corrupt
+    remaining (reference TestLeakyBucketDivBug)."""
+    with uclock.freeze():
+        peer = cluster.get_random_peer()
+        name, key = "divbug", "account:div"
+        out = call(loop_thread, peer, [rl_req(name, key, 1, limit=2000,
+                                              duration=1000,
+                                              algorithm=Algorithm.LEAKY_BUCKET)])
+        assert (out[0].status, out[0].remaining, out[0].limit) == (
+            Status.UNDER_LIMIT, 1999, 2000)
+        out = call(loop_thread, peer, [rl_req(name, key, 100, limit=2000,
+                                              duration=1000,
+                                              algorithm=Algorithm.LEAKY_BUCKET)])
+        assert (out[0].remaining, out[0].limit) == (1899, 2000)
+
+
+def test_multiple_async_mixed_owners(cluster, loop_thread):
+    """One batch whose items are owned by different daemons: responses
+    come back in request order, each against its own counter
+    (reference TestMultipleAsync)."""
+    peer = cluster.peer_at(0)
+    import hashlib
+
+    keys = ["ma:" + hashlib.md5(str(i).encode()).hexdigest()[:8] for i in range(12)]
+    owners = {cluster.find_owning_daemon("multi_async", k).grpc_address for k in keys}
+    assert len(owners) >= 2  # batch genuinely spans owners
+
+    reqs = [
+        rl_req("multi_async", k, hits=i % 3, limit=100, duration=60_000)
+        for i, k in enumerate(keys)
+    ]
+    out = call(loop_thread, peer, reqs)
+    assert len(out) == len(keys)
+    for i, r in enumerate(out):
+        assert r.error == ""
+        assert r.remaining == 100 - (i % 3), f"item {i} out of order"
+
+
+def test_get_peer_rate_limits_direct(cluster, loop_thread):
+    """Direct PeersV1.GetPeerRateLimits call against the owner
+    (reference TestGetPeerRateLimits)."""
+    import grpc as _grpc
+
+    from gubernator_tpu.service.rpc import PeersV1Stub
+
+    name, key = "direct_peers", "account:dp"
+    owner = cluster.find_owning_daemon(name, key)
+
+    async def run():
+        ch = _grpc.aio.insecure_channel(owner.grpc_address)
+        stub = PeersV1Stub(ch)
+        msg = pb.peers_pb.GetPeerRateLimitsReq()
+        msg.requests.append(rl_req(name, key, 1, limit=10, duration=60_000))
+        resp = await stub.get_peer_rate_limits(msg, timeout=5)
+        await ch.close()
+        return resp
+
+    resp = loop_thread.run(run())
+    assert len(resp.rate_limits) == 1
+    assert (resp.rate_limits[0].status, resp.rate_limits[0].remaining) == (
+        Status.UNDER_LIMIT, 9)
+
+
+def test_global_negative_hits(cluster, loop_thread):
+    """Negative GLOBAL hits grow remaining and propagate via broadcasts
+    (reference TestGlobalNegativeHits)."""
+    name, key = "gneg", "account:gneg1"
+    peers = cluster.list_non_owning_daemons(name, key)
+
+    def send(daemon, hits, want_remaining):
+        out = call(loop_thread, daemon,
+                   [rl_req(name, key, hits, limit=2, behavior=Behavior.GLOBAL)])
+        assert out[0].error == ""
+        assert out[0].status == Status.UNDER_LIMIT
+        return out[0].remaining
+
+    # New bucket with hits=-1: remaining = limit - (-1) = 3
+    assert send(peers[0], -1, 3) == 3
+
+    # After propagation, another peer's -1 yields 4
+    def converged_to(daemon, value):
+        def check():
+            out = call(loop_thread, daemon,
+                       [rl_req(name, key, 0, limit=2, behavior=Behavior.GLOBAL)])
+            return out[0].remaining == value
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if check():
+                return True
+            time.sleep(0.03)
+        return check()
+
+    assert converged_to(peers[1], 3)
+    assert send(peers[1], -1, 4) == 4
+    assert converged_to(peers[2], 4)
+    # consume all 4
+    assert send(peers[2], 4, 0) == 0
