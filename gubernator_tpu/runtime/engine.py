@@ -57,6 +57,10 @@ class EngineConfig:
     batch_limit: int = 1000  # max requests accumulated per flush
     batch_wait_s: float = 500e-6  # 500 µs
     max_flush_items: int = 8192  # hard cap pulled off the queue per flush
+    # Bound per-flush latency: a flush full of same-key duplicates would
+    # otherwise serialize into thousands of waves; overflow items carry
+    # over to the next flush in arrival order.
+    max_waves: int = 32
     keep_key_strings: bool = True  # hash -> string dict (Loader/debug)
     device: Optional[object] = None  # jax device for the table
 
@@ -121,11 +125,15 @@ class _WaveAssembler:
         self._groups: List[set] = []
         self._fill: List[int] = []
 
-    def place(self, grp: int) -> Tuple[object, int, int]:
-        """Returns (wave_batch, wave_index, lane) without committing."""
+    def place(self, grp: int, max_waves: Optional[int] = None):
+        """Returns (wave_batch, wave_index, lane), or None if placement
+        would exceed max_waves (caller carries the item to the next
+        flush)."""
         w = 0
         while True:
             if w == len(self.waves):
+                if max_waves is not None and w >= max_waves:
+                    return None
                 self.waves.append(self._make(self._B))
                 self._groups.append(set())
                 self._fill.append(0)
@@ -143,8 +151,10 @@ class EngineBase:
     submission path, and the pump thread's accumulate-and-flush loop
     (the reference's micro-batch policy, peer_client.go:284-337).
 
-    Subclasses provide cfg (batch_wait_s/batch_limit/max_flush_items),
-    now_fn, metrics, and _process(items)."""
+    Subclasses provide cfg (batch_wait_s/batch_limit/max_flush_items/
+    max_waves), now_fn, metrics, and _process(items) -> carry, where
+    carry is the list of (req, future) pairs the flush could not place
+    (wave cap); the pump re-presents them first on the next flush."""
 
     def _init_base(self, thread_name: str) -> None:
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
@@ -211,22 +221,33 @@ class EngineBase:
 
     def _pump(self) -> None:
         NB = int(Behavior.NO_BATCHING)
+        carry: List[Tuple[RateLimitReq, object]] = []
+        pending_bulks: List[_Bulk] = []
         while self._running:
-            try:
-                item = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
+            if not carry:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            else:
+                # Wave-capped leftovers from the previous flush go first
+                # (preserves per-key arrival order); drain anything queued
+                # without waiting.
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    item = _FLUSH
             if item is _STOP:
                 break
-            batch: List[Tuple[RateLimitReq, object]] = []
-            bulks: List[_Bulk] = []
+            batch: List[Tuple[RateLimitReq, object]] = list(carry)
+            carry = []
 
             def _extend(entry) -> bool:
                 """Add a queue entry (single pair or bulk); True if it asks
                 for an immediate flush."""
                 if type(entry) is _Bulk:
                     batch.extend(entry.work)
-                    bulks.append(entry)
+                    pending_bulks.append(entry)
                     return any(r.behavior & NB for r, _ in entry.work)
                 batch.append(entry)
                 return bool(entry[0].behavior & NB)
@@ -252,13 +273,27 @@ class EngineBase:
                     break
             if batch:
                 try:
-                    self._process(batch)
+                    carry = self._process(batch) or []
                 except Exception as e:  # never kill the pump
                     for _, fut in batch:
                         if not fut.done():
                             fut.set_result(RateLimitResp(error=str(e)))
-                for b in bulks:
-                    b.resolve()
+                    carry = []
+                # Resolve bulks whose members have all been answered;
+                # wave-capped bulks wait for their carried items.
+                still = []
+                for b in pending_bulks:
+                    if all(s.done() for s in b.slots):
+                        b.resolve()
+                    else:
+                        still.append(b)
+                pending_bulks = still
+        # Shutdown: fail anything still carried and resolve bulks.
+        for _, fut in carry:
+            if not fut.done():
+                fut.set_result(RateLimitResp(error="engine shutdown"))
+        for b in pending_bulks:
+            b.resolve()
 
 
 class DeviceEngine(EngineBase):
@@ -287,6 +322,8 @@ class DeviceEngine(EngineBase):
         self._invalid_at: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()  # guards table swap (load/restore)
 
+        if config.max_waves < 1:
+            raise ValueError("max_waves must be >= 1")
         dev = config.device
 
         with jax.default_device(dev) if dev is not None else _nullcontext():
@@ -326,7 +363,9 @@ class DeviceEngine(EngineBase):
 
     # ---- wave assembly + kernel dispatch -----------------------------------
 
-    def _process(self, items: List[Tuple[RateLimitReq, Future]]) -> None:
+    def _process(
+        self, items: List[Tuple[RateLimitReq, object]]
+    ) -> List[Tuple[RateLimitReq, object]]:
         t0 = time.perf_counter()
         now = self.now_fn()
         cfg = self.cfg
@@ -364,12 +403,21 @@ class DeviceEngine(EngineBase):
         GREG = int(Behavior.DURATION_IS_GREGORIAN)
         keep = cfg.keep_key_strings
 
+        carry: List[Tuple[RateLimitReq, object]] = []
         for i, (req, fut) in enumerate(items):
             hi, lo = int(hashes[0][i]), int(hashes[1][i])
             if keep:
                 self._key_strings[(hi, lo)] = req.hash_key()
             grp = int(hashes[2][i])
-            wb, w, lane = asm.place(grp)
+            placed = asm.place(grp, cfg.max_waves)
+            if placed is None:
+                # Wave cap reached for this group: defer to the next flush
+                # (the pump re-presents carried items first, preserving
+                # per-key arrival order).
+                carry.append((req, fut))
+                placements.append("carry")
+                continue
+            wb, w, lane = placed
             if req.behavior & GREG:
                 # calendar resolution stays per-item (rare path)
                 try:
@@ -417,7 +465,8 @@ class DeviceEngine(EngineBase):
         ]
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
         self.metrics.observe(
-            tot[0], tot[1], tot[2], tot[3], len(waves), len(items),
+            tot[0], tot[1], tot[2], tot[3], len(waves),
+            len(items) - len(carry),  # carried items count when served
             time.perf_counter() - t0,
         )
 
@@ -428,8 +477,8 @@ class DeviceEngine(EngineBase):
             self._store_write_behind(items, placements, outs)
 
         for (req, fut), place in zip(items, placements):
-            if place is None:
-                continue  # already resolved (encode error)
+            if place is None or place == "carry":
+                continue  # resolved (encode error) or deferred
             w, lane = place[0], place[1]
             st, rem, rst, lim = host[w][0], host[w][1], host[w][2], host[w][3]
             fut.set_result(
@@ -440,6 +489,7 @@ class DeviceEngine(EngineBase):
                     reset_time=int(rst[lane]),
                 )
             )
+        return carry
 
     def _store_write_behind(self, items, placements, outs) -> None:
         from gubernator_tpu.ops.decide import gather_rows
@@ -449,7 +499,7 @@ class DeviceEngine(EngineBase):
         rows = [jax.tree.map(np.asarray, r) for r in rows]
         changes = []
         for (req, _), place in zip(items, placements):
-            if place is None:
+            if place is None or place == "carry":
                 continue
             w, lane, hi, lo = place
             r = rows[w]
@@ -586,7 +636,14 @@ class _Bulk:
 
     def resolve(self) -> None:
         if not self.future.done():
-            self.future.set_result([s.value for s in self.slots])
+            self.future.set_result(
+                [
+                    s.value
+                    if s.done()
+                    else RateLimitResp(error="engine shutdown")
+                    for s in self.slots
+                ]
+            )
 
 
 class _nullcontext:

@@ -64,6 +64,7 @@ class IciEngineConfig:
     batch_limit: int = 1000
     batch_wait_s: float = 500e-6
     max_flush_items: int = 8192
+    max_waves: int = 32  # per-flush wave cap; overflow carries over
     sync_wait_s: float = 0.1  # GLOBAL sync cadence (reference 100ms)
 
 
@@ -77,6 +78,8 @@ class IciEngine(EngineBase):
         devices = cfg.devices or jax.devices()
         if cfg.num_groups % len(devices) or cfg.num_slots % len(devices):
             raise ValueError("num_groups/num_slots must divide by device count")
+        if cfg.max_waves < 1:
+            raise ValueError("max_waves must be >= 1")
         self.cfg = cfg
         self.now_fn = now_fn
         self.n_dev = len(devices)
@@ -198,7 +201,7 @@ class IciEngine(EngineBase):
 
     # -- flush processing ----------------------------------------------------
 
-    def _process(self, items) -> None:
+    def _process(self, items) -> list:
         t0 = time.perf_counter()
         now = self.now_fn()
         cfg = self.cfg
@@ -215,20 +218,31 @@ class IciEngine(EngineBase):
         replica_homes: List[np.ndarray] = []
         placements: List[Optional[Tuple[str, int, int]]] = []
 
+        carry = []
         for i, (req, fut) in enumerate(items):
             hi, lo = int(hi_a[i]), int(lo_a[i])
             try:
                 if not (req.behavior & GLOBAL):
                     grp = int(grp_a[i])
-                    wb, w, lane = sharded_asm.place(grp)
+                    placed = sharded_asm.place(grp, cfg.max_waves)
+                    if placed is None:
+                        carry.append((req, fut))
+                        placements.append("carry")
+                        continue
+                    wb, w, lane = placed
                     encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
                     sharded_asm.commit(w, grp)
                     placements.append(("s", w, lane))
                 else:
                     slot = group_of(lo, cfg.num_slots)
                     home = self._home_rr % self.n_dev
-                    self._home_rr += 1
-                    wb, w, lane = replica_asm.place((home, slot))
+                    placed = replica_asm.place((home, slot), cfg.max_waves)
+                    if placed is None:
+                        carry.append((req, fut))
+                        placements.append("carry")
+                        continue
+                    self._home_rr += 1  # only consumed on placement
+                    wb, w, lane = placed
                     encode_one(wb, lane, req, now, cfg.num_slots, key=(hi, lo))
                     while len(replica_homes) < len(replica_asm.waves):
                         replica_homes.append(np.zeros(B, dtype=np.int64))
@@ -271,12 +285,13 @@ class IciEngine(EngineBase):
                     tots[j] += h[4 + j]
         self.metrics.observe(
             tots[0], tots[1], tots[2], tots[3],
-            len(sharded_asm.waves) + len(replica_asm.waves), len(items),
+            len(sharded_asm.waves) + len(replica_asm.waves),
+            len(items) - len(carry),  # carried items count when served
             time.perf_counter() - t0,
         )
 
         for (req, fut), place in zip(items, placements):
-            if place is None:
+            if place is None or place == "carry":
                 continue
             path, w, lane = place
             st, rem, rst, lim = host[path][w][:4]
@@ -288,3 +303,4 @@ class IciEngine(EngineBase):
                     reset_time=int(rst[lane]),
                 )
             )
+        return carry

@@ -144,6 +144,28 @@ def test_snapshot_restore(engine):
         eng2.close()
 
 
+def test_wave_cap_carry_preserves_order():
+    """An adversarial flush of many same-key duplicates is bounded to
+    max_waves kernel calls per flush; the overflow carries to subsequent
+    flushes with sequential semantics intact."""
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(
+            num_groups=1 << 10, batch_size=16, batch_wait_s=0.001, max_waves=4
+        ),
+        now_fn=lambda: clock["now"],
+    )
+    try:
+        n = 40  # 40 same-key requests -> 40 waves uncapped; 10 flushes capped
+        out = eng.check_batch([mk(hits=1, limit=100) for _ in range(n)])
+        assert [r.remaining for r in out] == list(range(99, 99 - n, -1))
+        assert all(r.error == "" for r in out)
+        # engine survived and still serves
+        assert eng.check_batch([mk(hits=0, limit=100)])[0].remaining == 60
+    finally:
+        eng.close()
+
+
 def test_time_advance_expiry(engine):
     engine.check_batch([mk(key="exp", duration=50, hits=10)])
     engine._test_clock["now"] = NOW + 1000
