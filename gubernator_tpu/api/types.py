@@ -53,9 +53,9 @@ class Status(enum.IntEnum):
 
 
 def has_behavior(behavior: int, flag: Behavior) -> bool:
-    """Bit test (reference gubernator.go:776-781)."""
-    if flag == Behavior.BATCHING:
-        return behavior == 0
+    """Bit test (reference gubernator.go:776-778). Note the reference
+    quirk: HasBehavior(b, BATCHING) is always False since BATCHING == 0;
+    batching-is-default is expressed by the absence of NO_BATCHING."""
     return bool(behavior & flag)
 
 
