@@ -59,6 +59,11 @@ def has_behavior(behavior: int, flag: Behavior) -> bool:
     return bool(behavior & flag)
 
 
+def set_behavior(behavior: int, flag: Behavior, on: bool) -> int:
+    """Set or clear a behavior flag (reference gubernator.go:781-788)."""
+    return behavior | flag if on else behavior & ~flag
+
+
 @dataclass
 class RateLimitReq:
     """A single rate limit check (reference gubernator.proto:137-183)."""
