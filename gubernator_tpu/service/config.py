@@ -58,6 +58,10 @@ class DaemonConfig:
     discovery: str = "static"
     dns_fqdn: str = ""
     dns_interval_s: float = 300.0
+    # member-list (gossip) backend (reference memberlist.go knobs)
+    gossip_bind: str = ""  # UDP host:port; port 0 = ephemeral
+    gossip_seeds: List[str] = dataclasses.field(default_factory=list)
+    gossip_interval_s: float = 1.0
 
     # Peer picker tuning (reference config.go:421-443)
     peer_picker_hash: str = "fnv1"

@@ -154,8 +154,19 @@ class Daemon:
         elif conf.discovery == "static":
             if conf.peers:
                 self._pool = StaticPool(conf.peers, self.set_peers)
+        elif conf.discovery == "member-list":
+            from gubernator_tpu.service.discovery import GossipPool
+
+            self._pool = GossipPool(
+                bind=conf.gossip_bind or "127.0.0.1:0",
+                info=self.svc.local_info,
+                on_update=self.set_peers,
+                seeds=conf.gossip_seeds,
+                interval_s=conf.gossip_interval_s,
+            )
+            await self._pool.started()  # resolve the ephemeral bind
         elif conf.discovery in POOLS:
-            # gated backends (etcd/k8s/member-list) raise a clear error
+            # gated backends (etcd/k8s) raise a clear error
             self._pool = POOLS[conf.discovery](on_update=self.set_peers)
         else:
             raise ValueError(f"unknown peer discovery type: {conf.discovery!r}")

@@ -9,10 +9,12 @@ daemon.go:208-243). Same shape here:
 - StaticPool: fixed peer list (tests, config-driven clusters).
 - DnsPool: polls A/AAAA records via the stdlib resolver on an interval;
   each address becomes a peer at fixed ports (reference dns.go:130-218).
-- EtcdPool / K8sPool / MemberListPool: gated — their client libraries
-  are not in this image; constructing one raises a clear error naming
-  the missing dependency. The watch/lease/gossip protocols are
-  documented seams for when the dependency is available.
+- GossipPool ("member-list"): dependency-free UDP gossip membership —
+  the memberlist-style backend implemented on stdlib asyncio.
+- EtcdPool / K8sPool: gated — their client libraries are not in this
+  image; constructing one raises a clear error naming the missing
+  dependency. The watch/lease protocols are documented seams for when
+  the dependency is available.
 
 The JAX device mesh is static per process, so discovery governs the
 *host* layer only; a mesh reconfiguration is a restart/resharding event
@@ -98,6 +100,205 @@ class DnsPool:
             self._task.cancel()
 
 
+class GossipPool:
+    """Zero-dependency gossip membership (the memberlist-style backend,
+    reference memberlist.go:38-299, reimagined on stdlib asyncio UDP).
+
+    Each node carries its own PeerInfo in its gossip state and
+    periodically sends its full membership view (JSON datagram) to a few
+    random peers plus the configured seed nodes; receivers merge views
+    and refresh liveness. Peers unseen for `expire_intervals` gossip
+    rounds are dropped. Every membership change pushes the full PeerInfo
+    list through on_update -> SetPeers, like every other pool.
+
+    This is a simplified SWIM cousin (push-only, no indirect probes or
+    suspicion states) — adequate for LAN clusters; swap in a hardened
+    implementation behind the same OnUpdate contract for hostile
+    networks.
+    """
+
+    def __init__(
+        self,
+        bind: str,  # "host:port" UDP listen address (wildcards/port 0 ok)
+        info: PeerInfo,  # advertised service addresses
+        on_update: OnUpdate,
+        seeds: Sequence[str] = (),  # known gossip addresses
+        interval_s: float = 1.0,
+        expire_intervals: int = 5,
+        fanout: int = 3,
+        advertise: str = "",  # reachable gossip identity; derived if empty
+    ):
+        import json as _json
+        import random as _random
+
+        self._json = _json
+        self._random = _random
+        self.bind = bind
+        self.advertise = advertise
+        self.info = info
+        self.on_update = on_update
+        self.seeds = [s for s in seeds if s]
+        self.interval_s = interval_s
+        self.expire_s = interval_s * expire_intervals
+        self.fanout = fanout
+        # gossip_addr -> {"info": PeerInfo, "seen": monotonic}
+        self._peers = {}
+        self._last_pushed = None
+        self._transport = None
+        self._task = None
+        self._running = True
+        self._started = asyncio.ensure_future(self._start())
+
+    async def _start(self) -> None:
+        import time as _time
+
+        from gubernator_tpu.utils.net import resolve_host_ip
+
+        loop = asyncio.get_running_loop()
+        host, port = self.bind.rsplit(":", 1)
+
+        pool = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                pool._receive(data)
+
+        self._transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(host, int(port))
+        )
+        if not self._running:  # closed before the bind completed
+            self._transport.close()
+            return
+        # Gossip identity must be REACHABLE: actual bound port, wildcard
+        # host expanded to a real interface IP (the reference memberlist's
+        # separate advertise address).
+        actual = self._transport.get_extra_info("sockname")
+        self.bind = f"{host}:{actual[1]}"
+        if not self.advertise:
+            self.advertise = resolve_host_ip(self.bind)
+        self.seeds = [s for s in self.seeds if s != self.advertise]
+        self._peers[self.advertise] = {"info": self.info, "seen": _time.monotonic()}
+        self._push()
+        self._task = asyncio.ensure_future(self._loop())
+
+    def _encode(self) -> bytes:
+        import time as _time
+
+        now = _time.monotonic()
+        peers = {
+            addr: {
+                "grpc": st["info"].grpc_address,
+                "http": st["info"].http_address,
+                "dc": st["info"].data_center,
+                # freshness: how long ago this node heard from the peer,
+                # so receivers get accurate indirect liveness (prevents
+                # membership flapping in clusters larger than the fanout)
+                "age": round(now - st["seen"], 3),
+            }
+            for addr, st in self._peers.items()
+        }
+        return self._json.dumps({"from": self.advertise, "peers": peers}).encode()
+
+    def _receive(self, data: bytes) -> None:
+        import time as _time
+
+        try:
+            msg = self._json.loads(data)
+            if not isinstance(msg, dict):
+                return
+            now = _time.monotonic()
+            sender = msg.get("from")
+            changed = False
+            peers = msg.get("peers")
+            if not isinstance(peers, dict):
+                return
+            for addr, p in peers.items():
+                if addr == self.advertise or not isinstance(p, dict):
+                    continue
+                age = float(p.get("age", 0) or 0)
+                # indirect liveness: the sender saw this peer `age` ago;
+                # one transit interval of slack
+                seen = now - age - self.interval_s
+                if addr == sender:
+                    seen = now
+                info = PeerInfo(
+                    grpc_address=str(p.get("grpc", "")),
+                    http_address=str(p.get("http", "")),
+                    data_center=str(p.get("dc", "")),
+                )
+                st = self._peers.get(addr)
+                if st is None:
+                    self._peers[addr] = {"info": info, "seen": seen}
+                    changed = True
+                else:
+                    st["seen"] = max(st["seen"], seen)
+                    if st["info"] != info:
+                        # peer restarted with new service addresses
+                        st["info"] = info
+                        changed = True
+            if changed:
+                self._push()
+        except Exception:
+            return  # malformed/hostile datagrams must never escape
+
+    async def _loop(self) -> None:
+        import time as _time
+
+        while self._running:
+            await asyncio.sleep(self.interval_s)
+            now = _time.monotonic()
+            # expire silent peers
+            expired = [
+                a
+                for a, st in self._peers.items()
+                if a != self.advertise and now - st["seen"] > self.expire_s
+            ]
+            for a in expired:
+                del self._peers[a]
+            if expired:
+                self._push()
+            # gossip to a few random peers + seeds
+            targets = set(self.seeds)
+            others = [a for a in self._peers if a != self.advertise]
+            if others:
+                targets.update(
+                    self._random.sample(others, min(self.fanout, len(others)))
+                )
+            payload = self._encode()
+            for t in targets:
+                try:
+                    host, port = t.rsplit(":", 1)
+                    self._transport.sendto(payload, (host, int(port)))
+                except Exception:
+                    pass
+
+    def _push(self) -> None:
+        members = sorted(
+            (st["info"] for st in self._peers.values()),
+            key=lambda p: p.grpc_address,
+        )
+        snapshot = [(p.grpc_address, p.http_address, p.data_center) for p in members]
+        if snapshot != self._last_pushed:
+            self._last_pushed = snapshot
+            self.on_update(list(members))
+
+    async def started(self) -> "GossipPool":
+        """Await the UDP endpoint bind (resolves the ephemeral port)."""
+        await self._started
+        return self
+
+    def members(self) -> List[PeerInfo]:
+        return [st["info"] for st in self._peers.values()]
+
+    def close(self) -> None:
+        self._running = False
+        self._started.cancel()
+        if self._task is not None:
+            self._task.cancel()
+        if self._transport is not None:
+            self._transport.close()
+
+
 def _gated(name: str, dep: str):
     class _Gated:
         def __init__(self, *a, **kw):
@@ -111,16 +312,16 @@ def _gated(name: str, dep: str):
     return _Gated
 
 
-# Gated backends (reference etcd.go:42-352, kubernetes.go:35-247,
-# memberlist.go:38-299): same OnUpdate contract once their deps exist.
+# Gated backends (reference etcd.go:42-352, kubernetes.go:35-247): same
+# OnUpdate contract once their deps exist. The memberlist role is served
+# by the dependency-free GossipPool above.
 EtcdPool = _gated("EtcdPool", "etcd3")
 K8sPool = _gated("K8sPool", "kubernetes")
-MemberListPool = _gated("MemberListPool", "memberlist/SWIM")
 
 POOLS = {
     "static": StaticPool,
     "dns": DnsPool,
+    "member-list": GossipPool,
     "etcd": EtcdPool,
     "k8s": K8sPool,
-    "member-list": MemberListPool,
 }

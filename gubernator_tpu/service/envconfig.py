@@ -124,6 +124,13 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     conf.discovery = _env("GUBER_PEER_DISCOVERY_TYPE", "static")
     conf.dns_fqdn = _env("GUBER_DNS_FQDN", "")
     conf.dns_interval_s = parse_duration_s(_env("GUBER_DNS_POLL_INTERVAL"), 300.0)
+    # member-list / gossip (reference GUBER_MEMBERLIST_* envs)
+    conf.gossip_bind = _env("GUBER_MEMBERLIST_ADDRESS", "")
+    known = _env("GUBER_MEMBERLIST_KNOWN_NODES", "")
+    conf.gossip_seeds = [n.strip() for n in known.split(",") if n.strip()]
+    conf.gossip_interval_s = parse_duration_s(
+        _env("GUBER_MEMBERLIST_GOSSIP_INTERVAL"), 1.0
+    )
 
     conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1")
     conf.hash_replicas = _env_int("GUBER_REPLICATED_HASH_REPLICAS", 512)

@@ -1,0 +1,121 @@
+"""Gossip (member-list) discovery: convergence, failure expiry, and a
+gossip-discovered daemon cluster end-to-end."""
+
+import asyncio
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import PeerInfo, Status
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+from gubernator_tpu.service.discovery import GossipPool
+
+
+def test_gossip_pool_convergence_and_expiry(loop_thread):
+    async def run():
+        updates = {0: [], 1: [], 2: []}
+        pools = []
+
+        def on_update(i):
+            return lambda peers: updates[i].append([p.grpc_address for p in peers])
+
+        # First node; others seed off its (ephemeral) bind address.
+        p0 = GossipPool(
+            "127.0.0.1:0",
+            PeerInfo(grpc_address="g0:81"),
+            on_update(0),
+            interval_s=0.05,
+        )
+        await p0._started
+        for i in (1, 2):
+            p = GossipPool(
+                "127.0.0.1:0",
+                PeerInfo(grpc_address=f"g{i}:81"),
+                on_update(i),
+                seeds=[p0.advertise],
+                interval_s=0.05,
+            )
+            await p._started
+            pools.append(p)
+        pools.insert(0, p0)
+
+        # All three converge to the full membership.
+        deadline = time.monotonic() + 5
+        want = {"g0:81", "g1:81", "g2:81"}
+        while time.monotonic() < deadline:
+            if all(
+                {p.grpc_address for p in pool.members()} == want for pool in pools
+            ):
+                break
+            await asyncio.sleep(0.05)
+        for pool in pools:
+            assert {p.grpc_address for p in pool.members()} == want
+        assert updates[1] and updates[1][-1] == sorted(want)
+
+        # Node 2 dies; the others expire it.
+        pools[2].close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(
+                {p.grpc_address for p in pool.members()} == {"g0:81", "g1:81"}
+                for pool in pools[:2]
+            ):
+                break
+            await asyncio.sleep(0.05)
+        for pool in pools[:2]:
+            assert {p.grpc_address for p in pool.members()} == {"g0:81", "g1:81"}
+
+        for pool in pools[:2]:
+            pool.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=30)
+
+
+def test_gossip_discovered_daemon_cluster(loop_thread):
+    """Daemons that find each other purely via gossip route to one owner."""
+
+    async def start():
+        d0 = await Daemon.spawn(
+            DaemonConfig(
+                cache_size=2048, discovery="member-list",
+                gossip_bind="127.0.0.1:0", gossip_interval_s=0.05,
+            )
+        )
+        seed = d0._pool.advertise
+        d1 = await Daemon.spawn(
+            DaemonConfig(
+                cache_size=2048, discovery="member-list",
+                gossip_bind="127.0.0.1:0", gossip_seeds=[seed],
+                gossip_interval_s=0.05,
+            )
+        )
+        return d0, d1
+
+    d0, d1 = loop_thread.run(start(), timeout=120)
+    try:
+        # wait until both daemons see both peers
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if all(len(d.svc.picker.peers()) == 2 for d in (d0, d1)):
+                break
+            time.sleep(0.05)
+        assert all(len(d.svc.picker.peers()) == 2 for d in (d0, d1))
+
+        async def hit(d):
+            msg = pb.pb.GetRateLimitsReq()
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name="gsp", unique_key="k", duration=60_000, limit=10, hits=2
+                )
+            )
+            return (await d.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+        r1 = loop_thread.run(hit(d0))
+        r2 = loop_thread.run(hit(d1))
+        assert (r1.remaining, r2.remaining) == (8, 6)  # one shared owner
+    finally:
+        loop_thread.run(d0.close())
+        loop_thread.run(d1.close())
