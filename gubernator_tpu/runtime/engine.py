@@ -444,10 +444,18 @@ class DeviceEngine(EngineBase):
         outs = []
         with self._lock:
             table = self.table
-            for wb in waves:
-                table, out = decide(table, wb, now, ways=cfg.ways)
-                outs.append(out)
-            self.table = table
+            try:
+                for wb in waves:
+                    table, out = decide(table, wb, now, ways=cfg.ways)
+                    outs.append(out)
+                self.table = table
+            except Exception:
+                # A failed jitted call may have consumed the donated table
+                # buffers; recover so the engine keeps serving (counter
+                # loss on failure matches the reference's accepted
+                # cache-loss-on-restart semantics, docs/architecture.md:5-11).
+                self._recover_table_locked()
+                raise
 
         # Materialize results (one host sync per wave) and demux.
         host = [
@@ -528,6 +536,19 @@ class DeviceEngine(EngineBase):
             )
         if changes:
             self.store.on_change(changes)
+
+    def _recover_table_locked(self) -> None:
+        """Called with the lock held after a failed device call: if the
+        donated table buffers were consumed, rebuild an empty table so
+        subsequent requests serve instead of failing forever."""
+        try:
+            deleted = getattr(self.table.key_hi, "is_deleted", lambda: False)()
+        except Exception:
+            deleted = True
+        if deleted:
+            self.table = SlotTable.create(self.cfg.num_groups, self.cfg.ways)
+            self._key_strings.clear()
+            self._invalid_at.clear()
 
     # ---- direct state injection (AddCacheItem analog) ----------------------
 

@@ -12,6 +12,28 @@ from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
 NOW = 1_753_700_000_000
 
 
+def test_engine_recovers_after_table_loss():
+    """If a failed device call consumes the donated table buffers, the
+    engine rebuilds an empty table and keeps serving (counter loss on
+    failure = the reference's accepted cache-loss semantics)."""
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 9, batch_size=32, batch_wait_s=0.001),
+        now_fn=lambda: NOW,
+    )
+    try:
+        assert eng.check_batch([RateLimitReq(name="r", unique_key="k", duration=60_000, limit=10, hits=4)])[0].remaining == 6
+        # Simulate a runtime failure that consumed the table buffers.
+        with eng._lock:
+            for leaf in eng.table:
+                leaf.delete()
+            eng._recover_table_locked()
+        rl = eng.check_batch([RateLimitReq(name="r", unique_key="k", duration=60_000, limit=10, hits=1)])[0]
+        assert rl.error == ""
+        assert rl.remaining == 9  # fresh bucket after recovery
+    finally:
+        eng.close()
+
+
 def test_engine_concurrent_mixed_operations():
     eng = DeviceEngine(
         EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.001),
