@@ -57,6 +57,11 @@ class V1Service:
         self.forwarder = None  # PeerForwarder for non-owner items
         self.global_mgr = None  # GlobalManager for GLOBAL behavior
         self._peers_lock = asyncio.Lock()
+        # pre-resolved metric children (labels() lookups are hot-loop cost)
+        m = self.metrics
+        self._m_local = m.getratelimit_counter.labels("local")
+        self._m_global = m.getratelimit_counter.labels("global")
+        self._m_forward = m.getratelimit_counter.labels("forward")
 
     # ---- V1.GetRateLimits (reference gubernator.go:183-309) ----------------
 
@@ -90,6 +95,7 @@ class V1Service:
 
         from gubernator_tpu.api.types import validate_request
 
+        GLOBAL = int(Behavior.GLOBAL)  # plain-int flag tests in the hot loop
         for i, req in enumerate(reqs):
             err = validate_request(req)
             if err is not None:
@@ -99,7 +105,7 @@ class V1Service:
             if req.created_at is None or req.created_at == 0:
                 req.created_at = now
             if self.force_global:
-                req.behavior |= Behavior.GLOBAL
+                req.behavior |= GLOBAL
 
             key = req.hash_key()
             try:
@@ -112,16 +118,14 @@ class V1Service:
                 continue
 
             if peer.info.is_owner:
-                m.getratelimit_counter.labels("local").inc()
+                self._m_local.inc()
                 local_items.append((i, req))
-                if self.global_mgr is not None and has_behavior(
-                    req.behavior, Behavior.GLOBAL
-                ):
+                if self.global_mgr is not None and (req.behavior & GLOBAL):
                     # Owner-side GLOBAL update broadcast queue
                     # (reference gubernator.go:603-606)
                     self.global_mgr.queue_update(req)
-            elif has_behavior(req.behavior, Behavior.GLOBAL):
-                m.getratelimit_counter.labels("global").inc()
+            elif req.behavior & GLOBAL:
+                self._m_global.inc()
                 local_idx.append(i)
                 local_futs.append(
                     asyncio.ensure_future(
@@ -129,7 +133,7 @@ class V1Service:
                     )
                 )
             else:
-                m.getratelimit_counter.labels("forward").inc()
+                self._m_forward.inc()
                 forward_tasks.append(
                     (i, asyncio.ensure_future(self._forward(peer, req)))
                 )
