@@ -49,8 +49,10 @@ def span(name: str, **attributes):
 def propagate_inject(metadata: Dict[str, str]) -> Dict[str, str]:
     """Inject current trace context into a rate limit's metadata map
     (reference MetadataCarrier inject side). Fast-path: skip the
-    propagator machinery entirely when no span is recording (~6µs/item
-    otherwise, pure overhead without an SDK)."""
+    propagator machinery entirely when no span context is active
+    (~6µs/item otherwise, pure overhead without an SDK). NOTE: this
+    also skips non-trace propagators (e.g. baggage) in the no-span
+    case; configure tracing if baggage-only propagation matters."""
     if _OTEL:
         try:
             if not _otel_trace.get_current_span().get_span_context().is_valid:

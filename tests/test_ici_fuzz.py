@@ -1,0 +1,195 @@
+"""Differential fuzz of the ICI GLOBAL collective against an independent
+Python model of its spec (replica decide + pending deltas + sync merge:
+owner apply, key-checked delta summing, adoption, rebroadcast, eviction
+pending-drop). Small tables force slot collisions; random time advances
+force expiry paths."""
+
+import random
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.keys import group_of, key_hash128
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.models.oracle import OracleEngine
+from gubernator_tpu.ops.encode import encode_batch
+from gubernator_tpu.parallel import ici
+from gubernator_tpu.parallel import mesh as pmesh
+
+import jax
+
+NOW = 1_753_700_000_000
+NDEV = 4
+SLOTS_PER = 8
+NUM_SLOTS = NDEV * SLOTS_PER
+
+
+class IciModel:
+    """Spec model: one OracleEngine per device (replica semantics) plus a
+    slot-occupancy map per device (ways=1 direct-mapped eviction) and
+    per-device pending deltas. Sync implements the documented merge."""
+
+    def __init__(self):
+        self.oracles = [OracleEngine() for _ in range(NDEV)]
+        # device -> slot -> hash_key occupying it
+        self.slot_key = [dict() for _ in range(NDEV)]
+        self.pending = [dict() for _ in range(NDEV)]  # slot -> hits
+
+    @staticmethod
+    def slot_of(hash_key: str) -> int:
+        return group_of(key_hash128(hash_key)[1], NUM_SLOTS)
+
+    def decide(self, req: RateLimitReq, home: int, now: int):
+        import dataclasses
+
+        key = req.hash_key()
+        slot = self.slot_of(key)
+        ora = self.oracles[home]
+        prev = self.slot_key[home].get(slot)
+        if prev is not None and prev != key:
+            # direct-mapped eviction: drop the old entry and its un-synced
+            # pending deltas
+            ora.cache.pop(prev, None)
+            self.pending[home].pop(slot, None)
+        self.slot_key[home][slot] = key
+        resp = ora.decide(dataclasses.replace(req, metadata={}), now)
+        owned = slot // SLOTS_PER == home
+        if not owned and req.hits != 0:
+            self.pending[home][slot] = self.pending[home].get(slot, 0) + req.hits
+        return resp
+
+    def sync(self, now: int):
+        from gubernator_tpu.models.bucket import FIXED_SHIFT
+
+        new_entries = {}  # slot -> (key, CacheEntry-like copy) or None
+        for slot in range(NUM_SLOTS):
+            owner_dev = slot // SLOTS_PER
+            def live(dev):
+                k = self.slot_key[dev].get(slot)
+                if k is None:
+                    return None
+                item = self.oracles[dev].cache.get(k)
+                if item is None or item.expire_at < now:
+                    return None
+                return k, item
+
+            owner = live(owner_dev)
+            if owner is not None:
+                okey, oitem = owner
+                inc = sum(
+                    self.pending[d].get(slot, 0)
+                    for d in range(NDEV)
+                    if live(d) is not None and live(d)[0] == okey
+                )
+                base_key, base_item = okey, oitem
+            else:
+                # adoption: lowest device with live entry AND pending != 0
+                sel = None
+                for d in range(NDEV):
+                    lv = live(d)
+                    if lv is not None and self.pending[d].get(slot, 0) != 0:
+                        sel = d
+                        break
+                if sel is None:
+                    new_entries[slot] = None
+                    continue
+                akey, aitem = live(sel)
+                inc_total = sum(
+                    self.pending[d].get(slot, 0)
+                    for d in range(NDEV)
+                    if live(d) is not None and live(d)[0] == akey
+                )
+                inc = inc_total - self.pending[sel].get(slot, 0)
+                base_key, base_item = akey, aitem
+
+            import copy
+
+            item = copy.deepcopy(base_item)
+            if inc != 0:
+                st = item.value
+                if item.algorithm == Algorithm.LEAKY_BUCKET:
+                    st.remaining_s = max(st.remaining_s - (inc << FIXED_SHIFT), 0)
+                else:
+                    st.remaining = max(st.remaining - inc, 0)
+            new_entries[slot] = (base_key, item)
+
+        # rebroadcast: every device's slot takes the merged entry
+        import copy
+
+        for d in range(NDEV):
+            self.pending[d].clear()
+            for slot in range(NUM_SLOTS):
+                old_key = self.slot_key[d].pop(slot, None)
+                if old_key is not None:
+                    self.oracles[d].cache.pop(old_key, None)
+                ent = new_entries[slot]
+                if ent is not None:
+                    k, item = ent
+                    self.slot_key[d][slot] = k
+                    self.oracles[d].cache[k] = copy.deepcopy(item)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_ici_sync_matches_model(seed):
+    mesh = pmesh.make_mesh(jax.devices()[:NDEV])
+    state = ici.create_ici_state(mesh, NUM_SLOTS)
+    replica_fn = ici.make_replica_decide(mesh, NUM_SLOTS)
+    sync_fn = ici.make_sync_step(mesh, NUM_SLOTS)
+    model = IciModel()
+
+    rng = random.Random(seed)
+    keys = [f"fz:{i}" for i in range(20)]  # 20 keys on 32 slots: collisions
+    now = NOW
+
+    for step in range(250):
+        r = rng.random()
+        if r < 0.75:
+            key = rng.choice(keys)
+            home = rng.randrange(NDEV)
+            req = RateLimitReq(
+                name="z",
+                unique_key=key,
+                algorithm=rng.choice(
+                    [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                ),
+                behavior=Behavior.GLOBAL,
+                duration=rng.choice([500, 5_000, 60_000]),
+                limit=rng.choice([3, 10, 100]),
+                hits=rng.choice([-2, 0, 1, 1, 2, 5, 50]),
+            )
+            import dataclasses
+
+            b = encode_batch([dataclasses.replace(req)], now, NUM_SLOTS, 2)
+            hm = np.full((2,), home, dtype=np.int64)
+            state, out = replica_fn(state, b, hm, now)
+            want = model.decide(req, home, now)
+            got = (int(out.status[0]), int(out.remaining[0]), int(out.reset_time[0]))
+            assert got == (int(want.status), int(want.remaining), int(want.reset_time)), (
+                f"seed {seed} step {step} key {key} home {home}: {got} != "
+                f"{(int(want.status), int(want.remaining), int(want.reset_time))}"
+            )
+        elif r < 0.9:
+            state = sync_fn(state, now)
+            model.sync(now)
+        else:
+            now += rng.choice([1, 100, 1_000, 10_000])
+
+    # final sync then full read-back comparison on every device
+    state = sync_fn(state, now)
+    model.sync(now)
+    import dataclasses
+
+    for key in keys:
+        for d in range(NDEV):
+            req = RateLimitReq(
+                name="z", unique_key=key, behavior=Behavior.GLOBAL,
+                duration=60_000, limit=100, hits=0,
+            )
+            b = encode_batch([dataclasses.replace(req)], now, NUM_SLOTS, 2)
+            hm = np.full((2,), d, dtype=np.int64)
+            state, out = replica_fn(state, b, hm, now)
+            want = model.decide(dataclasses.replace(req), d, now)
+            got = (int(out.status[0]), int(out.remaining[0]))
+            assert got == (int(want.status), int(want.remaining)), (
+                f"seed {seed} final key {key} dev {d}"
+            )
