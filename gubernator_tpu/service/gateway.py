@@ -27,7 +27,19 @@ def build_app(svc: V1Service) -> web.Application:
                 {"code": 3, "message": f"invalid JSON: {e}"}, status=400
             )
         items = body.get("requests") or []
-        reqs = [pb.req_from_json(d) for d in items]
+        if not isinstance(items, list) or not all(
+            isinstance(d, dict) for d in items
+        ):
+            return web.json_response(
+                {"code": 3, "message": "'requests' must be a list of objects"},
+                status=400,
+            )
+        try:
+            reqs = [pb.req_from_json(d) for d in items]
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"code": 3, "message": f"invalid request: {e}"}, status=400
+            )
         try:
             out = await svc.get_rate_limits(reqs)
         except ApiError as e:

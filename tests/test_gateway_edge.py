@@ -1,0 +1,80 @@
+"""HTTP gateway input edge cases: type coercions, enum names, missing
+fields, camelCase acceptance — the public JSON surface must be tolerant
+on input and snake_case-exact on output."""
+
+import pytest
+import requests
+
+from gubernator_tpu.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def addr(loop_thread):
+    c = loop_thread.run(Cluster.start(1, cache_size=2048), timeout=120)
+    yield c.peer_at(0).http_address
+    loop_thread.run(c.stop())
+
+
+def post(addr, body):
+    return requests.post(
+        f"http://{addr}/v1/GetRateLimits", json=body, timeout=10
+    )
+
+
+def test_numbers_as_strings(addr):
+    # proto3 JSON allows int64 as strings; the gateway must accept both
+    r = post(addr, {"requests": [{
+        "name": "ge", "unique_key": "s1", "duration": "60000",
+        "limit": "10", "hits": "3"}]})
+    assert r.status_code == 200
+    assert r.json()["responses"][0]["remaining"] == "7"
+
+
+def test_camel_case_accepted_snake_emitted(addr):
+    r = post(addr, {"requests": [{
+        "name": "ge", "uniqueKey": "c1", "duration": 60000,
+        "limit": 5, "hits": 1, "createdAt": 1_753_700_000_000}]})
+    assert r.status_code == 200
+    body = r.json()["responses"][0]
+    assert body["remaining"] == "4"
+    assert "reset_time" in body  # snake_case out
+
+def test_enum_names(addr):
+    r = post(addr, {"requests": [{
+        "name": "ge", "unique_key": "e1", "duration": 60000,
+        "limit": 10, "hits": 1, "algorithm": "LEAKY_BUCKET",
+        "behavior": "DRAIN_OVER_LIMIT"}]})
+    assert r.status_code == 200
+    assert r.json()["responses"][0]["status"] == "UNDER_LIMIT"
+
+
+def test_empty_requests(addr):
+    r = post(addr, {"requests": []})
+    assert r.status_code == 200
+    assert r.json()["responses"] == []
+    r = post(addr, {})
+    assert r.status_code == 200
+    assert r.json()["responses"] == []
+
+
+def test_null_and_junk_fields(addr):
+    r = post(addr, {"requests": [{
+        "name": "ge", "unique_key": "n1", "duration": None,
+        "limit": 10, "hits": None, "junk_field": {"x": 1}}]})
+    assert r.status_code == 200
+    body = r.json()["responses"][0]
+    assert body.get("error", "") == ""
+    assert body["remaining"] == "10"  # hits None -> 0 (status read)
+
+
+def test_non_object_request_items(addr):
+    r = post(addr, {"requests": ["nonsense"]})
+    assert r.status_code == 400
+
+
+def test_metadata_round_trip(addr):
+    r = post(addr, {"requests": [{
+        "name": "ge", "unique_key": "m1", "duration": 60000,
+        "limit": 10, "hits": 1, "metadata": {"tenant": "abc"}}]})
+    assert r.status_code == 200
+    assert r.json()["responses"][0].get("error", "") == ""
