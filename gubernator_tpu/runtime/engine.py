@@ -396,6 +396,9 @@ class DeviceEngine(EngineBase):
             if fetched:
                 self.inject_snapshots(fetched)
 
+        if cfg.keep_key_strings:
+            self._maybe_prune_key_strings()
+
         asm = _WaveAssembler(RequestBatch.zeros, B)
         placements: List[Optional[Tuple[int, int]]] = []
         wave_rows: List[list] = []  # per-wave (req, hi, lo, grp) for bulk fill
@@ -536,6 +539,28 @@ class DeviceEngine(EngineBase):
             )
         if changes:
             self.store.on_change(changes)
+
+    def _maybe_prune_key_strings(self) -> None:
+        """Bound host memory: under key churn the hash->string dict keeps
+        entries for keys long evicted from the device table. When it
+        exceeds 2x the slot count, rebuild it from the table's live keys
+        (one device readback). Dropped strings only cost an extra store
+        read-through if the key returns; Loader snapshots stay complete
+        because live entries always retain their strings."""
+        n = self.cfg.num_groups * self.cfg.ways
+        if len(self._key_strings) <= max(2 * n, 4096):
+            return
+        with self._lock:
+            used = np.asarray(self.table.used)
+            hi = np.asarray(self.table.key_hi)[used]
+            lo = np.asarray(self.table.key_lo)[used]
+        live = set(zip(hi.tolist(), lo.tolist()))
+        self._key_strings = {
+            k: v for k, v in self._key_strings.items() if k in live
+        }
+        self._invalid_at = {
+            k: v for k, v in self._invalid_at.items() if k in live
+        }
 
     def _recover_table_locked(self) -> None:
         """Called with the lock held after a failed device call: if the

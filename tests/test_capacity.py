@@ -49,6 +49,31 @@ def test_eviction_under_pressure_keeps_serving():
         eng.close()
 
 
+def test_key_string_dict_bounded_under_churn():
+    """The host hash->string dict prunes to live table keys under churn
+    (bounded memory for long-lived daemons)."""
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=16, batch_size=64, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    try:
+        n_slots = 16 * 8
+        for round_ in range(80):
+            clock["now"] += 1
+            eng.check_batch([mk(f"churn:{round_}:{i}") for i in range(60)])
+        # 4800 distinct keys passed through 128 slots; dict stays bounded
+        # (threshold is max(2*slots, 4096) before a prune triggers)
+        assert len(eng._key_strings) <= max(2 * n_slots, 4096) + 64
+        # live keys keep their strings (snapshot completeness)
+        from gubernator_tpu.store.store import snapshots_from_engine
+
+        snaps = snapshots_from_engine(eng)
+        assert len(snaps) == eng.live_count()
+    finally:
+        eng.close()
+
+
 def test_eviction_prefers_expired_slots():
     eng = DeviceEngine(
         EngineConfig(num_groups=16, batch_size=64, batch_wait_s=0.001),
