@@ -321,6 +321,8 @@ class DeviceEngine(EngineBase):
         # store-set invalidation (reference cache.go:35-47)
         self._invalid_at: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()  # guards table swap (load/restore)
+        # guards the host key dictionaries (pump + executor threads)
+        self._keys_lock = threading.Lock()
 
         if config.max_waves < 1:
             raise ValueError("max_waves must be >= 1")
@@ -380,19 +382,24 @@ class DeviceEngine(EngineBase):
         # Read-through: consult the store for keys this process has never
         # seen, or whose store-set invalid_at deadline has passed
         # (reference algorithms.go:45-51 cache-miss path + cache.go:35-47
-        # invalidation contract, batched).
+        # invalidation contract, batched). Membership checks run under the
+        # keys lock; store I/O runs outside it.
         if self.store is not None and cfg.keep_key_strings:
+            need = []
+            with self._keys_lock:
+                for i, (req, _) in enumerate(items):
+                    hi, lo = int(hashes[0][i]), int(hashes[1][i])
+                    inv = self._invalid_at.get((hi, lo))
+                    if (hi, lo) not in self._key_strings or (
+                        inv is not None and inv != 0 and inv < now
+                    ):
+                        need.append((req, (hi, lo)))
+                        self._invalid_at.pop((hi, lo), None)
             fetched = []
-            for i, (req, _) in enumerate(items):
-                hi, lo = int(hashes[0][i]), int(hashes[1][i])
-                inv = self._invalid_at.get((hi, lo))
-                if (hi, lo) not in self._key_strings or (
-                    inv is not None and inv != 0 and inv < now
-                ):
-                    snap = self.store.get(req)
-                    if snap is not None:
-                        fetched.append(snap)
-                    self._invalid_at.pop((hi, lo), None)
+            for req, _k in need:
+                snap = self.store.get(req)
+                if snap is not None:
+                    fetched.append(snap)
             if fetched:
                 self.inject_snapshots(fetched)
 
@@ -407,10 +414,11 @@ class DeviceEngine(EngineBase):
         keep = cfg.keep_key_strings
 
         carry: List[Tuple[RateLimitReq, object]] = []
+        new_strings: Dict[Tuple[int, int], str] = {}
         for i, (req, fut) in enumerate(items):
             hi, lo = int(hashes[0][i]), int(hashes[1][i])
             if keep:
-                self._key_strings[(hi, lo)] = req.hash_key()
+                new_strings[(hi, lo)] = req.hash_key()
             grp = int(hashes[2][i])
             placed = asm.place(grp, cfg.max_waves)
             if placed is None:
@@ -437,6 +445,10 @@ class DeviceEngine(EngineBase):
                 wave_lanes[w].append(lane)
             asm.commit(w, grp)
             placements.append((w, lane, hi, lo))
+
+        if new_strings:
+            with self._keys_lock:
+                self._key_strings.update(new_strings)
 
         for w, rows in enumerate(wave_rows):
             if rows:
@@ -555,12 +567,13 @@ class DeviceEngine(EngineBase):
             hi = np.asarray(self.table.key_hi)[used]
             lo = np.asarray(self.table.key_lo)[used]
         live = set(zip(hi.tolist(), lo.tolist()))
-        self._key_strings = {
-            k: v for k, v in self._key_strings.items() if k in live
-        }
-        self._invalid_at = {
-            k: v for k, v in self._invalid_at.items() if k in live
-        }
+        with self._keys_lock:
+            self._key_strings = {
+                k: v for k, v in self._key_strings.items() if k in live
+            }
+            self._invalid_at = {
+                k: v for k, v in self._invalid_at.items() if k in live
+            }
 
     def _recover_table_locked(self) -> None:
         """Called with the lock held after a failed device call: if the
@@ -572,8 +585,9 @@ class DeviceEngine(EngineBase):
             deleted = True
         if deleted:
             self.table = SlotTable.create(self.cfg.num_groups, self.cfg.ways)
-            self._key_strings.clear()
-            self._invalid_at.clear()
+            with self._keys_lock:
+                self._key_strings.clear()
+                self._invalid_at.clear()
 
     # ---- direct state injection (AddCacheItem analog) ----------------------
 
@@ -619,15 +633,14 @@ class DeviceEngine(EngineBase):
         cfg = self.cfg
 
         asm = _WaveAssembler(InjectBatch.zeros, cfg.batch_size)
+        new_strings: Dict[Tuple[int, int], str] = {}
+        new_invalid: Dict[Tuple[int, int], Optional[int]] = {}
         for s in items:
             hi, lo = key_hash128(s.key)
             if cfg.keep_key_strings:
-                self._key_strings[(hi, lo)] = s.key
+                new_strings[(hi, lo)] = s.key
             inv = int(getattr(s, "invalid_at", 0))
-            if inv:
-                self._invalid_at[(hi, lo)] = inv
-            else:
-                self._invalid_at.pop((hi, lo), None)
+            new_invalid[(hi, lo)] = inv if inv else None
             grp = group_of(lo, cfg.num_groups)
             ib, w, lane = asm.place(grp)
             ib.key_hi[lane] = hi
@@ -645,6 +658,14 @@ class DeviceEngine(EngineBase):
             ib.active[lane] = True
             asm.commit(w, grp)
 
+        with self._keys_lock:
+            self._key_strings.update(new_strings)
+            for k, inv in new_invalid.items():
+                if inv is None:
+                    self._invalid_at.pop(k, None)
+                else:
+                    self._invalid_at[k] = inv
+
         with self._lock:
             table = self.table
             for ib in asm.waves:
@@ -659,7 +680,8 @@ class DeviceEngine(EngineBase):
         with self._lock:
             tbl = self.table
             host = {f: np.asarray(getattr(tbl, f)) for f in tbl._fields}
-        host["key_strings"] = dict(self._key_strings)
+        with self._keys_lock:
+            host["key_strings"] = dict(self._key_strings)
         return host
 
     def restore(self, snap: dict) -> None:

@@ -26,6 +26,11 @@ def build_app(svc: V1Service) -> web.Application:
             return web.json_response(
                 {"code": 3, "message": f"invalid JSON: {e}"}, status=400
             )
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"code": 3, "message": "request body must be a JSON object"},
+                status=400,
+            )
         items = body.get("requests") or []
         if not isinstance(items, list) or not all(
             isinstance(d, dict) for d in items
