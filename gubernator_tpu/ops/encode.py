@@ -10,12 +10,12 @@ distinct groups; this module just encodes.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
 from gubernator_tpu.api.keys import group_of, key_hash128
-from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, has_behavior
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq
 from gubernator_tpu.models.bucket import MAX_COUNT, MAX_DURATION_MS
 from gubernator_tpu.ops.layout import RequestBatch
 from gubernator_tpu.utils import gregorian as greg

@@ -18,8 +18,6 @@ per key) are identical to the reference's hash ring.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -37,7 +37,6 @@ from gubernator_tpu.api.types import (
     Behavior,
     RateLimitReq,
     RateLimitResp,
-    has_behavior,
     validate_request,
 )
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
