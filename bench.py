@@ -180,6 +180,68 @@ def _device_init_watchdog(timeout_s: float = 300.0):
     return done
 
 
+def bench_global() -> dict:
+    """BASELINE config (4): GLOBAL behavior on a 4-node cluster — load
+    spread across all nodes' replicas, async convergence to owners
+    (reference BenchmarkServer/GetRateLimits global + TestGlobalBehavior
+    semantics)."""
+    import asyncio
+
+    import jax
+
+    from gubernator_tpu.api.types import Behavior, RateLimitReq
+    from gubernator_tpu.client import GubernatorClient
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.service.config import BehaviorConfig
+
+    platform = jax.devices()[0].platform
+
+    async def run():
+        c = await Cluster.start(
+            4, behaviors=BehaviorConfig(global_sync_wait_s=0.1), cache_size=65536
+        )
+        clients = [GubernatorClient(d.grpc_address) for d in c.daemons]
+        try:
+            reqs = [
+                RateLimitReq(
+                    name="bench_global", unique_key=f"g{i % 2000}",
+                    behavior=Behavior.GLOBAL, duration=600_000,
+                    limit=10_000_000, hits=1,
+                )
+                for i in range(400)
+            ]
+            for cl in clients:
+                await cl.get_rate_limits(reqs[:100])  # warm all replicas
+            total = 0
+            t0 = time.perf_counter()
+
+            async def worker(cl, n):
+                nonlocal total
+                for _ in range(n):
+                    out = await cl.get_rate_limits(reqs)
+                    total += len(out)
+
+            # 3 concurrent clients per node, all four nodes
+            await asyncio.gather(
+                *(worker(cl, 6) for cl in clients for _ in range(3))
+            )
+            dt = time.perf_counter() - t0
+            return total / dt
+        finally:
+            for cl in clients:
+                await cl.close()
+            await c.stop()
+
+    tput = asyncio.run(run())
+    return {
+        "metric": f"GLOBAL 4-node cluster decisions/sec ({platform}, replica-local answers + async convergence)",
+        "value": round(tput, 0),
+        "unit": "decisions/s",
+        # aggregate across 4 nodes vs the per-node baseline: 4 x 4000/s
+        "vs_baseline": round(tput / 16_000.0, 1),
+    }
+
+
 def main() -> None:
     from gubernator_tpu.utils.platform import honor_env_platforms
 
@@ -187,10 +249,12 @@ def main() -> None:
 
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--mode", default="kernel", choices=("kernel", "engine", "server"),
+        "--mode", default="kernel",
+        choices=("kernel", "engine", "server", "global"),
         help="kernel: device decide throughput @1M keys (headline); "
         "engine: end-to-end host+device serving path; "
-        "server: full gRPC round trip",
+        "server: full gRPC round trip; "
+        "global: GLOBAL behavior on a 4-node cluster (BASELINE config 4)",
     )
     args, _ = parser.parse_known_args()
     init_done = _device_init_watchdog()
@@ -205,6 +269,9 @@ def main() -> None:
         return
     if args.mode == "server":
         print(json.dumps(bench_server()))
+        return
+    if args.mode == "global":
+        print(json.dumps(bench_global()))
         return
 
     from gubernator_tpu.ops import SlotTable, decide, decide_scan

@@ -70,7 +70,8 @@ class IciEngineConfig:
 
 class IciEngine(EngineBase):
     # GLOBAL-flagged requests are routed to the replica tier inside the
-    # engine; V1Service must not strip the flag (see _get_global_rate_limit)
+    # engine; V1Service must not strip the flag (see the GLOBAL bulk
+    # submission in server._get_rate_limits)
     routes_global_internally = True
 
     def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):

@@ -89,8 +89,7 @@ class V1Service:
         n = len(reqs)
         responses: List[Optional[RateLimitResp]] = [None] * n
         local_items: List[tuple] = []  # (idx, req) -> bulk engine submit
-        local_idx: List[int] = []
-        local_futs = []
+        global_items: List[tuple] = []  # (idx, req, owner_info) -> bulk
         forward_tasks = []
 
         from gubernator_tpu.api.types import validate_request
@@ -126,36 +125,57 @@ class V1Service:
                     self.global_mgr.queue_update(req)
             elif req.behavior & GLOBAL:
                 self._m_global.inc()
-                local_idx.append(i)
-                local_futs.append(
-                    asyncio.ensure_future(
-                        self._get_global_rate_limit(req, peer.info)
-                    )
-                )
+                global_items.append((i, req, peer.info))
             else:
                 self._m_forward.inc()
                 forward_tasks.append(
                     (i, asyncio.ensure_future(self._forward(peer, req)))
                 )
 
-        # One bulk submission (one queue entry, one future) for all
-        # owner-path items
+        # GLOBAL non-owner items: ONE bulk submission against the local
+        # replica (reference answers each from the local cache,
+        # gubernator.go:395-421 — per-item dispatch would force one engine
+        # flush per item via NO_BATCHING). Both bulks are SUBMITTED before
+        # either is awaited so the pump can coalesce them into one flush.
+        global_fut = None
+        if global_items:
+            import dataclasses
+
+            strip = not getattr(self.engine, "routes_global_internally", False)
+            bulk_reqs = []
+            for _, req, _owner in global_items:
+                r2 = dataclasses.replace(req, metadata=dict(req.metadata))
+                r2.behavior = req.behavior | Behavior.NO_BATCHING
+                if strip:
+                    r2.behavior &= ~Behavior.GLOBAL
+                bulk_reqs.append(r2)
+            global_fut = self.engine.check_bulk(bulk_reqs)
+
+        local_fut = None
         if local_items:
+            local_fut = self.engine.check_bulk([r for _, r in local_items])
+
+        if global_fut is not None:
             try:
-                results = await asyncio.wrap_future(
-                    self.engine.check_bulk([r for _, r in local_items])
-                )
+                results = await asyncio.wrap_future(global_fut)
+                for (i, req, owner), resp in zip(global_items, results):
+                    if self.global_mgr is not None:
+                        self.global_mgr.queue_hit(req)
+                    resp.metadata = {"owner": owner.grpc_address}
+                    responses[i] = resp
+            except Exception as e:
+                for i, _, _ in global_items:
+                    responses[i] = RateLimitResp(error=str(e))
+
+        if local_fut is not None:
+            try:
+                results = await asyncio.wrap_future(local_fut)
                 for (i, _), resp in zip(local_items, results):
                     responses[i] = resp
             except Exception as e:
                 for i, _ in local_items:
                     responses[i] = RateLimitResp(error=str(e))
 
-        for i, fut in zip(local_idx, local_futs):
-            try:
-                responses[i] = await fut
-            except Exception as e:
-                responses[i] = RateLimitResp(error=str(e))
         for i, task in forward_tasks:
             try:
                 responses[i] = await task
@@ -170,26 +190,6 @@ class V1Service:
         if self.picker is None or not self.picker.peers():
             return _LocalPeer(self.local_info)
         return self.picker.get(key)
-
-    # ---- GLOBAL non-owner path (reference gubernator.go:395-421) -----------
-
-    async def _get_global_rate_limit(
-        self, req: RateLimitReq, owner: PeerInfo
-    ) -> RateLimitResp:
-        import dataclasses
-
-        req2 = dataclasses.replace(req, metadata=dict(req.metadata))
-        req2.behavior = req.behavior | Behavior.NO_BATCHING
-        if not getattr(self.engine, "routes_global_internally", False):
-            # Reference semantics: answer from the local cache as if owner
-            # (gubernator.go:408-414). An IciEngine instead KEEPS the
-            # GLOBAL bit so the request lands on its replica tier.
-            req2.behavior &= ~Behavior.GLOBAL
-        resp = await asyncio.wrap_future(self.engine.check_async(req2))
-        if self.global_mgr is not None:
-            self.global_mgr.queue_hit(req)
-        resp.metadata = {"owner": owner.grpc_address}
-        return resp
 
     async def _forward(self, peer, req: RateLimitReq) -> RateLimitResp:
         if self.forwarder is None:
