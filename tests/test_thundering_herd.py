@@ -1,0 +1,57 @@
+"""Thundering herd: many concurrent clients hammering ONE key through a
+real cluster must lose zero updates (the reference's 100-way
+BenchmarkServer shape as an exactness test)."""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.api.types import RateLimitReq, Status
+from gubernator_tpu.client import GubernatorClient
+from gubernator_tpu.cluster import Cluster
+
+LIMIT = 1_000_000
+
+
+def test_thundering_herd_exact_consumption(loop_thread):
+    c = loop_thread.run(Cluster.start(3, cache_size=4096), timeout=120)
+
+    async def run():
+        clients = [GubernatorClient(d.grpc_address) for d in c.daemons]
+        per_client_calls, hits_per_call = 5, 7
+        n_tasks = 60  # 60 concurrent "clients" spread over 3 daemons
+
+        async def hammer(i):
+            cl = clients[i % len(clients)]
+            for _ in range(per_client_calls):
+                out = await cl.get_rate_limits(
+                    [
+                        RateLimitReq(
+                            name="herd", unique_key="one", duration=600_000,
+                            limit=LIMIT, hits=hits_per_call,
+                        )
+                    ]
+                )
+                assert out[0].error == ""
+                assert out[0].status == Status.UNDER_LIMIT
+
+        await asyncio.gather(*(hammer(i) for i in range(n_tasks)))
+
+        # exact total: no lost updates, no double counts
+        out = await clients[0].get_rate_limits(
+            [
+                RateLimitReq(
+                    name="herd", unique_key="one", duration=600_000,
+                    limit=LIMIT, hits=0,
+                )
+            ]
+        )
+        for cl in clients:
+            await cl.close()
+        return out[0].remaining
+
+    try:
+        remaining = loop_thread.run(run(), timeout=120)
+        assert remaining == LIMIT - 60 * 5 * 7
+    finally:
+        loop_thread.run(c.stop())
