@@ -306,6 +306,16 @@ class PeerMesh:
                 self.svc.metrics.batch_send_retries.inc()
                 peer = self.get(key)
 
+    def queued_batch_items(self) -> int:
+        """Total rate checks sitting in per-peer batch queues (the
+        gubernator_batch_queue_length gauge)."""
+        total = 0
+        for p in self._all.values():
+            q = p._queue
+            if q is not None:
+                total += q.qsize()
+        return total
+
     # -- health (reference gubernator.go:542-586) ----------------------------
 
     def record_error(self, msg: str) -> None:
@@ -349,6 +359,9 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     )
     svc.picker = mesh
     svc.forwarder = mesh
+    svc.metrics.add_sync(
+        lambda m, mesh=mesh: m.batch_queue_length.set(mesh.queued_batch_items())
+    )
     # Two-tier GLOBAL: the gRPC global manager always runs the HOST tier
     # (pod-to-pod hit aggregation + broadcast); in "ici" mode the engine's
     # collective sync thread additionally runs the device tier within the
