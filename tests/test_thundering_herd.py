@@ -18,37 +18,39 @@ def test_thundering_herd_exact_consumption(loop_thread):
 
     async def run():
         clients = [GubernatorClient(d.grpc_address) for d in c.daemons]
-        per_client_calls, hits_per_call = 5, 7
-        n_tasks = 60  # 60 concurrent "clients" spread over 3 daemons
+        try:
+            per_client_calls, hits_per_call = 5, 7
+            n_tasks = 60  # 60 concurrent "clients" spread over 3 daemons
 
-        async def hammer(i):
-            cl = clients[i % len(clients)]
-            for _ in range(per_client_calls):
-                out = await cl.get_rate_limits(
-                    [
-                        RateLimitReq(
-                            name="herd", unique_key="one", duration=600_000,
-                            limit=LIMIT, hits=hits_per_call,
-                        )
-                    ]
-                )
-                assert out[0].error == ""
-                assert out[0].status == Status.UNDER_LIMIT
+            async def hammer(i):
+                cl = clients[i % len(clients)]
+                for _ in range(per_client_calls):
+                    out = await cl.get_rate_limits(
+                        [
+                            RateLimitReq(
+                                name="herd", unique_key="one", duration=600_000,
+                                limit=LIMIT, hits=hits_per_call,
+                            )
+                        ]
+                    )
+                    assert out[0].error == ""
+                    assert out[0].status == Status.UNDER_LIMIT
 
-        await asyncio.gather(*(hammer(i) for i in range(n_tasks)))
+            await asyncio.gather(*(hammer(i) for i in range(n_tasks)))
 
-        # exact total: no lost updates, no double counts
-        out = await clients[0].get_rate_limits(
-            [
-                RateLimitReq(
-                    name="herd", unique_key="one", duration=600_000,
-                    limit=LIMIT, hits=0,
-                )
-            ]
-        )
-        for cl in clients:
-            await cl.close()
-        return out[0].remaining
+            # exact total: no lost updates, no double counts
+            out = await clients[0].get_rate_limits(
+                [
+                    RateLimitReq(
+                        name="herd", unique_key="one", duration=600_000,
+                        limit=LIMIT, hits=0,
+                    )
+                ]
+            )
+            return out[0].remaining
+        finally:
+            for cl in clients:
+                await cl.close()
 
     try:
         remaining = loop_thread.run(run(), timeout=120)
