@@ -39,6 +39,13 @@ from gubernator_tpu.models.bucket import (
 from gubernator_tpu.utils import gregorian as greg
 
 
+def _i64(x: int) -> int:
+    """Wrap to int64 like Go's arithmetic (and the kernel's): the spec is
+    bug-for-bug at adversarial extremes where products overflow."""
+    x &= (1 << 64) - 1
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
 @dataclass
 class CacheEntry:
     """Host-side mirror of the reference CacheItem (reference cache.go:29-41)."""
@@ -303,7 +310,7 @@ class OracleEngine:
                 status=Status.UNDER_LIMIT,
                 limit=b.limit,
                 remaining=rem,
-                reset_time=created_at + (b.limit - rem) * ri,
+                reset_time=_i64(created_at + (b.limit - rem) * ri),
             )
 
             self._on_change(r, item, is_owner)
@@ -319,7 +326,7 @@ class OracleEngine:
             if rem == r.hits:
                 b.remaining_s = 0
                 rl.remaining = 0
-                rl.reset_time = created_at + (rl.limit - 0) * ri
+                rl.reset_time = _i64(created_at + (rl.limit - 0) * ri)
                 return rl
 
             # Over the limit: no consumption unless DRAIN_OVER_LIMIT
@@ -337,7 +344,7 @@ class OracleEngine:
 
             b.remaining_s -= r.hits << FIXED_SHIFT
             rl.remaining = b.remaining_s >> FIXED_SHIFT
-            rl.reset_time = created_at + (rl.limit - rl.remaining) * ri
+            rl.reset_time = _i64(created_at + (rl.limit - rl.remaining) * ri)
             return rl
 
         return self._leaky_bucket_new_item(r, now_ms, is_owner)
@@ -367,14 +374,14 @@ class OracleEngine:
             status=Status.UNDER_LIMIT,
             limit=b.limit,
             remaining=r.burst - r.hits,
-            reset_time=created_at + (b.limit - (r.burst - r.hits)) * ri,
+            reset_time=_i64(created_at + (b.limit - (r.burst - r.hits)) * ri),
         )
 
         # First request over the burst (reference algorithms.go:469-477).
         if r.hits > r.burst:
             rl.status = Status.OVER_LIMIT
             rl.remaining = 0
-            rl.reset_time = created_at + (rl.limit - 0) * ri
+            rl.reset_time = _i64(created_at + (rl.limit - 0) * ri)
             b.remaining_s = 0
 
         item = CacheEntry(
