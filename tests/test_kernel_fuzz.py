@@ -189,6 +189,46 @@ def test_kernel_fuzz(seed):
 GREGORIAN_HOURS_SAFE = 1  # GREGORIAN_HOURS
 
 
+@pytest.mark.parametrize("seed", [100, 104])
+def test_kernel_fuzz_adversarial(seed):
+    """Extreme domain (caught an oracle/kernel int64-wrap divergence in
+    round 1): 2^40 durations, +/-2^30 hits, 2^31-1 limits, huge bursts."""
+    rng = random.Random(seed)
+    keys = [f"acct:{i}" for i in range(30)]
+    now = NOW
+    seq = []
+    for _ in range(500):
+        behavior = 0
+        if rng.random() < 0.08:
+            behavior |= Behavior.RESET_REMAINING
+        if rng.random() < 0.15:
+            behavior |= Behavior.DRAIN_OVER_LIMIT
+        if rng.random() < 0.10:
+            behavior |= Behavior.DURATION_IS_GREGORIAN
+        greg = behavior & Behavior.DURATION_IS_GREGORIAN
+        seq.append(
+            (
+                RateLimitReq(
+                    name=rng.choice(["a", "b"]),
+                    unique_key=rng.choice(keys),
+                    algorithm=rng.choice(
+                        [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                    ),
+                    behavior=behavior,
+                    duration=rng.choice([GREGORIAN_MINUTES, 1])
+                    if greg
+                    else rng.choice([0, 3, 1000, 30_000, 2**40]),
+                    limit=rng.choice([0, 1, 10, 2000, 2**31 - 1]),
+                    hits=rng.choice([-(2**30), -1, 0, 1, 5, 3000, 2**30]),
+                    burst=rng.choice([0, 5, 30, 2**30]),
+                ),
+                now,
+            )
+        )
+        now += rng.choice([0, 1, 50, 3000, 61_000, 10**7])
+    check_seq(seq)
+
+
 def test_kernel_batch_parallel_lanes():
     """Multiple distinct-group keys decided in one batched call must match
     per-key sequential oracle results."""
