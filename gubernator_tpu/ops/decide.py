@@ -383,12 +383,26 @@ def _decide_impl(table: SlotTable, batch: RequestBatch, now, *, ways: int):
     )
 
     act = batch.active
+    # Surface displaced occupants: a miss-path insert that overwrote a slot
+    # holding a different key (live or expired). The host must forget the
+    # displaced key so its next request takes the store read-through path.
+    old_hi = table.key_hi[slot]
+    old_lo = table.key_lo[slot]
+    displaced = (
+        act
+        & ~exists
+        & table.used[slot]
+        & ((old_hi != batch.key_hi) | (old_lo != batch.key_lo))
+    )
     out = DecideOutput(
         status=jnp.where(act, resp["status"], jnp.int8(0)),
         limit=jnp.where(act, batch.limit, 0),
         remaining=jnp.where(act, resp["remaining"], 0),
         reset_time=jnp.where(act, resp["reset_time"], 0),
         slot=idx,
+        evicted_hi=jnp.where(displaced, old_hi, 0),
+        evicted_lo=jnp.where(displaced, old_lo, 0),
+        freed=act & freed,
         hits=jnp.sum(act & exists),
         misses=jnp.sum(act & ~exists),
         unexpired_evictions=jnp.sum(evicts_live),
@@ -406,6 +420,31 @@ def decide(table: SlotTable, batch: RequestBatch, now, ways: int = 8):
 def make_decide(ways: int = 8):
     """Returns a decide fn closed over `ways` (for engines/benchmarks)."""
     return functools.partial(decide, ways=ways)
+
+
+@functools.partial(jax.jit, static_argnames=("ways",))
+def probe_exists(table: SlotTable, key_hi, key_lo, group, now, ways: int = 8):
+    """Ground-truth residency probe: True per lane iff the key has a LIVE
+    entry in its group (same lazy-expiry + invalidation semantics as the
+    decide kernel's match). The engine uses this right before each wave to
+    drive store read-through on actual table misses — the reference
+    consults the store on every cache miss (algorithms.go:45-51), and the
+    table, not host bookkeeping, is what defines a miss."""
+    now = jnp.asarray(now, dtype=I64)
+    grp_base = group.astype(I64) * ways
+    way_ix = grp_base[:, None] + jnp.arange(ways, dtype=I64)[None, :]
+    w_used = table.used[way_ix]
+    w_invalid = table.invalid_at[way_ix]
+    w_expired = w_used & (
+        (table.expire_at[way_ix] < now) | ((w_invalid != 0) & (w_invalid < now))
+    )
+    live = (
+        w_used
+        & ~w_expired
+        & (table.key_hi[way_ix] == key_hi[:, None])
+        & (table.key_lo[way_ix] == key_lo[:, None])
+    )
+    return jnp.any(live, axis=1)
 
 
 @jax.jit

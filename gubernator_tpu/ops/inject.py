@@ -81,14 +81,28 @@ def _inject_impl(table: SlotTable, items: InjectBatch, now, ways: int = 8):
         created_at=items.stamp,
         active=items.active,
     )
-    slot, _exists, _ev = _choose_slot(table, probe, now, ways)
+    slot, exists, _ev = _choose_slot(table, probe, now, ways)
     n = table.num_slots
     idx = jnp.where(items.active, slot, n)
+
+    # Surface displaced occupants (same contract as decide's evicted_hi/lo):
+    # an insert that overwrote a slot holding a different key. The host
+    # forgets those keys so their next request re-reads through the Store.
+    old_hi = table.key_hi[slot]
+    old_lo = table.key_lo[slot]
+    displaced = (
+        items.active
+        & ~exists
+        & table.used[slot]
+        & ((old_hi != items.key_hi) | (old_lo != items.key_lo))
+    )
+    evicted_hi = jnp.where(displaced, old_hi, 0)
+    evicted_lo = jnp.where(displaced, old_lo, 0)
 
     def upd(arr, val):
         return arr.at[idx].set(val, mode="drop")
 
-    return SlotTable(
+    new_table = SlotTable(
         key_hi=upd(table.key_hi, items.key_hi),
         key_lo=upd(table.key_lo, items.key_lo),
         used=upd(table.used, jnp.ones_like(items.active)),
@@ -103,9 +117,13 @@ def _inject_impl(table: SlotTable, items: InjectBatch, now, ways: int = 8):
         burst=upd(table.burst, items.burst),
         lru=upd(table.lru, jnp.broadcast_to(now, idx.shape)),
     )
+    return new_table, evicted_hi, evicted_lo
 
 
 @functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
 def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
-    """Jitted entry with donated table buffers."""
+    """Jitted entry with donated table buffers.
+
+    Returns (table', evicted_hi, evicted_lo): displaced occupant keys per
+    lane ((0,0) = none) so the host can invalidate its key dictionary."""
     return _inject_impl(table, items, now, ways=ways)

@@ -139,6 +139,17 @@ class DecideOutput(NamedTuple):
     remaining: jnp.ndarray  # (B,) int64
     reset_time: jnp.ndarray  # (B,) int64
     slot: jnp.ndarray  # (B,) int64 slot each lane touched (N for padding)
+    # Displaced occupant's key when this lane's insert evicted a DIFFERENT
+    # key from the slot ((0,0) = none). The host drops these from its
+    # key dictionary so the key's next request re-reads through the Store
+    # — the reference re-consults the store on every cache miss
+    # (reference algorithms.go:45-51), so eviction must not orphan the
+    # persisted counter.
+    evicted_hi: jnp.ndarray  # (B,) int64
+    evicted_lo: jnp.ndarray  # (B,) int64
+    # Slot freed by token-bucket RESET_REMAINING (the only path where the
+    # reference removes the persisted entry, algorithms.go:78-90).
+    freed: jnp.ndarray  # (B,) bool
     # metrics (scalars): cache hits, misses, unexpired evictions, over-limit
     hits: jnp.ndarray
     misses: jnp.ndarray

@@ -149,7 +149,7 @@ def make_inject_replicas(mesh: Mesh, num_slots: int):
 
         tbl = _squeeze(state.table)
         pending = state.pending[0]
-        tbl = _inject_impl(tbl, items, now, ways=1)
+        tbl, _ehi, _elo = _inject_impl(tbl, items, now, ways=1)
         # The authoritative push supersedes this pod's un-synced local
         # deltas for these slots (the host tier already carried them to
         # the owner); leaving them would re-apply the same hits at the

@@ -41,7 +41,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
-from gubernator_tpu.ops.decide import decide
+from gubernator_tpu.ops.decide import decide, gather_rows, probe_exists
 from gubernator_tpu.utils import clock as _clock
 
 
@@ -316,9 +316,6 @@ class DeviceEngine(EngineBase):
         self.metrics = EngineMetrics()
         self.store = None  # optional Store plugin (gubernator_tpu.store)
         self._key_strings: Dict[Tuple[int, int], str] = {}
-        # key -> invalid_at deadline; drives store re-fetch after a
-        # store-set invalidation (reference cache.go:35-47)
-        self._invalid_at: Dict[Tuple[int, int], int] = {}
         self._lock = threading.Lock()  # guards table swap (load/restore)
         # guards the host key dictionaries (pump + executor threads)
         self._keys_lock = threading.Lock()
@@ -344,7 +341,9 @@ class DeviceEngine(EngineBase):
         wb = RequestBatch.zeros(self.cfg.batch_size)
         table, out = decide(self.table, wb, now, ways=self.cfg.ways)
         np.asarray(out.status)
-        table = inject(table, InjectBatch.zeros(self.cfg.batch_size), now, ways=self.cfg.ways)
+        table, _, _ = inject(
+            table, InjectBatch.zeros(self.cfg.batch_size), now, ways=self.cfg.ways
+        )
         np.asarray(table.used[:1])
         self.table = table
 
@@ -378,29 +377,31 @@ class DeviceEngine(EngineBase):
             [req.hash_key() for req, _ in items], cfg.num_groups
         )
 
-        # Read-through: consult the store for keys this process has never
-        # seen, or whose store-set invalid_at deadline has passed
-        # (reference algorithms.go:45-51 cache-miss path + cache.go:35-47
-        # invalidation contract, batched). Membership checks run under the
-        # keys lock; store I/O runs outside it.
+        # Store read-through happens per WAVE inside the execution loop
+        # below, driven by a table-residency probe — the table, not host
+        # bookkeeping, defines a cache miss (reference algorithms.go:45-51
+        # consults the store on every cache miss). To keep blocking store
+        # I/O outside the device lock, keys this process has never seen
+        # (absent from _key_strings, which is a superset of table
+        # residency) are prefetched HERE; the per-wave probe catches the
+        # rare remainder (displaced keys) with a direct fetch.
+        prefetched: Dict[Tuple[int, int], object] = {}
         if self.store is not None and cfg.keep_key_strings:
-            need = []
             with self._keys_lock:
+                need = []
+                seen = set()
                 for i, (req, _) in enumerate(items):
-                    hi, lo = int(hashes[0][i]), int(hashes[1][i])
-                    inv = self._invalid_at.get((hi, lo))
-                    if (hi, lo) not in self._key_strings or (
-                        inv is not None and inv != 0 and inv < now
-                    ):
-                        need.append((req, (hi, lo)))
-                        self._invalid_at.pop((hi, lo), None)
-            fetched = []
-            for req, _k in need:
-                snap = self.store.get(req)
+                    k = (int(hashes[0][i]), int(hashes[1][i]))
+                    if k not in self._key_strings and k not in seen:
+                        seen.add(k)
+                        need.append((req, k))
+            for req, k in need:
+                try:
+                    snap = self.store.get(req)
+                except Exception:
+                    snap = None  # store outage == cache miss, not a crash
                 if snap is not None:
-                    fetched.append(snap)
-            if fetched:
-                self.inject_snapshots(fetched)
+                    prefetched[k] = snap
 
         if cfg.keep_key_strings:
             self._maybe_prune_key_strings()
@@ -454,20 +455,44 @@ class DeviceEngine(EngineBase):
                 encode_rows(asm.waves[w], wave_lanes[w], rows, now)
         waves = asm.waves
 
-        # Execute waves sequentially against the (donated) table.
+        # Execute waves sequentially against the (donated) table. With a
+        # Store attached, each wave runs the reference's exact per-request
+        # sequence at wave granularity (algorithms.go:45-51):
+        #   probe (cache lookup) -> Store.Get for misses -> insert -> decide
+        # and then gathers its touched rows from the intermediate table so
+        # write-behind persists the value the caller observed even if a
+        # later wave displaces the slot (OnChange runs within the request,
+        # algorithms.go:149-153).
+        if self.store is not None:
+            wave_lane_req: List[Dict[int, tuple]] = [dict() for _ in waves]
+            for i, place in enumerate(placements):
+                if isinstance(place, tuple):
+                    wave_lane_req[place[0]][place[1]] = (
+                        items[i][0], place[2], place[3],
+                    )
         outs = []
+        wave_rows_gathered = []
         with self._lock:
             table = self.table
             try:
-                for wb in waves:
+                for w, wb in enumerate(waves):
+                    if self.store is not None:
+                        table = self._wave_readthrough(
+                            table, wb, wave_lane_req[w], now, prefetched
+                        )
                     table, out = decide(table, wb, now, ways=cfg.ways)
                     outs.append(out)
+                    if self.store is not None:
+                        wave_rows_gathered.append(gather_rows(table, out.slot))
                 self.table = table
             except Exception:
-                # A failed jitted call may have consumed the donated table
-                # buffers; recover so the engine keeps serving (counter
-                # loss on failure matches the reference's accepted
-                # cache-loss-on-restart semantics, docs/architecture.md:5-11).
+                # Keep the last valid intermediate state if we still hold
+                # it; a failed jitted call may have consumed the donated
+                # table buffers, in which case recovery rebuilds an empty
+                # table so the engine keeps serving (counter loss on
+                # failure matches the reference's accepted cache-loss-on-
+                # restart semantics, docs/architecture.md:5-11).
+                self.table = table
                 self._recover_table_locked()
                 raise
 
@@ -485,6 +510,12 @@ class DeviceEngine(EngineBase):
             )
             for o in outs
         ]
+
+        # Displaced keys keep their _key_strings entries: the dictionary is
+        # a superset of table residency (Loader snapshots need strings for
+        # every live key), and _maybe_prune_key_strings bounds its size by
+        # rebuilding from the table. Read-through never consults it for
+        # correctness — the per-wave probe is ground truth.
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
         self.metrics.observe(
             tot[0], tot[1], tot[2], tot[3], len(waves),
@@ -496,7 +527,7 @@ class DeviceEngine(EngineBase):
         # its response can rely on the store reflecting it (the reference's
         # OnChange runs within the request, algorithms.go:149-153).
         if self.store is not None:
-            self._store_write_behind(items, placements, outs)
+            self._store_write_behind(items, placements, outs, wave_rows_gathered)
 
         for (req, fut), place in zip(items, placements):
             if place is None or place == "carry":
@@ -513,28 +544,84 @@ class DeviceEngine(EngineBase):
             )
         return carry
 
-    def _store_write_behind(self, items, placements, outs) -> None:
-        from gubernator_tpu.ops.decide import gather_rows
+    def _wave_readthrough(
+        self, table, wb, lane_req: Dict[int, tuple], now, prefetched: Dict
+    ):
+        """Reference miss path at wave granularity: probe the table for
+        each lane's key; for actual misses, use the pre-flush prefetch (or
+        Store.Get for the rare displaced key) and inject the persisted
+        state so the wave's decide continues the counter (reference
+        algorithms.go:45-51). Runs under self._lock; store outages are
+        treated as misses, never table-fatal."""
+        from gubernator_tpu.ops.inject import InjectBatch, inject
+
+        cfg = self.cfg
+        exists = np.asarray(
+            probe_exists(table, wb.key_hi, wb.key_lo, wb.group, now, ways=cfg.ways)
+        )
+        rows = []
+        for lane, (req, hi, lo) in lane_req.items():
+            if exists[lane]:
+                continue
+            snap = prefetched.get((hi, lo))
+            if snap is None:
+                try:
+                    snap = self.store.get(req)
+                except Exception:
+                    snap = None  # store outage == cache miss
+            if snap is not None:
+                rows.append((lane, snap, hi, lo))
+        if not rows:
+            return table
+        ib = InjectBatch.zeros(cfg.batch_size)
+        for j, (lane, s, hi, lo) in enumerate(rows):
+            ib.key_hi[j] = hi
+            ib.key_lo[j] = lo
+            ib.group[j] = wb.group[lane]
+            ib.algo[j] = int(s.algorithm)
+            ib.status[j] = int(s.status)
+            ib.limit[j] = s.limit
+            ib.duration[j] = s.duration
+            ib.remaining[j] = s.remaining
+            ib.stamp[j] = s.stamp
+            ib.expire_at[j] = s.expire_at
+            ib.invalid_at[j] = int(getattr(s, "invalid_at", 0))
+            ib.burst[j] = s.burst
+            ib.active[j] = True
+        table, _ehi, _elo = inject(table, ib, now, ways=cfg.ways)
+        return table
+
+    def _store_write_behind(self, items, placements, outs, wave_rows) -> None:
         from gubernator_tpu.store.store import ItemSnapshot
 
-        rows = [gather_rows(self.table, o.slot) for o in outs]
-        rows = [jax.tree.map(np.asarray, r) for r in rows]
-        changes = []
+        # Rows were gathered per-wave from the intermediate tables, so each
+        # lane sees exactly the state its own decide produced even when a
+        # later wave in the same flush displaced or freed the slot.
+        rows = [jax.tree.map(np.asarray, r) for r in wave_rows]
+        freed = [np.asarray(o.freed) for o in outs]
+        # Per-key LAST op wins, in request order: a hit followed by a
+        # same-flush RESET_REMAINING must end as a remove (not resurrect
+        # the pre-reset snapshot via a late batched on_change), and a
+        # RESET followed by a new hit must end as the new snapshot.
+        ops: Dict[str, Optional[ItemSnapshot]] = {}
         for (req, _), place in zip(items, placements):
             if place is None or place == "carry":
                 continue
             w, lane, hi, lo = place
             r = rows[w]
             key = req.hash_key()
-            # Rows are gathered from the final post-all-waves table: a slot
-            # freed in an early wave may have been reused by a DIFFERENT
-            # key in a later wave of the same flush. Only rows still
-            # holding OUR key are writable; anything else means our entry
-            # is gone (RESET_REMAINING free or same-flush eviction).
-            if not bool(r.used[lane]) or int(r.key_hi[lane]) != hi or int(r.key_lo[lane]) != lo:
-                self.store.remove(key)
+            # Only a token-bucket RESET_REMAINING free deletes the
+            # persisted entry (reference algorithms.go:78-90); the
+            # reference keeps Store entries across cache eviction and
+            # restores them via Store.Get on the next cache miss.
+            if bool(freed[w][lane]):
+                ops[key] = None
                 continue
-            changes.append(
+            if not bool(r.used[lane]) or int(r.key_hi[lane]) != hi or int(r.key_lo[lane]) != lo:
+                # Shouldn't happen with per-wave gathers; skip defensively
+                # without touching the persisted entry.
+                continue
+            ops[key] = (
                 ItemSnapshot(
                     key=key,
                     algorithm=int(r.algo[lane]),
@@ -548,6 +635,10 @@ class DeviceEngine(EngineBase):
                     burst=int(r.burst[lane]),
                 )
             )
+        changes = [s for s in ops.values() if s is not None]
+        for key, s in ops.items():
+            if s is None:
+                self.store.remove(key)
         if changes:
             self.store.on_change(changes)
 
@@ -570,9 +661,6 @@ class DeviceEngine(EngineBase):
             self._key_strings = {
                 k: v for k, v in self._key_strings.items() if k in live
             }
-            self._invalid_at = {
-                k: v for k, v in self._invalid_at.items() if k in live
-            }
 
     def _recover_table_locked(self) -> None:
         """Called with the lock held after a failed device call: if the
@@ -586,7 +674,6 @@ class DeviceEngine(EngineBase):
             self.table = SlotTable.create(self.cfg.num_groups, self.cfg.ways)
             with self._keys_lock:
                 self._key_strings.clear()
-                self._invalid_at.clear()
 
     # ---- direct state injection (AddCacheItem analog) ----------------------
 
@@ -633,13 +720,10 @@ class DeviceEngine(EngineBase):
 
         asm = _WaveAssembler(InjectBatch.zeros, cfg.batch_size)
         new_strings: Dict[Tuple[int, int], str] = {}
-        new_invalid: Dict[Tuple[int, int], Optional[int]] = {}
         for s in items:
             hi, lo = key_hash128(s.key)
             if cfg.keep_key_strings:
                 new_strings[(hi, lo)] = s.key
-            inv = int(getattr(s, "invalid_at", 0))
-            new_invalid[(hi, lo)] = inv if inv else None
             grp = group_of(lo, cfg.num_groups)
             ib, w, lane = asm.place(grp)
             ib.key_hi[lane] = hi
@@ -659,16 +743,11 @@ class DeviceEngine(EngineBase):
 
         with self._keys_lock:
             self._key_strings.update(new_strings)
-            for k, inv in new_invalid.items():
-                if inv is None:
-                    self._invalid_at.pop(k, None)
-                else:
-                    self._invalid_at[k] = inv
 
         with self._lock:
             table = self.table
             for ib in asm.waves:
-                table = inject(table, ib, now, ways=cfg.ways)
+                table, _ehi, _elo = inject(table, ib, now, ways=cfg.ways)
             self.table = table
 
     # ---- snapshot / restore (Loader seam, task: store) ---------------------
@@ -684,11 +763,17 @@ class DeviceEngine(EngineBase):
         return host
 
     def restore(self, snap: dict) -> None:
-        """Host -> device restore (the Loader.Load analog)."""
+        """Host -> device restore (the Loader.Load analog).
+
+        Replaces the table AND the host key-string dictionary under their
+        locks (the pump/executor threads read both); invalidation state
+        lives in the table's own invalid_at column, which the per-wave
+        read-through probe consults directly."""
         fields = {f: jax.numpy.asarray(snap[f]) for f in SlotTable._fields}
         with self._lock:
             self.table = SlotTable(**fields)
-        self._key_strings.update(snap.get("key_strings", {}))
+        with self._keys_lock:
+            self._key_strings = dict(snap.get("key_strings", {}))
 
 
 class _Bulk:

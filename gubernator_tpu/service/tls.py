@@ -176,7 +176,23 @@ def http_ssl_context(conf: TlsConfig) -> ssl.SSLContext:
         kf.write(conf.key_pem)
         kf.flush()
         ctx.load_cert_chain(cf.name, kf.name)
-    if conf.client_auth == "require":
-        ctx.verify_mode = ssl.CERT_REQUIRED
-        ctx.load_verify_locations(cadata=conf.ca_pem.decode())
+    if conf.client_auth != "none":
+        # Mirror server_credentials: a dedicated client-auth CA takes
+        # precedence over the serving CA, and 'request' maps to OPTIONAL
+        # (reference tls.go client-auth modes).
+        client_ca = conf.client_auth_ca_pem or conf.ca_pem
+        if client_ca:
+            ctx.verify_mode = (
+                ssl.CERT_REQUIRED
+                if conf.client_auth == "require"
+                else ssl.CERT_OPTIONAL
+            )
+            ctx.load_verify_locations(cadata=client_ca.decode())
+        elif conf.client_auth == "require":
+            raise ValueError(
+                "client_auth='require' needs a CA: set client_auth_ca_file/"
+                "client_auth_ca_pem or ca_file/ca_pem"
+            )
+        # 'request' with no CA configured: nothing to verify against —
+        # serve without client-cert verification (tolerated config).
     return ctx

@@ -152,11 +152,12 @@ def load_engine(engine, loader: Loader) -> int:
 
 
 def attach_store(engine, store: Store) -> None:
-    """Enable read-through + write-behind on a DeviceEngine."""
-    if not engine.cfg.keep_key_strings:
-        raise ValueError(
-            "attach_store requires EngineConfig.keep_key_strings=True: the "
-            "read-through gate tracks known keys host-side; without it every "
-            "request would hit the store."
-        )
+    """Enable read-through + write-behind on a DeviceEngine.
+
+    Read-through correctness is driven by the device-table residency
+    probe and write-behind keys come from each request, so the host
+    key-string dictionary is not required. Keeping keep_key_strings=True
+    (the default) is still recommended: it lets the engine prefetch
+    never-seen keys OUTSIDE the device lock and keeps Loader snapshots
+    carrying original key strings."""
     engine.store = store

@@ -13,6 +13,14 @@ duration as `end.UnixNano() - begin.UnixNano()/1000000`, a precedence bug
 yielding nanosecond-scale garbage. We return the intended value
 (end - begin in ms). Weeks are unsupported in the reference
 (interval.go:92-93) and unsupported here, with the same error text.
+
+Deviation (intentional): interval boundaries are computed in UTC, while
+the reference uses the server's local timezone (interval.go now.Location()).
+A distributed cluster whose nodes disagree on /etc/localtime would compute
+different day/month/year reset times per node; pinning to UTC makes
+Gregorian windows identical across every peer and replica. Operators who
+need local-midnight semantics should run with TZ=UTC parity at the client
+instead. Listed in docs/architecture.md "Known deviations".
 """
 
 from __future__ import annotations

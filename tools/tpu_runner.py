@@ -1,0 +1,91 @@
+"""Persistent TPU job runner for the axon tunnel.
+
+The tunnel allows one device claim, and a process killed while holding
+(or acquiring) it wedges the claim for a long time. So: claim ONCE in a
+long-lived process and feed it work as files — never kill it.
+
+Protocol (dir: /tmp/tpu_jobs):
+  - runner writes `status` = READY <platform> once the claim succeeds,
+    or FAILED <err> (then exits 1; the outer loop retries with a fresh
+    process — backend-init failure is cached per-process in jax).
+  - submit work by writing <name>.py then touching <name>.go
+  - runner execs the file (globals persist across jobs: keep tables/
+    compiled fns alive between experiments), writes stdout+traceback to
+    <name>.out and then <name>.done
+  - touch STOP to make the runner exit cleanly.
+
+Usage:  while ! python tools/tpu_runner.py; do sleep 90; done
+"""
+
+import io
+import os
+import sys
+import time
+import traceback
+
+JOBS = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")
+
+
+def main() -> int:
+    os.makedirs(JOBS, exist_ok=True)
+    status = os.path.join(JOBS, "status")
+
+    def put_status(s: str) -> None:
+        with open(status, "w") as f:
+            f.write(s + "\n")
+
+    put_status("CLAIMING")
+    t0 = time.time()
+    try:
+        import jax
+
+        devs = jax.devices()
+        plat = devs[0].platform
+    except Exception as e:
+        put_status(f"FAILED {time.time() - t0:.0f}s {e!r}"[:500])
+        return 1
+    put_status(f"READY {plat} n={len(devs)} claim={time.time() - t0:.1f}s")
+    print(f"claimed {plat} x{len(devs)} in {time.time() - t0:.1f}s", flush=True)
+
+    env: dict = {"__name__": "__tpu_job__"}
+    while True:
+        if os.path.exists(os.path.join(JOBS, "STOP")):
+            put_status("STOPPED")
+            return 0
+        ready = sorted(
+            f[:-3] for f in os.listdir(JOBS) if f.endswith(".go")
+        )
+        ran = False
+        for name in ready:
+            go = os.path.join(JOBS, name + ".go")
+            py = os.path.join(JOBS, name + ".py")
+            out = os.path.join(JOBS, name + ".out")
+            done = os.path.join(JOBS, name + ".done")
+            if os.path.exists(done) or not os.path.exists(py):
+                continue
+            ran = True
+            buf = io.StringIO()
+            old = sys.stdout
+            sys.stdout = buf
+            try:
+                with open(py) as f:
+                    code = f.read()
+                exec(compile(code, py, "exec"), env)
+                ok = True
+            except BaseException:
+                buf.write("\n" + traceback.format_exc())
+                ok = False
+            finally:
+                sys.stdout = old
+            with open(out, "w") as f:
+                f.write(buf.getvalue())
+            with open(done, "w") as f:
+                f.write("ok\n" if ok else "error\n")
+            os.remove(go)
+            print(f"job {name}: {'ok' if ok else 'ERROR'}", flush=True)
+        if not ran:
+            time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
