@@ -18,14 +18,25 @@ def main() -> None:
     parser.add_argument("--debug", action="store_true")
     args = parser.parse_args()
 
-    # GUBER_LOG_LEVEL / GUBER_LOG_FORMAT=json (reference config.go:286-310)
-    level_name = os.environ.get("GUBER_LOG_LEVEL", "").upper()
+    from gubernator_tpu.utils.platform import honor_env_platforms
+
+    honor_env_platforms()
+
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.envconfig import setup_daemon_config
+
+    # Config FIRST so --config file keys (injected into the env) are seen
+    # by the log settings too (reference config.go:268-310 order).
+    conf = setup_daemon_config(args.config)
+
+    # GUBER_LOG_LEVEL / GUBER_LOG_FORMAT=json / GUBER_DEBUG or --debug
+    # (reference config.go:286-310)
     level = (
         logging.DEBUG
-        if args.debug
-        else getattr(logging, level_name, logging.INFO)
+        if args.debug or conf.debug
+        else getattr(logging, conf.log_level.upper(), logging.INFO)
     )
-    if os.environ.get("GUBER_LOG_FORMAT", "").lower() == "json":
+    if conf.log_format.lower() == "json":
 
         class _Json(logging.Formatter):
             def format(self, record):
@@ -46,14 +57,11 @@ def main() -> None:
             level=level, format="%(asctime)s %(levelname)s %(name)s %(message)s"
         )
 
-    from gubernator_tpu.utils.platform import honor_env_platforms
+    # Span verbosity is process-global, so only the CLI entry point sets
+    # it (GUBER_TRACING_LEVEL; reference config.go:717-752).
+    from gubernator_tpu.utils import tracing
 
-    honor_env_platforms()
-
-    from gubernator_tpu.service.daemon import Daemon
-    from gubernator_tpu.service.envconfig import setup_daemon_config
-
-    conf = setup_daemon_config(args.config)
+    tracing.set_trace_level(conf.trace_level)
 
     async def run() -> None:
         d = await Daemon.spawn(conf)

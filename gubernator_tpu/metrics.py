@@ -5,10 +5,11 @@ The reference's functional tests poll these metrics as their
 synchronization API (SURVEY.md §4) — sample names must match exactly
 (e.g. `gubernator_broadcast_duration_count`). Two exposition notes:
 
-- Counter-style metrics use prometheus_client Gauge under the hood:
-  Client_python's Counter force-appends `_total`, but the reference's Go
-  names (`gubernator_getratelimit_counter`, `gubernator_cache_access_count`,
-  ...) have no suffix. A Gauge emits the bare name; we only ever inc() it.
+- Counter-style metrics are exposed by _BareCounter below: client_python's
+  Counter force-appends `_total` to the exposition name, but the
+  reference's Go names (`gubernator_getratelimit_counter`,
+  `gubernator_cache_access_count`, ...) have no suffix. _BareCounter keeps
+  the bare Go sample name AND a correct `# TYPE <name> counter` line.
 - Summary emits `<name>_count` / `<name>_sum`, matching Go's summaries.
 
 Each Daemon owns one CollectorRegistry (like the reference's per-daemon
@@ -16,6 +17,8 @@ registry, daemon.go:91-103) so in-process cluster fixtures don't collide.
 """
 
 from __future__ import annotations
+
+import threading
 
 from prometheus_client import (
     CollectorRegistry,
@@ -26,13 +29,93 @@ from prometheus_client import (
 )
 
 
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _BareChild:
+    __slots__ = ("_parent", "_key")
+
+    def __init__(self, parent: "_BareCounter", key: tuple):
+        self._parent = parent
+        self._key = key
+
+    def inc(self, amount: float = 1) -> None:
+        p = self._parent
+        with p._lock:
+            p._values[self._key] = p._values.get(self._key, 0.0) + amount
+
+    def set(self, value: float) -> None:
+        """Monotonic set — bridges externally-accumulated engine counters
+        at scrape time."""
+        p = self._parent
+        with p._lock:
+            p._values[self._key] = float(value)
+
+    def get(self) -> float:
+        p = self._parent
+        with p._lock:
+            return p._values.get(self._key, 0.0)
+
+
+class _BareCounter:
+    """Monotonic counter exposed under its bare Go name with a correct
+    `# TYPE <name> counter` line.
+
+    prometheus_client cannot express this (its Counter appends `_total`
+    per OpenMetrics; a raw Metric('counter') mangles the TYPE header), so
+    value storage and text exposition live here; Metrics.render() prepends
+    these lines to the registry's standard output."""
+
+    def __init__(self, name: str, doc: str, labelnames=()):
+        self.name = name
+        self.doc = doc
+        self.labelnames = tuple(labelnames)
+        self._values: dict = {}
+        self._lock = threading.Lock()
+        if not self.labelnames:
+            self._values[()] = 0.0
+
+    def labels(self, *values) -> _BareChild:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label values"
+            )
+        return _BareChild(self, tuple(str(v) for v in values))
+
+    # unlabeled convenience (mirrors prometheus_client's API shape)
+    def inc(self, amount: float = 1) -> None:
+        _BareChild(self, ()).inc(amount)
+
+    def set(self, value: float) -> None:
+        _BareChild(self, ()).set(value)
+
+    def render_lines(self) -> list:
+        out = [f"# HELP {self.name} {self.doc}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            if key:
+                lbl = ",".join(
+                    f'{n}="{_escape_label(val)}"'
+                    for n, val in zip(self.labelnames, key)
+                )
+                out.append(f"{self.name}{{{lbl}}} {v}")
+            else:
+                out.append(f"{self.name} {v}")
+        return out
+
+
 class Metrics:
     def __init__(self, registry: CollectorRegistry | None = None):
         self.registry = registry or CollectorRegistry()
         r = self.registry
+        self._bare: list[_BareCounter] = []
 
         def counter(name, doc, labels=()):
-            return Gauge(name, doc, list(labels), registry=r)
+            c = _BareCounter(name, doc, labels)
+            self._bare.append(c)
+            return c
 
         # Core serving metrics (reference gubernator.go:60-111)
         self.getratelimit_counter = counter(
@@ -129,6 +212,18 @@ class Metrics:
             "Requests queued for GLOBAL hit-update send.",
             registry=r,
         )
+        # Failure visibility for the async GLOBAL legs: the reference logs
+        # every failed send/broadcast leg (global.go:180-186, 278-281);
+        # these counters make a persistently failing leg observable at
+        # /metrics too.
+        self.global_send_errors = counter(
+            "gubernator_global_send_errors",
+            "Failed GLOBAL hit-update sends to owners.",
+        )
+        self.global_broadcast_errors = counter(
+            "gubernator_global_broadcast_errors",
+            "Failed GLOBAL broadcast pushes to peers.",
+        )
 
         # gRPC stats (reference grpc_stats.go:51-62)
         self.grpc_request_counts = counter(
@@ -159,7 +254,11 @@ class Metrics:
 
     def render(self) -> bytes:
         self.sync()
-        return generate_latest(self.registry)
+        lines = []
+        for c in self._bare:
+            lines.extend(c.render_lines())
+        text = ("\n".join(lines) + "\n").encode() if lines else b""
+        return text + generate_latest(self.registry)
 
     content_type = CONTENT_TYPE_LATEST
 

@@ -57,6 +57,24 @@ def _leak_fixed(elapsed, limit, rate_num, burst):
     return jnp.where(elapsed <= 0, jnp.zeros_like(leak), leak)
 
 
+def displaced_occupants(table: SlotTable, slot, exists, active, key_hi, key_lo):
+    """Displaced occupant keys for miss-path inserts, (0,0) = none.
+
+    Computed against the PRE-update table. The engine's store path uses
+    these to keep its host key dictionary aligned with table residency
+    (a key whose last flush event is a displacement is dropped so its
+    next request prefetches store state outside the device lock)."""
+    old_hi = table.key_hi[slot]
+    old_lo = table.key_lo[slot]
+    displaced = (
+        active
+        & ~exists
+        & table.used[slot]
+        & ((old_hi != key_hi) | (old_lo != key_lo))
+    )
+    return jnp.where(displaced, old_hi, 0), jnp.where(displaced, old_lo, 0)
+
+
 def _choose_slot(table: SlotTable, batch: RequestBatch, now, ways: int):
     """Probe each request's W-way group: find the live matching way, or the
     way to insert into (matched-expired > empty > expired > LRU)."""
@@ -383,16 +401,8 @@ def _decide_impl(table: SlotTable, batch: RequestBatch, now, *, ways: int):
     )
 
     act = batch.active
-    # Surface displaced occupants: a miss-path insert that overwrote a slot
-    # holding a different key (live or expired). The host must forget the
-    # displaced key so its next request takes the store read-through path.
-    old_hi = table.key_hi[slot]
-    old_lo = table.key_lo[slot]
-    displaced = (
-        act
-        & ~exists
-        & table.used[slot]
-        & ((old_hi != batch.key_hi) | (old_lo != batch.key_lo))
+    evicted_hi, evicted_lo = displaced_occupants(
+        table, slot, exists, act, batch.key_hi, batch.key_lo
     )
     out = DecideOutput(
         status=jnp.where(act, resp["status"], jnp.int8(0)),
@@ -400,8 +410,8 @@ def _decide_impl(table: SlotTable, batch: RequestBatch, now, *, ways: int):
         remaining=jnp.where(act, resp["remaining"], 0),
         reset_time=jnp.where(act, resp["reset_time"], 0),
         slot=idx,
-        evicted_hi=jnp.where(displaced, old_hi, 0),
-        evicted_lo=jnp.where(displaced, old_lo, 0),
+        evicted_hi=evicted_hi,
+        evicted_lo=evicted_lo,
         freed=act & freed,
         hits=jnp.sum(act & exists),
         misses=jnp.sum(act & ~exists),

@@ -85,19 +85,12 @@ def _inject_impl(table: SlotTable, items: InjectBatch, now, ways: int = 8):
     n = table.num_slots
     idx = jnp.where(items.active, slot, n)
 
-    # Surface displaced occupants (same contract as decide's evicted_hi/lo):
-    # an insert that overwrote a slot holding a different key. The host
-    # forgets those keys so their next request re-reads through the Store.
-    old_hi = table.key_hi[slot]
-    old_lo = table.key_lo[slot]
-    displaced = (
-        items.active
-        & ~exists
-        & table.used[slot]
-        & ((old_hi != items.key_hi) | (old_lo != items.key_lo))
+    # Surface displaced occupants (same contract as decide's evicted_hi/lo).
+    from gubernator_tpu.ops.decide import displaced_occupants
+
+    evicted_hi, evicted_lo = displaced_occupants(
+        table, slot, exists, items.active, items.key_hi, items.key_lo
     )
-    evicted_hi = jnp.where(displaced, old_hi, 0)
-    evicted_lo = jnp.where(displaced, old_lo, 0)
 
     def upd(arr, val):
         return arr.at[idx].set(val, mode="drop")
@@ -125,5 +118,7 @@ def inject(table: SlotTable, items: InjectBatch, now, ways: int = 8):
     """Jitted entry with donated table buffers.
 
     Returns (table', evicted_hi, evicted_lo): displaced occupant keys per
-    lane ((0,0) = none) so the host can invalidate its key dictionary."""
+    lane ((0,0) = none), same contract as DecideOutput.evicted_hi/lo (see
+    ops/layout.py) — the engine's store path uses them to keep the host
+    key dictionary aligned with table residency."""
     return _inject_impl(table, items, now, ways=ways)

@@ -140,11 +140,11 @@ class DecideOutput(NamedTuple):
     reset_time: jnp.ndarray  # (B,) int64
     slot: jnp.ndarray  # (B,) int64 slot each lane touched (N for padding)
     # Displaced occupant's key when this lane's insert evicted a DIFFERENT
-    # key from the slot ((0,0) = none). The host drops these from its
-    # key dictionary so the key's next request re-reads through the Store
-    # — the reference re-consults the store on every cache miss
-    # (reference algorithms.go:45-51), so eviction must not orphan the
-    # persisted counter.
+    # key from the slot ((0,0) = none). The engine's store path tracks
+    # these as flush events: a key whose last event is a displacement is
+    # dropped from the host key dictionary so its next request prefetches
+    # the persisted counter outside the device lock (the reference
+    # re-consults the store on every cache miss, algorithms.go:45-51).
     evicted_hi: jnp.ndarray  # (B,) int64
     evicted_lo: jnp.ndarray  # (B,) int64
     # Slot freed by token-bucket RESET_REMAINING (the only path where the

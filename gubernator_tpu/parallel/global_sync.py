@@ -25,8 +25,11 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import time
 from typing import Dict, Optional
+
+log = logging.getLogger("gubernator_tpu.global")
 
 from gubernator_tpu.api.types import (
     Behavior,
@@ -103,8 +106,18 @@ class GlobalManager:
             if take:
                 try:
                     await self._send_hits(take)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # The loop must survive, but a failing flush is never
+                    # silent (reference logs every leg, global.go:180-186).
+                    log.exception("GLOBAL hit-update flush failed")
+                    self.svc.metrics.global_send_errors.inc()
+                    from gubernator_tpu.utils import tracing
+
+                    with tracing.span(
+                        "globalManager.sendHits.error", level="ERROR",
+                        error=str(e),
+                    ):
+                        pass
 
     async def _broadcast_loop(self) -> None:
         while self._running:
@@ -126,8 +139,16 @@ class GlobalManager:
             if take:
                 try:
                     await self._broadcast(take)
-                except Exception:
-                    pass
+                except Exception as e:
+                    log.exception("GLOBAL broadcast flush failed")
+                    self.svc.metrics.global_broadcast_errors.inc()
+                    from gubernator_tpu.utils import tracing
+
+                    with tracing.span(
+                        "globalManager.broadcast.error", level="ERROR",
+                        error=str(e),
+                    ):
+                        pass
 
     # -- send hits to owners (reference global.go:144-187) -------------------
 
@@ -155,6 +176,11 @@ class GlobalManager:
                             reqs, timeout=self.b.global_timeout_s
                         )
                     except Exception as e:
+                        log.warning(
+                            "GLOBAL hit-update to %s failed: %s",
+                            peer.info.grpc_address, e,
+                        )
+                        self.svc.metrics.global_send_errors.inc()
                         if hasattr(self.svc.forwarder, "record_error"):
                             self.svc.forwarder.record_error(
                                 f"global send to {peer.info.grpc_address}: {e}"
@@ -215,8 +241,19 @@ class GlobalManager:
                         await peer.update_peer_globals(
                             globals_, timeout=self.b.global_timeout_s
                         )
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # One dead replica must not stop the fan-out, but
+                        # every failed leg is logged and counted (reference
+                        # global.go:278-281).
+                        log.warning(
+                            "GLOBAL broadcast to %s failed: %s",
+                            peer.info.grpc_address, e,
+                        )
+                        self.svc.metrics.global_broadcast_errors.inc()
+                        if hasattr(self.svc.forwarder, "record_error"):
+                            self.svc.forwarder.record_error(
+                                f"global broadcast to {peer.info.grpc_address}: {e}"
+                            )
 
             await asyncio.gather(*(push(p) for p in peers))
             self.svc.metrics.broadcast_counter.inc()

@@ -87,7 +87,12 @@ class Peer:
     async def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
         """Single check via the peer's batch queue (reference
         peer_client.go:125-162); NO_BATCHING bypasses the queue."""
-        if has_behavior(req.behavior, Behavior.NO_BATCHING):
+        if has_behavior(req.behavior, Behavior.NO_BATCHING) or getattr(
+            self.behaviors, "disable_batching", False
+        ):
+            # Per-request NO_BATCHING, or the daemon-wide kill switch
+            # (reference Behaviors.DisableBatching / GUBER_DISABLE_BATCHING,
+            # peer_client.go:128-133).
             out = await self.get_peer_rate_limits([req])
             return out[0]
         if self._closed:

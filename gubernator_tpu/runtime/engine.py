@@ -471,19 +471,30 @@ class DeviceEngine(EngineBase):
                         items[i][0], place[2], place[3],
                     )
         outs = []
-        wave_rows_gathered = []
+        wave_rows_host: List[object] = []  # materialized post-decide rows
+        served: Dict[Tuple[int, int], Tuple[int, int]] = {}  # key->(w,lane)
+        events: List[Tuple[str, Tuple[int, int]]] = []  # ('d'|'i', key)
         with self._lock:
             table = self.table
             try:
                 for w, wb in enumerate(waves):
                     if self.store is not None:
                         table = self._wave_readthrough(
-                            table, wb, wave_lane_req[w], now, prefetched
+                            table, wb, wave_lane_req[w], now,
+                            prefetched, served, wave_rows_host, events,
                         )
                     table, out = decide(table, wb, now, ways=cfg.ways)
                     outs.append(out)
                     if self.store is not None:
-                        wave_rows_gathered.append(gather_rows(table, out.slot))
+                        rows = gather_rows(table, out.slot)
+                        wave_rows_host.append(jax.tree.map(np.asarray, rows))
+                        ehi = np.asarray(out.evicted_hi)
+                        elo = np.asarray(out.evicted_lo)
+                        for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
+                            events.append(("d", (int(ehi[j]), int(elo[j]))))
+                        for lane, (req, hi, lo) in wave_lane_req[w].items():
+                            served[(hi, lo)] = (w, lane)
+                            events.append(("i", (hi, lo)))
                 self.table = table
             except Exception:
                 # Keep the last valid intermediate state if we still hold
@@ -511,11 +522,22 @@ class DeviceEngine(EngineBase):
             for o in outs
         ]
 
-        # Displaced keys keep their _key_strings entries: the dictionary is
-        # a superset of table residency (Loader snapshots need strings for
-        # every live key), and _maybe_prune_key_strings bounds its size by
-        # rebuilding from the table. Read-through never consults it for
-        # correctness — the per-wave probe is ground truth.
+        # Key-dictionary hygiene (store path): a key whose LAST flush event
+        # was a displacement is gone from the table — drop its string so
+        # its next request prefetches store state OUTSIDE the device lock.
+        # A key re-inserted after its displacement (read-through or a later
+        # wave) keeps its entry; Loader snapshots need strings for every
+        # live key. Read-through correctness never depends on this — the
+        # per-wave probe is ground truth.
+        if keep and events:
+            last: Dict[Tuple[int, int], str] = {}
+            for ev, k in events:
+                last[k] = ev
+            dead = [k for k, ev in last.items() if ev == "d"]
+            if dead:
+                with self._keys_lock:
+                    for k in dead:
+                        self._key_strings.pop(k, None)
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
         self.metrics.observe(
             tot[0], tot[1], tot[2], tot[3], len(waves),
@@ -527,7 +549,7 @@ class DeviceEngine(EngineBase):
         # its response can rely on the store reflecting it (the reference's
         # OnChange runs within the request, algorithms.go:149-153).
         if self.store is not None:
-            self._store_write_behind(items, placements, outs, wave_rows_gathered)
+            self._store_write_behind(items, placements, outs, wave_rows_host)
 
         for (req, fut), place in zip(items, placements):
             if place is None or place == "carry":
@@ -544,15 +566,50 @@ class DeviceEngine(EngineBase):
             )
         return carry
 
+    @staticmethod
+    def _snapshot_from_row(r, lane: int, key: str):
+        """ItemSnapshot from one materialized gathered-row lane."""
+        from gubernator_tpu.store.store import ItemSnapshot
+
+        return ItemSnapshot(
+            key=key,
+            algorithm=int(r.algo[lane]),
+            status=int(r.status[lane]),
+            limit=int(r.limit[lane]),
+            duration=int(r.duration[lane]),
+            remaining=int(r.remaining[lane]),
+            stamp=int(r.stamp[lane]),
+            expire_at=int(r.expire_at[lane]),
+            invalid_at=int(r.invalid_at[lane]),
+            burst=int(r.burst[lane]),
+        )
+
     def _wave_readthrough(
-        self, table, wb, lane_req: Dict[int, tuple], now, prefetched: Dict
+        self,
+        table,
+        wb,
+        lane_req: Dict[int, tuple],
+        now,
+        prefetched: Dict,
+        served: Dict,
+        wave_rows_host: List,
+        events: List,
     ):
         """Reference miss path at wave granularity: probe the table for
-        each lane's key; for actual misses, use the pre-flush prefetch (or
-        Store.Get for the rare displaced key) and inject the persisted
-        state so the wave's decide continues the counter (reference
-        algorithms.go:45-51). Runs under self._lock; store outages are
-        treated as misses, never table-fatal."""
+        each lane's key; for actual misses, recover the freshest state and
+        inject it so the wave's decide continues the counter (reference
+        algorithms.go:45-51). Freshness order:
+
+        1. a row this SAME flush already decided (the key was displaced
+           between its own waves — pre-flush store state would drop the
+           earlier hits, and a RESET-freed row must stay gone because the
+           store.remove only lands at flush end);
+        2. the pre-flush prefetch (keys never seen by this process);
+        3. Store.Get under the lock (rare: displaced in a prior flush but
+           raced back before hygiene dropped its string).
+
+        Runs under self._lock; store outages degrade to misses, never
+        table-fatal."""
         from gubernator_tpu.ops.inject import InjectBatch, inject
 
         cfg = self.cfg
@@ -563,12 +620,26 @@ class DeviceEngine(EngineBase):
         for lane, (req, hi, lo) in lane_req.items():
             if exists[lane]:
                 continue
-            snap = prefetched.get((hi, lo))
-            if snap is None:
-                try:
-                    snap = self.store.get(req)
-                except Exception:
-                    snap = None  # store outage == cache miss
+            snap = None
+            sv = served.get((hi, lo))
+            if sv is not None:
+                pw, plane = sv
+                r = wave_rows_host[pw]
+                if (
+                    bool(r.used[plane])
+                    and int(r.key_hi[plane]) == hi
+                    and int(r.key_lo[plane]) == lo
+                ):
+                    snap = self._snapshot_from_row(r, plane, req.hash_key())
+                # else: that wave freed the entry (RESET_REMAINING) — it
+                # must look absent; do NOT fall back to the stale store.
+            else:
+                snap = prefetched.get((hi, lo))
+                if snap is None:
+                    try:
+                        snap = self.store.get(req)
+                    except Exception:
+                        snap = None  # store outage == cache miss
             if snap is not None:
                 rows.append((lane, snap, hi, lo))
         if not rows:
@@ -588,16 +659,22 @@ class DeviceEngine(EngineBase):
             ib.invalid_at[j] = int(getattr(s, "invalid_at", 0))
             ib.burst[j] = s.burst
             ib.active[j] = True
-        table, _ehi, _elo = inject(table, ib, now, ways=cfg.ways)
+        table, ehi, elo = inject(table, ib, now, ways=cfg.ways)
+        ehi = np.asarray(ehi)
+        elo = np.asarray(elo)
+        for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
+            events.append(("d", (int(ehi[j]), int(elo[j]))))
+        for lane, snap, hi, lo in rows:
+            events.append(("i", (hi, lo)))
         return table
 
-    def _store_write_behind(self, items, placements, outs, wave_rows) -> None:
+    def _store_write_behind(self, items, placements, outs, rows) -> None:
         from gubernator_tpu.store.store import ItemSnapshot
 
-        # Rows were gathered per-wave from the intermediate tables, so each
-        # lane sees exactly the state its own decide produced even when a
-        # later wave in the same flush displaced or freed the slot.
-        rows = [jax.tree.map(np.asarray, r) for r in wave_rows]
+        # Rows were gathered per-wave from the intermediate tables (and
+        # already materialized), so each lane sees exactly the state its
+        # own decide produced even when a later wave in the same flush
+        # displaced or freed the slot.
         freed = [np.asarray(o.freed) for o in outs]
         # Per-key LAST op wins, in request order: a hit followed by a
         # same-flush RESET_REMAINING must end as a remove (not resurrect
@@ -621,20 +698,7 @@ class DeviceEngine(EngineBase):
                 # Shouldn't happen with per-wave gathers; skip defensively
                 # without touching the persisted entry.
                 continue
-            ops[key] = (
-                ItemSnapshot(
-                    key=key,
-                    algorithm=int(r.algo[lane]),
-                    status=int(r.status[lane]),
-                    limit=int(r.limit[lane]),
-                    duration=int(r.duration[lane]),
-                    remaining=int(r.remaining[lane]),
-                    stamp=int(r.stamp[lane]),
-                    expire_at=int(r.expire_at[lane]),
-                    invalid_at=int(r.invalid_at[lane]),
-                    burst=int(r.burst[lane]),
-                )
-            )
+            ops[key] = self._snapshot_from_row(r, lane, key)
         changes = [s for s in ops.values() if s is not None]
         for key, s in ops.items():
             if s is None:

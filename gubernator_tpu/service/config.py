@@ -28,6 +28,49 @@ class BehaviorConfig:
     global_peer_requests_concurrency: int = 100
 
     force_global: bool = False
+    # Forward every peer request as its own RPC instead of micro-batching
+    # (reference Behaviors.DisableBatching / GUBER_DISABLE_BATCHING,
+    # peer_client.go:128-133).
+    disable_batching: bool = False
+
+
+@dataclasses.dataclass
+class EtcdConfig:
+    """etcd discovery settings (reference EtcdPoolConfig + GUBER_ETCD_*
+    env block, config.go:380-404, etcd.go:42-80)."""
+
+    endpoints: List[str] = dataclasses.field(
+        default_factory=lambda: ["localhost:2379"]
+    )
+    key_prefix: str = "/gubernator-peers"
+    advertise_address: str = ""
+    data_center: str = ""
+    dial_timeout_s: float = 5.0
+    user: str = ""
+    password: str = ""
+    # TLS toward etcd (reference setupEtcdTLS, config.go:680-715)
+    tls_enabled: bool = False
+    tls_ca: str = ""
+    tls_cert: str = ""
+    tls_key: str = ""
+    tls_skip_verify: bool = False
+    # lease TTL driving registration keepalive (reference etcd.go:37)
+    lease_ttl_s: float = 30.0
+
+
+@dataclasses.dataclass
+class K8sConfig:
+    """Kubernetes discovery settings (reference K8sPoolConfig + GUBER_K8S_*
+    env block, kubernetes.go:24-33, config.go:405-413)."""
+
+    namespace: str = "default"
+    pod_ip: str = ""
+    pod_port: str = ""
+    selector: str = ""  # label selector for the peer Endpoints/Pods
+    mechanism: str = "endpoints"  # endpoints | pods
+    api_server: str = ""  # default: in-cluster env/service account
+    token_file: str = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    ca_file: str = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 
 
 @dataclasses.dataclass
@@ -58,10 +101,40 @@ class DaemonConfig:
     discovery: str = "static"
     dns_fqdn: str = ""
     dns_interval_s: float = 300.0
+    dns_resolv_conf: str = "/etc/resolv.conf"  # reference GUBER_RESOLV_CONF
     # member-list (gossip) backend (reference memberlist.go knobs)
     gossip_bind: str = ""  # UDP host:port; port 0 = ephemeral
+    gossip_advertise: str = ""  # reference GUBER_MEMBERLIST_ADVERTISE_ADDRESS
     gossip_seeds: List[str] = dataclasses.field(default_factory=list)
     gossip_interval_s: float = 1.0
+    # etcd / k8s discovery blocks (populated by the matching env vars)
+    etcd: Optional[EtcdConfig] = None
+    k8s: Optional[K8sConfig] = None
+
+    # gRPC server hardening (reference daemon.go:120-133): receive cap is
+    # always 1MB like the reference; conn-age rotation is opt-in.
+    grpc_max_conn_age_s: float = 0.0  # GUBER_GRPC_MAX_CONN_AGE_SEC; 0 = off
+
+    # Separate health-only listener that never requests a client cert
+    # (reference HTTPStatusListenAddress / GUBER_STATUS_HTTP_ADDRESS,
+    # daemon.go:305-333). Only meaningful with TLS+mTLS configured.
+    status_http_listen_address: str = ""
+
+    # Span verbosity: ERROR | INFO | DEBUG (reference GUBER_TRACING_LEVEL,
+    # config.go:717-752 — INFO drops noisy per-peer/healthcheck spans).
+    trace_level: str = "INFO"
+
+    # Log settings (reference GUBER_LOG_LEVEL / GUBER_LOG_FORMAT /
+    # GUBER_DEBUG; applied by the CLI entry point).
+    log_level: str = "info"
+    log_format: str = ""  # "json" or "" (text)
+    debug: bool = False
+
+    # Reference GUBER_WORKER_COUNT sizes its goroutine WorkerPool
+    # (workers.go:125-147). The TPU engine has no worker shards — the
+    # kernel replaces them — so this knob is accepted and recorded but
+    # intentionally has no effect (documented N/A).
+    worker_count: int = 0
 
     # Peer picker tuning (reference config.go:421-443)
     peer_picker_hash: str = "fnv1"

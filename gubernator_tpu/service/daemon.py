@@ -34,6 +34,8 @@ class Daemon:
         self.http_runner: Optional[web.AppRunner] = None
         self.grpc_address = ""
         self.http_address = ""
+        self.status_runner = None
+        self.status_address = ""
         self._channel: Optional[grpc.aio.Channel] = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -45,6 +47,9 @@ class Daemon:
         return d
 
     async def start(self) -> None:
+        # NOTE: trace level is process-global (like the env var that sets
+        # it); the CLI entry point applies conf.trace_level. A library
+        # Daemon must not clobber other in-process daemons' tracing.
         conf = self.conf
         if conf.global_mode == "ici":
             from gubernator_tpu.runtime.ici_engine import IciEngine, IciEngineConfig
@@ -87,7 +92,16 @@ class Daemon:
         )
 
         # gRPC server hosting both services (reference daemon.go:139-167)
-        self.grpc_server = grpc.aio.server()
+        # with the reference's hardening: 1MB receive cap (daemon.go:122)
+        # and optional max-connection-age rotation (daemon.go:128-133).
+        opts = [("grpc.max_receive_message_length", 1024 * 1024)]
+        if conf.grpc_max_conn_age_s > 0:
+            age_ms = int(conf.grpc_max_conn_age_s * 1000)
+            opts += [
+                ("grpc.max_connection_age_ms", age_ms),
+                ("grpc.max_connection_age_grace_ms", age_ms),
+            ]
+        self.grpc_server = grpc.aio.server(options=opts)
         self.grpc_server.add_generic_rpc_handlers(
             (rpc.v1_handler(V1Servicer(self.svc)), rpc.peers_handler(PeersV1Servicer(self.svc)))
         )
@@ -123,6 +137,30 @@ class Daemon:
         actual = site._server.sockets[0].getsockname()
         self.http_address = f"{hhost}:{actual[1]}"
 
+        # Optional health-only listener that never requests a client cert
+        # (reference daemon.go:305-333): lets load balancers probe
+        # /v1/HealthCheck on an mTLS deployment without presenting certs.
+        self.status_runner = None
+        self.status_address = ""
+        if conf.status_http_listen_address:
+            from gubernator_tpu.service.gateway import build_status_app
+
+            status_app = build_status_app(self.svc)
+            self.status_runner = web.AppRunner(status_app)
+            await self.status_runner.setup()
+            shost, sport = conf.status_http_listen_address.rsplit(":", 1)
+            status_ssl = None
+            if conf.tls is not None:
+                from gubernator_tpu.service.tls import http_ssl_context
+
+                status_ssl = http_ssl_context(conf.tls, no_client_auth=True)
+            ssite = web.TCPSite(
+                self.status_runner, shost, int(sport), ssl_context=status_ssl
+            )
+            await ssite.start()
+            sactual = ssite._server.sockets[0].getsockname()
+            self.status_address = f"{shost}:{sactual[1]}"
+
         self.svc.local_info = PeerInfo(
             grpc_address=advertise,
             http_address=self.http_address,
@@ -150,6 +188,7 @@ class Daemon:
                 self.set_peers,
                 interval_s=conf.dns_interval_s,
                 own_address=advertise,
+                resolv_conf=conf.dns_resolv_conf,
             )
         elif conf.discovery == "static":
             if conf.peers:
@@ -163,6 +202,7 @@ class Daemon:
                 on_update=self.set_peers,
                 seeds=conf.gossip_seeds,
                 interval_s=conf.gossip_interval_s,
+                advertise=conf.gossip_advertise,
             )
             await self._pool.started()  # resolve the ephemeral bind
         elif conf.discovery in POOLS:
@@ -170,6 +210,35 @@ class Daemon:
             self._pool = POOLS[conf.discovery](on_update=self.set_peers)
         else:
             raise ValueError(f"unknown peer discovery type: {conf.discovery!r}")
+
+        # Readiness gate (reference WaitForConnect, daemon.go:451-488):
+        # confirm every listener actually accepts connections before
+        # declaring the daemon started.
+        await self.wait_for_connect()
+
+    async def wait_for_connect(self, timeout_s: float = 10.0) -> None:
+        """Dial each listener until it accepts a TCP connection
+        (reference daemon.go:451-488)."""
+        addrs = [self.grpc_address, self.http_address]
+        if self.status_address:
+            addrs.append(self.status_address)
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        for addr in addrs:
+            host, port = addr.rsplit(":", 1)
+            if host in ("0.0.0.0", "::"):
+                host = "127.0.0.1"
+            while True:
+                try:
+                    _, writer = await asyncio.open_connection(host, int(port))
+                    writer.close()
+                    break
+                except OSError:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise TimeoutError(
+                            f"listener {addr} not accepting connections "
+                            f"after {timeout_s}s"
+                        )
+                    await asyncio.sleep(0.05)
 
     async def close(self) -> None:
         # Drain counters to the Loader before teardown (reference
@@ -191,6 +260,8 @@ class Daemon:
             await self.grpc_server.stop(grace=0.5)
         if self.http_runner is not None:
             await self.http_runner.cleanup()
+        if getattr(self, "status_runner", None) is not None:
+            await self.status_runner.cleanup()
         if self.engine is not None:
             self.engine.close()
 

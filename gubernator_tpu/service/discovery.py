@@ -43,6 +43,83 @@ class StaticPool:
         pass
 
 
+def _query_nameserver(
+    ns: str, fqdn: str, qtype: int, timeout: float = 2.0, port: int = 53
+) -> List[str]:
+    """One A (1) or AAAA (28) query against a specific nameserver over
+    UDP, stdlib-only (the reference uses miekg/dns to honor a custom
+    resolv.conf, dns.go:39-127)."""
+    import random
+    import struct
+
+    txid = random.randint(0, 0xFFFF)
+    header = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)  # RD=1
+    qname = b"".join(
+        bytes([len(p)]) + p.encode() for p in fqdn.rstrip(".").split(".")
+    ) + b"\x00"
+    pkt = header + qname + struct.pack(">HH", qtype, 1)  # IN
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(timeout)
+        s.sendto(pkt, (ns, port))
+        data, _ = s.recvfrom(4096)
+    if len(data) < 12 or struct.unpack(">H", data[:2])[0] != txid:
+        return []
+    _, _, qd, an, _, _ = struct.unpack(">HHHHHH", data[:12])
+
+    def skip_name(off: int) -> int:
+        # A name is a run of labels ending with either a null byte or a
+        # compression pointer; labels and a trailing pointer can MIX
+        # (RFC 1035 §4.1.4), so check for the pointer at every label.
+        while True:
+            b = data[off]
+            if b & 0xC0 == 0xC0:
+                return off + 2
+            if b == 0:
+                return off + 1
+            off += b + 1
+
+    off = 12
+    for _ in range(qd):  # skip questions
+        off = skip_name(off) + 4
+    out = []
+    for _ in range(an):
+        off = skip_name(off)
+        rtype, _, _, rdlen = struct.unpack(">HHIH", data[off : off + 10])
+        off += 10
+        rdata = data[off : off + rdlen]
+        off += rdlen
+        if rtype == qtype == 1 and rdlen == 4:
+            out.append(socket.inet_ntop(socket.AF_INET, rdata))
+        elif rtype == qtype == 28 and rdlen == 16:
+            out.append(socket.inet_ntop(socket.AF_INET6, rdata))
+    return out
+
+
+def resolve_with_resolv_conf(fqdn: str, resolv_conf: str) -> List[str]:
+    """Resolve A+AAAA records using the nameservers listed in a specific
+    resolv.conf file (reference GUBER_RESOLV_CONF, dns.go:60-87)."""
+    nameservers = []
+    with open(resolv_conf) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2 and parts[0] == "nameserver":
+                nameservers.append(parts[1])
+    import struct
+
+    for ns in nameservers:
+        ips: List[str] = []
+        for qtype in (1, 28):
+            try:
+                ips.extend(_query_nameserver(ns, fqdn, qtype))
+            except (OSError, struct.error, IndexError):
+                # Unreachable nameserver or a malformed/truncated answer:
+                # try the next nameserver rather than erroring the poll.
+                continue
+        if ips:
+            return sorted(set(ips))
+    return []
+
+
 class DnsPool:
     """Resolves an FQDN on an interval; every address becomes a peer
     (reference dns.go:130-218; fixed-port convention dns.go:187-195)."""
@@ -56,6 +133,7 @@ class DnsPool:
         interval_s: float = 300.0,
         own_address: str = "",
         resolver=None,
+        resolv_conf: str = "",
     ):
         self.fqdn = fqdn
         self.on_update = on_update
@@ -63,7 +141,14 @@ class DnsPool:
         self.http_port = http_port
         self.interval_s = interval_s
         self.own_address = own_address
-        self._resolver = resolver or self._system_resolve
+        if resolver is not None:
+            self._resolver = resolver
+        elif resolv_conf and resolv_conf != "/etc/resolv.conf":
+            # Custom resolv.conf: query its nameservers directly (the
+            # system resolver already honors the default path).
+            self._resolver = lambda f: resolve_with_resolv_conf(f, resolv_conf)
+        else:
+            self._resolver = self._system_resolve
         self._task: Optional[asyncio.Task] = None
         self._running = True
         self._task = asyncio.ensure_future(self._poll())

@@ -16,7 +16,12 @@ import re
 from typing import Dict, List, Optional
 
 from gubernator_tpu.api.types import PeerInfo
-from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.service.config import (
+    BehaviorConfig,
+    DaemonConfig,
+    EtcdConfig,
+    K8sConfig,
+)
 from gubernator_tpu.service.tls import TlsConfig
 
 _DUR_RE = re.compile(r"([0-9.]+)(ns|us|µs|ms|s|m|h)")
@@ -93,17 +98,27 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             "GUBER_GLOBAL_PEER_REQUESTS_CONCURRENCY", 100
         ),
         force_global=_env_bool("GUBER_FORCE_GLOBAL"),
+        disable_batching=_env_bool("GUBER_DISABLE_BATCHING"),
     )
 
     conf = DaemonConfig(
         instance_id=_env("GUBER_INSTANCE_ID", ""),
         grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "127.0.0.1:81"),
         http_listen_address=_env("GUBER_HTTP_ADDRESS", "127.0.0.1:80"),
+        status_http_listen_address=_env("GUBER_STATUS_HTTP_ADDRESS", ""),
         advertise_address=_env("GUBER_ADVERTISE_ADDRESS", ""),
         data_center=_env("GUBER_DATA_CENTER", ""),
         cache_size=_env_int("GUBER_CACHE_SIZE", 50_000),
         behaviors=behaviors,
         global_mode=_env("GUBER_GLOBAL_MODE", "grpc"),
+        grpc_max_conn_age_s=float(_env_int("GUBER_GRPC_MAX_CONN_AGE_SEC", 0)),
+        trace_level=_env("GUBER_TRACING_LEVEL", "INFO").upper(),
+        log_level=_env("GUBER_LOG_LEVEL", "info"),
+        log_format=_env("GUBER_LOG_FORMAT", ""),
+        debug=_env_bool("GUBER_DEBUG"),
+        # Sizes the reference's goroutine pool; N/A for the device engine
+        # (see DaemonConfig.worker_count).
+        worker_count=_env_int("GUBER_WORKER_COUNT", 0),
     )
 
     # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2
@@ -124,15 +139,92 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     conf.discovery = _env("GUBER_PEER_DISCOVERY_TYPE", "static")
     conf.dns_fqdn = _env("GUBER_DNS_FQDN", "")
     conf.dns_interval_s = parse_duration_s(_env("GUBER_DNS_POLL_INTERVAL"), 300.0)
+    conf.dns_resolv_conf = _env("GUBER_RESOLV_CONF", "/etc/resolv.conf")
     # member-list / gossip (reference GUBER_MEMBERLIST_* envs)
     conf.gossip_bind = _env("GUBER_MEMBERLIST_ADDRESS", "")
+    conf.gossip_advertise = _env("GUBER_MEMBERLIST_ADVERTISE_ADDRESS", "")
     known = _env("GUBER_MEMBERLIST_KNOWN_NODES", "")
     conf.gossip_seeds = [n.strip() for n in known.split(",") if n.strip()]
     conf.gossip_interval_s = parse_duration_s(
         _env("GUBER_MEMBERLIST_GOSSIP_INTERVAL"), 1.0
     )
+    if conf.discovery == "member-list" and not conf.gossip_seeds:
+        raise ValueError(
+            "when using `member-list` for peer discovery, you MUST provide a "
+            "hostname of a known host in the cluster via "
+            "`GUBER_MEMBERLIST_KNOWN_NODES`"
+        )
 
-    conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1")
+    # etcd block (reference GUBER_ETCD_*, config.go:380-404; the reference
+    # also accepts the misspelled GUBER_ETCD_TLS_EABLED, config.go:701)
+    if conf.discovery == "etcd" or any(
+        k.startswith("GUBER_ETCD_") for k in os.environ
+    ):
+        endpoints = _env("GUBER_ETCD_ENDPOINTS", "localhost:2379")
+        conf.etcd = EtcdConfig(
+            endpoints=[e.strip() for e in endpoints.split(",") if e.strip()],
+            key_prefix=_env("GUBER_ETCD_KEY_PREFIX", "/gubernator-peers"),
+            advertise_address=_env(
+                "GUBER_ETCD_ADVERTISE_ADDRESS", conf.advertise_address
+            ),
+            data_center=_env("GUBER_ETCD_DATA_CENTER", conf.data_center),
+            dial_timeout_s=parse_duration_s(_env("GUBER_ETCD_DIAL_TIMEOUT"), 5.0),
+            user=_env("GUBER_ETCD_USER", ""),
+            password=_env("GUBER_ETCD_PASSWORD", ""),
+            tls_enabled=_env_bool("GUBER_ETCD_TLS_ENABLE")
+            or _env_bool("GUBER_ETCD_TLS_ENABLED")
+            or _env_bool("GUBER_ETCD_TLS_EABLED"),  # reference's misspelling
+            tls_ca=_env("GUBER_ETCD_TLS_CA", ""),
+            tls_cert=_env("GUBER_ETCD_TLS_CERT", ""),
+            tls_key=_env("GUBER_ETCD_TLS_KEY", ""),
+            tls_skip_verify=_env_bool("GUBER_ETCD_TLS_SKIP_VERIFY"),
+        )
+
+    # k8s block (reference GUBER_K8S_*, config.go:405-413 + selector
+    # validation :445-449)
+    if conf.discovery == "k8s" or any(
+        k.startswith("GUBER_K8S_") for k in os.environ
+    ):
+        mech = _env("GUBER_K8S_WATCH_MECHANISM", "endpoints") or "endpoints"
+        if mech not in ("endpoints", "pods"):
+            raise ValueError(
+                "invalid value for watch mechanism `GUBER_K8S_WATCH_MECHANISM` "
+                "needs to be either 'endpoints' or 'pods' (defaults to "
+                "'endpoints')"
+            )
+        conf.k8s = K8sConfig(
+            namespace=_env("GUBER_K8S_NAMESPACE", "default"),
+            pod_ip=_env("GUBER_K8S_POD_IP", ""),
+            pod_port=_env("GUBER_K8S_POD_PORT", ""),
+            selector=_env("GUBER_K8S_ENDPOINTS_SELECTOR", ""),
+            mechanism=mech,
+        )
+        if conf.discovery == "k8s" and not conf.k8s.selector:
+            raise ValueError(
+                "when using k8s for peer discovery, you MUST provide a "
+                "`GUBER_K8S_ENDPOINTS_SELECTOR` to select the gubernator "
+                "peers from the endpoints listing"
+            )
+
+    # Peer picker (reference config.go:421-443): GUBER_PEER_PICKER selects
+    # the implementation (only replicated-hash exists); its hash defaults
+    # to fnv1a when selected explicitly, fnv1 otherwise (matching the
+    # reference's two defaults).
+    picker = _env("GUBER_PEER_PICKER", "")
+    if picker:
+        if picker != "replicated-hash":
+            raise ValueError(
+                f"'GUBER_PEER_PICKER={picker}' is invalid; choices are "
+                "['replicated-hash', 'consistent-hash']"
+            )
+        conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1a")
+    else:
+        conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1")
+    if conf.peer_picker_hash not in ("fnv1", "fnv1a"):
+        raise ValueError(
+            f"'GUBER_PEER_PICKER_HASH={conf.peer_picker_hash}' is invalid; "
+            "choices are [fnv1, fnv1a]"
+        )
     conf.hash_replicas = _env_int("GUBER_REPLICATED_HASH_REPLICAS", 512)
 
     # Optional process/runtime collectors (reference flags.go:19-57,
@@ -141,6 +233,17 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         f.strip() for f in _env("GUBER_METRIC_FLAGS").split(",") if f.strip()
     ]
 
+    import ssl as _ssl
+
+    # Reference getEnvMinVersion (config.go:580-597): "1.0"-"1.3", unknown
+    # values fall back to the highest supported version.
+    min_map = {
+        "": _ssl.TLSVersion.TLSv1_3,  # reference default when unset
+        "1.0": _ssl.TLSVersion.TLSv1,
+        "1.1": _ssl.TLSVersion.TLSv1_1,
+        "1.2": _ssl.TLSVersion.TLSv1_2,
+        "1.3": _ssl.TLSVersion.TLSv1_3,
+    }
     tls = TlsConfig(
         ca_file=_env("GUBER_TLS_CA"),
         ca_key_file=_env("GUBER_TLS_CA_KEY"),
@@ -148,6 +251,9 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         key_file=_env("GUBER_TLS_KEY"),
         auto_tls=_env_bool("GUBER_TLS_AUTO"),
         client_auth_ca_file=_env("GUBER_TLS_CLIENT_AUTH_CA_CERT"),
+        client_auth_cert_file=_env("GUBER_TLS_CLIENT_AUTH_CERT"),
+        client_auth_key_file=_env("GUBER_TLS_CLIENT_AUTH_KEY"),
+        client_auth_server_name=_env("GUBER_TLS_CLIENT_AUTH_SERVER_NAME"),
         client_auth={
             "": "none",
             "request": "request",
@@ -155,6 +261,9 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             "require-and-verify": "require",
         }.get(_env("GUBER_TLS_CLIENT_AUTH"), "none"),
         insecure_skip_verify=_env_bool("GUBER_TLS_INSECURE_SKIP_VERIFY"),
+        min_version=min_map.get(
+            _env("GUBER_TLS_MIN_VERSION").strip(), _ssl.TLSVersion.TLSv1_3
+        ),
     )
     conf.tls = (
         tls

@@ -71,3 +71,16 @@ def build_app(svc: V1Service) -> web.Application:
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     return app
+
+
+def build_status_app(svc: V1Service) -> web.Application:
+    """Health-only app for the no-mTLS status listener (reference
+    daemon.go:305-333 serves ONLY /v1/HealthCheck there)."""
+    app = web.Application()
+
+    async def health_check(request: web.Request) -> web.Response:
+        h = await svc.health_check()
+        return web.json_response(pb.health_to_json(h))
+
+    app.router.add_get("/v1/HealthCheck", health_check)
+    return app

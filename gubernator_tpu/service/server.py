@@ -215,8 +215,12 @@ class V1Service:
             ctx = tracing.propagate_extract(req.metadata)
             if ctx is not None:
                 with tracing.attached(ctx):
+                    # Per-peer span: DEBUG-level, dropped at the default
+                    # INFO trace level (reference config.go:736-752).
                     with tracing.span(
-                        "V1Instance.getLocalRateLimit", key=req.hash_key()
+                        "V1Instance.getLocalRateLimit",
+                        level="DEBUG",
+                        key=req.hash_key(),
                     ):
                         pass
             if has_behavior(req.behavior, Behavior.GLOBAL):

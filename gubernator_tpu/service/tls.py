@@ -35,6 +35,14 @@ class TlsConfig:
     client_auth: str = "none"
     client_auth_ca_file: str = ""
     client_auth_ca_pem: bytes = b""
+    # Dedicated client-side identity for dialing mTLS peers (reference
+    # ClientAuthCertFile/ClientAuthKeyFile/ClientAuthServerName,
+    # tls.go:70-90); falls back to the server cert pair when unset.
+    client_auth_cert_file: str = ""
+    client_auth_key_file: str = ""
+    client_auth_cert_pem: bytes = b""
+    client_auth_key_pem: bytes = b""
+    client_auth_server_name: str = ""
     insecure_skip_verify: bool = False
     min_version: int = ssl.TLSVersion.TLSv1_2
 
@@ -117,6 +125,10 @@ def setup_tls(conf: TlsConfig, hosts: Optional[List[str]] = None) -> TlsConfig:
         conf.key_pem = _read(conf.key_file)
     if conf.client_auth_ca_file:
         conf.client_auth_ca_pem = _read(conf.client_auth_ca_file)
+    if conf.client_auth_cert_file:
+        conf.client_auth_cert_pem = _read(conf.client_auth_cert_file)
+    if conf.client_auth_key_file:
+        conf.client_auth_key_pem = _read(conf.client_auth_key_file)
     if conf.auto_tls and not conf.cert_pem:
         ca, ca_key, cert, key = generate_self_signed(hosts or ["localhost", "127.0.0.1"])
         if not conf.ca_pem:
@@ -142,10 +154,14 @@ def server_credentials(conf: TlsConfig) -> grpc.ServerCredentials:
 def client_credentials(
     conf: TlsConfig, client_cert: bool = False
 ) -> grpc.ChannelCredentials:
+    # A dedicated client-auth identity wins over reusing the server pair
+    # (reference tls.go:70-90).
+    key = conf.client_auth_key_pem or conf.key_pem
+    chain = conf.client_auth_cert_pem or conf.cert_pem
     return grpc.ssl_channel_credentials(
         root_certificates=conf.ca_pem or None,
-        private_key=conf.key_pem if client_cert else None,
-        certificate_chain=conf.cert_pem if client_cert else None,
+        private_key=key if client_cert else None,
+        certificate_chain=chain if client_cert else None,
     )
 
 
@@ -157,13 +173,18 @@ def client_channel_options(conf: TlsConfig, host: str = "") -> tuple:
     server name (covers the common self-signed/SAN-mismatch case). The
     chain must still anchor at ca_pem or the system roots.
     """
+    if conf.client_auth_server_name:
+        return (("grpc.ssl_target_name_override", conf.client_auth_server_name),)
     if conf.insecure_skip_verify:
         return (("grpc.ssl_target_name_override", "localhost"),)
     return ()
 
 
-def http_ssl_context(conf: TlsConfig) -> ssl.SSLContext:
-    """Server-side context for the aiohttp gateway listener."""
+def http_ssl_context(conf: TlsConfig, no_client_auth: bool = False) -> ssl.SSLContext:
+    """Server-side context for the aiohttp gateway listener.
+
+    no_client_auth builds the status-listener variant that never requests
+    a client certificate (reference daemon.go:316 ClientAuth=NoClientCert)."""
     import tempfile
 
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -176,7 +197,7 @@ def http_ssl_context(conf: TlsConfig) -> ssl.SSLContext:
         kf.write(conf.key_pem)
         kf.flush()
         ctx.load_cert_chain(cf.name, kf.name)
-    if conf.client_auth != "none":
+    if conf.client_auth != "none" and not no_client_auth:
         # Mirror server_credentials: a dedicated client-auth CA takes
         # precedence over the serving CA, and 'request' maps to OPTIONAL
         # (reference tls.go client-auth modes).

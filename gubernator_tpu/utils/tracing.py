@@ -31,10 +31,34 @@ except Exception:  # pragma: no cover - otel not installed
     _TRACER = None
 
 
+# Span verbosity (reference GUBER_TRACING_LEVEL, config.go:717-752): at
+# INFO (default) the reference filters out noisy per-peer/healthcheck
+# spans; DEBUG keeps everything; ERROR keeps only spans created with
+# level="ERROR" — the failure-path spans (the reference's holster
+# tracing levels spans at creation the same way).
+_LEVELS = {"ERROR": 0, "INFO": 1, "DEBUG": 2}
+_LEVEL = 1
+
+
+def set_trace_level(level: str) -> None:
+    global _LEVEL
+    _LEVEL = _LEVELS.get(str(level).upper(), 1)
+
+
+def get_trace_level() -> str:
+    return {v: k for k, v in _LEVELS.items()}[_LEVEL]
+
+
 @contextlib.contextmanager
-def span(name: str, **attributes):
-    """Named scope (the reference's tracing.StartNamedScope analog)."""
-    if not _OTEL:
+def span(name: str, level: str = "INFO", **attributes):
+    """Named scope (the reference's tracing.StartNamedScope analog).
+
+    `level` tags the span's verbosity at creation: spans above the
+    configured GUBER_TRACING_LEVEL are skipped entirely (the reference
+    drops per-peer/healthcheck spans below DEBUG, config.go:736-752).
+    Failure paths create level="ERROR" spans, which survive every
+    configured level."""
+    if not _OTEL or _LEVELS.get(str(level).upper(), 1) > _LEVEL:
         yield None
         return
     with _TRACER.start_as_current_span(name) as s:

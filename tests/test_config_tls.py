@@ -110,3 +110,147 @@ def test_tls_cluster_end_to_end(loop_thread):
         assert seen == [90, 80, 70]
     finally:
         loop_thread.run(c.stop())
+
+
+def test_setup_daemon_config_parity_tail(monkeypatch):
+    """VERDICT r1 item 7: the remaining GUBER_* catalog (reference
+    config.go:270-479 / example.conf) — etcd block, k8s block, TLS
+    min-version + client-auth trio, tracing level, peer picker, hardening
+    knobs."""
+    import ssl
+
+    env = {
+        "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+        "GUBER_HTTP_ADDRESS": "127.0.0.1:0",
+        "GUBER_STATUS_HTTP_ADDRESS": "127.0.0.1:0",
+        "GUBER_GRPC_MAX_CONN_AGE_SEC": "30",
+        "GUBER_TRACING_LEVEL": "DEBUG",
+        "GUBER_DISABLE_BATCHING": "true",
+        "GUBER_WORKER_COUNT": "16",
+        "GUBER_RESOLV_CONF": "/tmp/resolv.conf",
+        "GUBER_MEMBERLIST_ADVERTISE_ADDRESS": "10.0.0.5:7946",
+        "GUBER_MEMBERLIST_KNOWN_NODES": "seed:7946",
+        "GUBER_PEER_PICKER": "replicated-hash",
+        "GUBER_REPLICATED_HASH_REPLICAS": "128",
+        "GUBER_TLS_MIN_VERSION": "1.2",
+        "GUBER_TLS_AUTO": "true",
+        "GUBER_TLS_CLIENT_AUTH_SERVER_NAME": "gubernator.example",
+        "GUBER_ETCD_ENDPOINTS": "e1:2379,e2:2379",
+        "GUBER_ETCD_KEY_PREFIX": "/custom-peers",
+        "GUBER_ETCD_DIAL_TIMEOUT": "2s",
+        "GUBER_ETCD_USER": "u",
+        "GUBER_ETCD_PASSWORD": "p",
+        "GUBER_ETCD_TLS_EABLED": "true",  # reference's misspelled alias
+        "GUBER_K8S_NAMESPACE": "prod",
+        "GUBER_K8S_POD_IP": "10.1.2.3",
+        "GUBER_K8S_POD_PORT": "81",
+        "GUBER_K8S_ENDPOINTS_SELECTOR": "app=gubernator",
+        "GUBER_K8S_WATCH_MECHANISM": "pods",
+        "GUBER_LOG_LEVEL": "debug",
+        "GUBER_LOG_FORMAT": "json",
+        "GUBER_DEBUG": "true",
+    }
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    conf = setup_daemon_config()
+    assert conf.grpc_max_conn_age_s == 30
+    assert conf.trace_level == "DEBUG"
+    assert conf.behaviors.disable_batching is True
+    assert conf.worker_count == 16
+    assert conf.status_http_listen_address == "127.0.0.1:0"
+    assert conf.dns_resolv_conf == "/tmp/resolv.conf"
+    assert conf.gossip_advertise == "10.0.0.5:7946"
+    # GUBER_PEER_PICKER selected -> hash defaults to fnv1a (reference
+    # config.go:429)
+    assert conf.peer_picker_hash == "fnv1a"
+    assert conf.hash_replicas == 128
+    assert conf.tls.min_version == ssl.TLSVersion.TLSv1_2
+    assert conf.tls.client_auth_server_name == "gubernator.example"
+    assert conf.etcd is not None
+    assert conf.etcd.endpoints == ["e1:2379", "e2:2379"]
+    assert conf.etcd.key_prefix == "/custom-peers"
+    assert conf.etcd.dial_timeout_s == 2.0
+    assert conf.etcd.user == "u" and conf.etcd.password == "p"
+    assert conf.etcd.tls_enabled is True
+    assert conf.k8s is not None
+    assert conf.k8s.namespace == "prod"
+    assert conf.k8s.mechanism == "pods"
+    assert conf.k8s.selector == "app=gubernator"
+    assert conf.log_level == "debug" and conf.log_format == "json"
+    assert conf.debug is True
+
+
+def test_env_validation_errors(monkeypatch):
+    import pytest as _pytest
+
+    monkeypatch.setenv("GUBER_PEER_PICKER", "bogus")
+    with _pytest.raises(ValueError, match="GUBER_PEER_PICKER"):
+        setup_daemon_config()
+    monkeypatch.delenv("GUBER_PEER_PICKER")
+
+    monkeypatch.setenv("GUBER_PEER_DISCOVERY_TYPE", "k8s")
+    with _pytest.raises(ValueError, match="GUBER_K8S_ENDPOINTS_SELECTOR"):
+        setup_daemon_config()
+    monkeypatch.setenv("GUBER_K8S_WATCH_MECHANISM", "bogus")
+    with _pytest.raises(ValueError, match="GUBER_K8S_WATCH_MECHANISM"):
+        setup_daemon_config()
+    monkeypatch.delenv("GUBER_K8S_WATCH_MECHANISM")
+    monkeypatch.delenv("GUBER_PEER_DISCOVERY_TYPE")
+
+    monkeypatch.setenv("GUBER_PEER_DISCOVERY_TYPE", "member-list")
+    with _pytest.raises(ValueError, match="GUBER_MEMBERLIST_KNOWN_NODES"):
+        setup_daemon_config()
+
+
+def test_status_listener_and_recv_cap(loop_thread):
+    """The no-mTLS status listener serves ONLY /v1/HealthCheck (reference
+    daemon.go:305-333) and the gRPC server enforces the reference's 1MB
+    receive cap (daemon.go:122)."""
+    import grpc
+    import requests
+
+    from gubernator_tpu.service import pb, rpc
+    from gubernator_tpu.service.daemon import Daemon
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        status_http_listen_address="127.0.0.1:0",
+        cache_size=1024,
+    )
+    d = loop_thread.run(Daemon.spawn(conf), timeout=120)
+    try:
+        h = requests.get(
+            f"http://{d.status_address}/v1/HealthCheck", timeout=5
+        ).json()
+        assert h["status"] == "healthy"
+        # Status listener must NOT serve the full API.
+        r = requests.post(
+            f"http://{d.status_address}/v1/GetRateLimits",
+            json={"requests": []},
+            timeout=5,
+        )
+        assert r.status_code in (404, 405)
+
+        async def oversized():
+            async with grpc.aio.insecure_channel(d.grpc_address) as ch:
+                stub = rpc.V1Stub(ch)
+                msg = pb.pb.GetRateLimitsReq()
+                big = "x" * 2048
+                for i in range(700):  # ~1.4MB of metadata
+                    msg.requests.append(
+                        pb.pb.RateLimitReq(
+                            name="big", unique_key=f"k{i}", duration=60000,
+                            limit=10, hits=1, metadata={"pad": big},
+                        )
+                    )
+                try:
+                    await stub.get_rate_limits(msg, timeout=10)
+                except grpc.aio.AioRpcError as e:
+                    return e.code()
+                return None
+
+        code = loop_thread.run(oversized())
+        assert code == grpc.StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        loop_thread.run(d.close())

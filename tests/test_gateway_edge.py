@@ -78,3 +78,24 @@ def test_metadata_round_trip(addr):
         "limit": 10, "hits": 1, "metadata": {"tenant": "abc"}}]})
     assert r.status_code == 200
     assert r.json()["responses"][0].get("error", "") == ""
+
+
+def test_metrics_counter_type_lines():
+    """VERDICT r1 item 9: counter-style metrics must expose a correct
+    `# TYPE <name> counter` line while keeping the reference's bare Go
+    sample names (no `_total` suffix)."""
+    from gubernator_tpu.metrics import Metrics
+
+    m = Metrics()
+    m.getratelimit_counter.labels("local").inc()
+    m.over_limit_counter.inc(3)
+    text = m.render().decode()
+    assert "# TYPE gubernator_getratelimit_counter counter" in text
+    assert 'gubernator_getratelimit_counter{calltype="local"} 1.0' in text
+    assert "# TYPE gubernator_over_limit_counter counter" in text
+    assert "gubernator_over_limit_counter 3.0" in text
+    assert "_total" not in text.replace("duration_count", "")
+    # gauges stay gauges
+    assert "# TYPE gubernator_cache_size gauge" in text
+    # summaries keep _count/_sum names the functional tests poll
+    assert "gubernator_broadcast_duration_count" in text

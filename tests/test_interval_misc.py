@@ -67,3 +67,76 @@ def test_force_global(loop_thread):
         assert "owner" in rl.metadata  # GLOBAL replica path, not forwarding
     finally:
         loop_thread.run(c.stop())
+
+
+def test_dns_answer_parser_mixed_labels_and_pointer():
+    """Names mixing literal labels with a trailing compression pointer
+    (RFC 1035 §4.1.4) must parse; malformed answers must not escape the
+    resolver's error handling."""
+    import functools
+    import os
+    import socket
+    import struct
+    import tempfile
+    import threading
+
+    import gubernator_tpu.service.discovery as disc
+
+    def build_response(txid, fqdn):
+        hdr = struct.pack(">HHHHHH", txid, 0x8180, 1, 2, 0, 0)
+        qname = b"".join(
+            bytes([len(p)]) + p.encode() for p in fqdn.split(".")
+        ) + b"\x00"
+        q = qname + struct.pack(">HH", 1, 1)
+        # answer 1: pure pointer name -> A 10.0.0.1
+        a1 = b"\xc0\x0c" + struct.pack(">HHIH", 1, 1, 60, 4) + bytes(
+            [10, 0, 0, 1]
+        )
+        # answer 2: literal label "lb" + pointer -> A 10.0.0.2 (the
+        # mixed form bind/dnsmasq emit for CNAME chains)
+        a2 = b"\x02lb\xc0\x0c" + struct.pack(">HHIH", 1, 1, 60, 4) + bytes(
+            [10, 0, 0, 2]
+        )
+        return hdr + q + a1 + a2
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def serve():
+        for _ in range(2):  # A then AAAA query
+            data, addr = srv.recvfrom(4096)
+            txid = struct.unpack(">H", data[:2])[0]
+            srv.sendto(build_response(txid, "peers.test"), addr)
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    with tempfile.NamedTemporaryFile("w", suffix=".conf", delete=False) as f:
+        f.write("nameserver 127.0.0.1\n")
+        path = f.name
+    orig = disc._query_nameserver
+    disc._query_nameserver = functools.partial(orig, port=port)
+    try:
+        ips = disc.resolve_with_resolv_conf("peers.test", path)
+        assert ips == ["10.0.0.1", "10.0.0.2"], ips
+    finally:
+        disc._query_nameserver = orig
+        os.unlink(path)
+        srv.close()
+
+
+def test_trace_level_gating():
+    from gubernator_tpu.utils import tracing
+
+    try:
+        tracing.set_trace_level("ERROR")
+        assert tracing.get_trace_level() == "ERROR"
+        tracing.set_trace_level("INFO")
+        assert tracing.get_trace_level() == "INFO"
+        # gating logic is exercised regardless of an OTel SDK being
+        # configured: spans above the level yield None without touching
+        # the tracer
+        with tracing.span("x", level="DEBUG") as s:
+            assert s is None
+    finally:
+        tracing.set_trace_level("INFO")

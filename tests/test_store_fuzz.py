@@ -333,3 +333,62 @@ def test_store_outage_is_a_miss_not_a_crash():
         assert eng.check_batch([mk(b, 1)])[0].remaining == 98
     finally:
         eng.close()
+
+
+def test_same_flush_own_hits_survive_displacement():
+    """Review finding r2: one flush [A, B, A] with A,B colliding (ways=1).
+    A's wave-0 hit must survive B's displacement — the wave-2 read-through
+    must reuse the SAME-FLUSH decided state, not the pre-flush store
+    snapshot (which would silently uncount A's first hit)."""
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=4, ways=1, batch_size=8, batch_wait_s=0.05),
+        now_fn=lambda: clock["now"],
+    )
+    store = MemoryStore()
+    attach_store(eng, store)
+    oracle = OracleEngine()
+    a, b = _colliding_keys(4, 2)[:2]
+
+    def mk(key, hits, behavior=0):
+        return RateLimitReq(
+            name="sf", unique_key=key, duration=600_000, limit=100,
+            hits=hits, behavior=behavior,
+        )
+
+    try:
+        # Seed both keys so the store has pre-flush state for each.
+        eng.check_batch([mk(a, 10)])
+        oracle.decide(mk(a, 10), clock["now"])
+        clock["now"] += 5
+        eng.check_batch([mk(b, 20)])
+        oracle.decide(mk(b, 20), clock["now"])
+        clock["now"] += 5
+        # ONE flush, three waves: A, B, A.
+        got = eng.check_batch([mk(a, 1), mk(b, 1), mk(a, 1)])
+        want = [
+            oracle.decide(mk(a, 1), clock["now"]),
+            oracle.decide(mk(b, 1), clock["now"]),
+            oracle.decide(mk(a, 1), clock["now"]),
+        ]
+        assert [g.remaining for g in got] == [w.remaining for w in want] == [
+            89, 79, 88,
+        ]
+        # And the persisted value reflects BOTH of A's hits.
+        snap = store.get(mk(a, 0))
+        assert snap is not None and snap.remaining == 88
+        # Same-flush RESET + return: [A RESET(frees), B, A] — A's final
+        # request must see a fresh bucket (store remove lands at flush
+        # end), not resurrect pre-flush state.
+        clock["now"] += 5
+        got = eng.check_batch(
+            [mk(a, 1, int(Behavior.RESET_REMAINING)), mk(b, 1), mk(a, 1)]
+        )
+        want = [
+            oracle.decide(mk(a, 1, int(Behavior.RESET_REMAINING)), clock["now"]),
+            oracle.decide(mk(b, 1), clock["now"]),
+            oracle.decide(mk(a, 1), clock["now"]),
+        ]
+        assert [g.remaining for g in got] == [w.remaining for w in want]
+    finally:
+        eng.close()
