@@ -177,7 +177,7 @@ class Daemon:
         # Discovery pool pushes membership through set_peers
         # (reference daemon.go:208-243). Unknown/unavailable backends fail
         # fast rather than silently serving as a cluster of one.
-        from gubernator_tpu.service.discovery import POOLS, DnsPool, StaticPool
+        from gubernator_tpu.service.discovery import DnsPool, StaticPool
 
         self._pool = None
         if conf.discovery == "dns":
@@ -205,9 +205,27 @@ class Daemon:
                 advertise=conf.gossip_advertise,
             )
             await self._pool.started()  # resolve the ephemeral bind
-        elif conf.discovery in POOLS:
-            # gated backends (etcd/k8s) raise a clear error
-            self._pool = POOLS[conf.discovery](on_update=self.set_peers)
+        elif conf.discovery == "etcd":
+            from gubernator_tpu.service.config import EtcdConfig
+            from gubernator_tpu.service.etcd import EtcdPool
+
+            econf = conf.etcd or EtcdConfig()
+            if not econf.advertise_address:
+                econf.advertise_address = advertise
+            self._pool = EtcdPool(
+                econf,
+                PeerInfo(
+                    grpc_address=econf.advertise_address,
+                    http_address=self.http_address,
+                    data_center=conf.data_center,
+                ),
+                self.set_peers,
+            )
+        elif conf.discovery == "k8s":
+            from gubernator_tpu.service.config import K8sConfig
+            from gubernator_tpu.service.k8s import K8sPool
+
+            self._pool = K8sPool(conf.k8s or K8sConfig(), self.set_peers)
         else:
             raise ValueError(f"unknown peer discovery type: {conf.discovery!r}")
 

@@ -11,10 +11,11 @@ daemon.go:208-243). Same shape here:
   each address becomes a peer at fixed ports (reference dns.go:130-218).
 - GossipPool ("member-list"): dependency-free UDP gossip membership —
   the memberlist-style backend implemented on stdlib asyncio.
-- EtcdPool / K8sPool: gated — their client libraries are not in this
-  image; constructing one raises a clear error naming the missing
-  dependency. The watch/lease protocols are documented seams for when
-  the dependency is available.
+- EtcdPool (service/etcd.py): lease registration + keepalive +
+  re-register-on-loss + prefix watch over a hand-rolled etcd v3 gRPC
+  client (reference etcd.go:42-352).
+- K8sPool (service/k8s.py): informer-equivalent HTTP list+watch of
+  Endpoints/Pods with readiness filtering (reference kubernetes.go:35-247).
 
 The JAX device mesh is static per process, so discovery governs the
 *host* layer only; a mesh reconfiguration is a restart/resharding event
@@ -384,29 +385,13 @@ class GossipPool:
             self._transport.close()
 
 
-def _gated(name: str, dep: str):
-    class _Gated:
-        def __init__(self, *a, **kw):
-            raise RuntimeError(
-                f"{name} discovery requires the '{dep}' package, which is "
-                f"not available in this environment. Use 'static' or 'dns' "
-                f"discovery, or install {dep}."
-            )
+# Real etcd/k8s backends live in their own modules (service/etcd.py with
+# a hand-rolled etcdserverpb wire client; service/k8s.py on the HTTP
+# list+watch API) — re-exported here for discoverability. Constructor
+# signatures are per-backend (each takes its own config block), so the
+# daemon selects backends explicitly; DISCOVERY_TYPES is the valid-name
+# registry.
+from gubernator_tpu.service.etcd import EtcdPool  # noqa: E402
+from gubernator_tpu.service.k8s import K8sPool  # noqa: E402
 
-    _Gated.__name__ = name
-    return _Gated
-
-
-# Gated backends (reference etcd.go:42-352, kubernetes.go:35-247): same
-# OnUpdate contract once their deps exist. The memberlist role is served
-# by the dependency-free GossipPool above.
-EtcdPool = _gated("EtcdPool", "etcd3")
-K8sPool = _gated("K8sPool", "kubernetes")
-
-POOLS = {
-    "static": StaticPool,
-    "dns": DnsPool,
-    "member-list": GossipPool,
-    "etcd": EtcdPool,
-    "k8s": K8sPool,
-}
+DISCOVERY_TYPES = ("static", "dns", "member-list", "etcd", "k8s")

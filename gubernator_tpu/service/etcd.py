@@ -1,0 +1,393 @@
+"""etcd v3 peer discovery (reference etcd.go:42-352).
+
+Same protocol contract as the reference's EtcdPool, on asyncio:
+
+- register: grant a 30s-TTL lease, Put `<prefix>/<grpc_address>` =
+  PeerInfo JSON bound to the lease, then stream LeaseKeepAlive; if the
+  keepalive stream dies or the server reports TTL=0, re-register with a
+  fresh lease after a short backoff (reference etcd.go:221-315).
+- watch: Range the prefix to build the peer list, then Watch the prefix
+  from that revision; any event triggers a re-Range and an OnUpdate
+  callback; watch failures restart with backoff (reference
+  etcd.go:109-219).
+- close: delete our key and revoke the lease, best-effort (reference
+  etcd.go:297-308).
+
+The wire client is a minimal hand-rolled etcdserverpb stub
+(protos/etcd.proto) speaking the real etcd gRPC API — no external etcd
+client library required. Values are PeerInfo JSON with the reference's
+field names; a non-JSON value is treated as a bare gRPC address
+(backward-compat behavior, reference etcd.go:162-172).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Dict, List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.service.config import EtcdConfig
+from gubernator_tpu.service.protos import etcd_pb2 as epb
+
+log = logging.getLogger("gubernator_tpu.etcd")
+
+ETCD_TIMEOUT_S = 10.0
+BACKOFF_S = 5.0
+DEFAULT_PREFIX = "/gubernator/peers/"
+
+_SVC_KV = "etcdserverpb.KV"
+_SVC_WATCH = "etcdserverpb.Watch"
+_SVC_LEASE = "etcdserverpb.Lease"
+_SVC_AUTH = "etcdserverpb.Auth"
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """etcd prefix query: range_end = prefix with last byte + 1 (etcd's
+    clientv3.GetPrefixRangeEnd)."""
+    b = bytearray(prefix)
+    for i in reversed(range(len(b))):
+        if b[i] < 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return b"\x00"
+
+
+class EtcdClient:
+    """Thin async client over the etcd v3 gRPC API subset.
+
+    Multiple endpoints are supported by rotation: callers invoke
+    next_endpoint() after persistent failures and the channel + stubs
+    rebuild against the next configured member (the official client
+    load-balances; rotation gives the same availability property —
+    a healthy member is eventually used)."""
+
+    def __init__(self, conf: EtcdConfig):
+        self.conf = conf
+        self.endpoints = list(conf.endpoints) or ["localhost:2379"]
+        self._endpoint_ix = 0
+        self.channel = None
+        self._token: Optional[str] = None
+        self._build()
+
+    def next_endpoint(self) -> None:
+        """Rotate to the next configured etcd member (failover)."""
+        if len(self.endpoints) <= 1:
+            return
+        old = self.channel
+        self._endpoint_ix = (self._endpoint_ix + 1) % len(self.endpoints)
+        self._token = None  # tokens are per-member sessions
+        self._build()
+        if old is not None:
+            asyncio.ensure_future(old.close())
+        log.info("etcd failover to %s", self.endpoints[self._endpoint_ix])
+
+    def _build(self) -> None:
+        conf = self.conf
+        target = self.endpoints[self._endpoint_ix]
+        options = ()
+        if conf.tls_enabled:
+            from gubernator_tpu.service.tls import TlsConfig, client_credentials, setup_tls
+
+            tls = TlsConfig(
+                ca_file=conf.tls_ca,
+                cert_file=conf.tls_cert,
+                key_file=conf.tls_key,
+                insecure_skip_verify=conf.tls_skip_verify,
+            )
+            setup_tls(tls)
+            creds = client_credentials(tls, client_cert=bool(tls.cert_pem))
+            if conf.tls_skip_verify:
+                options = (("grpc.ssl_target_name_override", "localhost"),)
+            self.channel = grpc.aio.secure_channel(target, creds, options=options)
+        else:
+            self.channel = grpc.aio.insecure_channel(target)
+        ch = self.channel
+        self.range = ch.unary_unary(
+            f"/{_SVC_KV}/Range",
+            request_serializer=epb.RangeRequest.SerializeToString,
+            response_deserializer=epb.RangeResponse.FromString,
+        )
+        self.put = ch.unary_unary(
+            f"/{_SVC_KV}/Put",
+            request_serializer=epb.PutRequest.SerializeToString,
+            response_deserializer=epb.PutResponse.FromString,
+        )
+        self.delete_range = ch.unary_unary(
+            f"/{_SVC_KV}/DeleteRange",
+            request_serializer=epb.DeleteRangeRequest.SerializeToString,
+            response_deserializer=epb.DeleteRangeResponse.FromString,
+        )
+        self.lease_grant = ch.unary_unary(
+            f"/{_SVC_LEASE}/LeaseGrant",
+            request_serializer=epb.LeaseGrantRequest.SerializeToString,
+            response_deserializer=epb.LeaseGrantResponse.FromString,
+        )
+        self.lease_revoke = ch.unary_unary(
+            f"/{_SVC_LEASE}/LeaseRevoke",
+            request_serializer=epb.LeaseRevokeRequest.SerializeToString,
+            response_deserializer=epb.LeaseRevokeResponse.FromString,
+        )
+        self.lease_keepalive = ch.stream_stream(
+            f"/{_SVC_LEASE}/LeaseKeepAlive",
+            request_serializer=epb.LeaseKeepAliveRequest.SerializeToString,
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )
+        self.watch = ch.stream_stream(
+            f"/{_SVC_WATCH}/Watch",
+            request_serializer=epb.WatchRequest.SerializeToString,
+            response_deserializer=epb.WatchResponse.FromString,
+        )
+        self.authenticate = ch.unary_unary(
+            f"/{_SVC_AUTH}/Authenticate",
+            request_serializer=epb.AuthenticateRequest.SerializeToString,
+            response_deserializer=epb.AuthenticateResponse.FromString,
+        )
+        self._token: Optional[str] = None
+
+    async def auth_metadata(self) -> Sequence:
+        """user/password auth: Authenticate once, then send the token on
+        every call (etcd's `token` metadata header)."""
+        if not self.conf.user:
+            return ()
+        if self._token is None:
+            resp = await self.authenticate(
+                epb.AuthenticateRequest(
+                    name=self.conf.user, password=self.conf.password
+                ),
+                timeout=self.conf.dial_timeout_s,
+            )
+            self._token = resp.token
+        return (("token", self._token),)
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+
+class EtcdPool:
+    """Peer discovery pool backed by etcd (reference EtcdPool)."""
+
+    def __init__(
+        self,
+        conf: EtcdConfig,
+        advertise: PeerInfo,
+        on_update: Callable[[List[PeerInfo]], None],
+        client: Optional[EtcdClient] = None,
+    ):
+        if not advertise.grpc_address:
+            raise ValueError("etcd discovery requires an advertise gRPC address")
+        self.conf = conf
+        self.advertise = advertise
+        self.on_update = on_update
+        self.client = client or EtcdClient(conf)
+        self.key_prefix = conf.key_prefix or DEFAULT_PREFIX
+        if not self.key_prefix.endswith("/"):
+            self.key_prefix += "/"
+        self._key = (self.key_prefix + advertise.grpc_address).encode()
+        self._value = json.dumps(
+            {
+                "GRPCAddress": advertise.grpc_address,
+                "HTTPAddress": advertise.http_address,
+                "DataCenter": conf.data_center or advertise.data_center,
+            }
+        ).encode()
+        self._lease_id = 0
+        self._running = True
+        self.registrations = 0  # observability: counts (re-)registrations
+        self._register_task = asyncio.ensure_future(self._register_loop())
+        self._watch_task = asyncio.ensure_future(self._watch_loop())
+
+    # -- registration + lease keepalive (reference etcd.go:221-315) ----------
+
+    async def _register_once(self) -> None:
+        md = await self.client.auth_metadata()
+        lease = await self.client.lease_grant(
+            epb.LeaseGrantRequest(TTL=int(self.conf.lease_ttl_s)),
+            timeout=ETCD_TIMEOUT_S,
+            metadata=md,
+        )
+        if lease.error:
+            raise RuntimeError(f"lease grant: {lease.error}")
+        self._lease_id = lease.ID
+        await self.client.put(
+            epb.PutRequest(key=self._key, value=self._value, lease=lease.ID),
+            timeout=ETCD_TIMEOUT_S,
+            metadata=md,
+        )
+        self.registrations += 1
+
+    async def _register_loop(self) -> None:
+        backoff = 0.5
+        while self._running:
+            try:
+                await self._register_once()
+                log.info(
+                    "registered %s with etcd (lease %d)",
+                    self.advertise.grpc_address, self._lease_id,
+                )
+                backoff = 0.5
+                await self._keepalive_until_lost()
+                if self._running:
+                    log.warning("keep alive lost, attempting to re-register peer")
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                if not self._running:
+                    return
+                log.warning("etcd registration failed: %s", e)
+                self.client.next_endpoint()
+            await asyncio.sleep(min(backoff, BACKOFF_S))
+            backoff *= 2
+
+    async def _keepalive_until_lost(self) -> None:
+        """Stream keepalives every TTL/3; returns when the lease is lost
+        (stream error, stream end, or server-reported TTL<=0)."""
+        interval = max(self.conf.lease_ttl_s / 3.0, 0.05)
+        md = await self.client.auth_metadata()
+        call = self.client.lease_keepalive(metadata=md)
+
+        async def sender():
+            try:
+                while self._running:
+                    await call.write(epb.LeaseKeepAliveRequest(ID=self._lease_id))
+                    await asyncio.sleep(interval)
+            except Exception:
+                pass
+
+        send_task = asyncio.ensure_future(sender())
+        try:
+            while self._running:
+                resp = await asyncio.wait_for(
+                    call.read(), timeout=self.conf.lease_ttl_s + ETCD_TIMEOUT_S
+                )
+                if resp is grpc.aio.EOF:
+                    return
+                if resp.TTL <= 0:  # lease expired/revoked server-side
+                    return
+        except (asyncio.TimeoutError, grpc.aio.AioRpcError):
+            return
+        finally:
+            send_task.cancel()
+            try:
+                call.cancel()
+            except Exception:
+                pass
+
+    # -- watch + peer collection (reference etcd.go:109-219) -----------------
+
+    async def _collect_peers(self) -> int:
+        md = await self.client.auth_metadata()
+        prefix = self.key_prefix.encode()
+        resp = await self.client.range(
+            epb.RangeRequest(key=prefix, range_end=prefix_range_end(prefix)),
+            timeout=ETCD_TIMEOUT_S,
+            metadata=md,
+        )
+        peers: Dict[str, PeerInfo] = {}
+        for kv in resp.kvs:
+            p = self._unmarshal(kv.value)
+            peers[p.grpc_address] = p
+        out = []
+        for p in peers.values():
+            if p.grpc_address == self.advertise.grpc_address:
+                p = PeerInfo(
+                    grpc_address=p.grpc_address,
+                    http_address=p.http_address,
+                    data_center=p.data_center,
+                    is_owner=True,
+                )
+            out.append(p)
+        self.on_update(out)
+        return resp.header.revision
+
+    def _unmarshal(self, value: bytes) -> PeerInfo:
+        try:
+            d = json.loads(value)
+            return PeerInfo(
+                grpc_address=d.get("GRPCAddress", ""),
+                http_address=d.get("HTTPAddress", ""),
+                data_center=d.get("DataCenter", ""),
+            )
+        except (json.JSONDecodeError, UnicodeDecodeError, AttributeError):
+            # Backward compat: a bare address value (reference
+            # etcd.go:162-172)
+            return PeerInfo(grpc_address=value.decode(errors="replace"))
+
+    async def _watch_loop(self) -> None:
+        while self._running:
+            try:
+                revision = await self._collect_peers()
+                md = await self.client.auth_metadata()
+                call = self.client.watch(metadata=md)
+                prefix = self.key_prefix.encode()
+                await call.write(
+                    epb.WatchRequest(
+                        create_request=epb.WatchCreateRequest(
+                            key=prefix,
+                            range_end=prefix_range_end(prefix),
+                            start_revision=revision + 1,
+                        )
+                    )
+                )
+                try:
+                    while self._running:
+                        resp = await call.read()
+                        if resp is grpc.aio.EOF or resp.canceled:
+                            break
+                        if resp.events:
+                            await self._collect_peers()
+                finally:
+                    try:
+                        call.cancel()
+                    except Exception:
+                        pass
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                if not self._running:
+                    return
+                log.warning("etcd watch failed, restarting: %s", e)
+                self.client.next_endpoint()
+            if self._running:
+                await asyncio.sleep(0.5)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Synchronous close (PoolInterface contract); schedules the
+        deregistration on the running loop."""
+        if not self._running:
+            return
+        self._running = False
+        self._register_task.cancel()
+        self._watch_task.cancel()
+        asyncio.ensure_future(self._deregister())
+
+    async def aclose(self) -> None:
+        if self._running:
+            self._running = False
+            self._register_task.cancel()
+            self._watch_task.cancel()
+        await self._deregister()
+
+    async def _deregister(self) -> None:
+        try:
+            md = await self.client.auth_metadata()
+            await self.client.delete_range(
+                epb.DeleteRangeRequest(key=self._key),
+                timeout=ETCD_TIMEOUT_S,
+                metadata=md,
+            )
+            if self._lease_id:
+                await self.client.lease_revoke(
+                    epb.LeaseRevokeRequest(ID=self._lease_id),
+                    timeout=ETCD_TIMEOUT_S,
+                    metadata=md,
+                )
+        except Exception as e:
+            log.warning("during etcd deregistration: %s", e)
+        finally:
+            await self.client.close()

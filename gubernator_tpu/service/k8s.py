@@ -1,0 +1,254 @@
+"""Kubernetes peer discovery (reference kubernetes.go:35-247).
+
+Informer-equivalent built on the Kubernetes HTTP API with aiohttp — no
+client-go analog required:
+
+- list Endpoints (default) or Pods in a namespace filtered by a label
+  selector, then open a `?watch=1` stream from the returned
+  resourceVersion; every event updates an object store and rebuilds the
+  full peer list (the reference's SharedIndexInformer re-lists its store
+  on every add/update/delete, kubernetes.go:174-247).
+- Endpoints mode: one peer per subset address at `<ip>:<pod_port>`
+  (kubernetes.go:218-245). Pods mode: one peer per pod with all
+  containers ready+running (kubernetes.go:188-216).
+- Self-detection: address IP == conf.pod_ip marks IsOwner.
+- Watch failures (410 Gone, network errors, stream end) re-list and
+  re-watch with backoff — the informer's resync behavior.
+
+In-cluster credentials come from the standard service-account mount
+(token + CA) and KUBERNETES_SERVICE_HOST/PORT; both are overridable for
+tests/off-cluster runs (reference kubernetesconfig.go in-cluster vs
+local kubeconfig split).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import ssl
+from typing import Callable, Dict, List, Optional
+
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.service.config import K8sConfig
+
+log = logging.getLogger("gubernator_tpu.k8s")
+
+BACKOFF_S = 5.0
+
+
+async def _iter_lines(stream):
+    """Yield newline-delimited chunks without aiohttp's per-line 64KB
+    readline cap — a single watch event for a large Endpoints object can
+    exceed it."""
+    buf = b""
+    while True:
+        chunk = await stream.readany()
+        if not chunk:
+            if buf.strip():
+                yield buf
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            line = line.strip()
+            if line:
+                yield line
+
+
+class K8sPool:
+    def __init__(
+        self,
+        conf: K8sConfig,
+        on_update: Callable[[List[PeerInfo]], None],
+    ):
+        if not conf.selector:
+            raise ValueError(
+                "k8s discovery requires a label selector "
+                "(GUBER_K8S_ENDPOINTS_SELECTOR)"
+            )
+        if conf.mechanism not in ("endpoints", "pods"):
+            raise ValueError(f"invalid k8s watch mechanism {conf.mechanism!r}")
+        self.conf = conf
+        self.on_update = on_update
+        self._objects: Dict[str, dict] = {}  # name -> API object
+        self._running = True
+        self._session = None
+        self._task = asyncio.ensure_future(self._run())
+
+    # -- API plumbing ---------------------------------------------------------
+
+    def _base_url(self) -> str:
+        if self.conf.api_server:
+            return self.conf.api_server.rstrip("/")
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        return f"https://{host}:{port}"
+
+    def _headers(self) -> dict:
+        try:
+            with open(self.conf.token_file) as f:
+                return {"Authorization": f"Bearer {f.read().strip()}"}
+        except OSError:
+            return {}
+
+    def _ssl(self):
+        if not self._base_url().startswith("https"):
+            return None
+        try:
+            ctx = ssl.create_default_context(cafile=self.conf.ca_file)
+        except OSError:
+            ctx = ssl.create_default_context()
+        return ctx
+
+    def _resource(self) -> str:
+        return "endpoints" if self.conf.mechanism == "endpoints" else "pods"
+
+    def _path(self) -> str:
+        return (
+            f"/api/v1/namespaces/{self.conf.namespace}/{self._resource()}"
+        )
+
+    async def _ensure_session(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    # -- list + watch loop ----------------------------------------------------
+
+    async def _run(self) -> None:
+        while self._running:
+            try:
+                rv = await self._list()
+                await self._watch(rv)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                if not self._running:
+                    return
+                log.warning("k8s watch failed, re-listing: %s", e)
+            if self._running:
+                await asyncio.sleep(min(BACKOFF_S, 1.0))
+
+    async def _list(self) -> str:
+        session = await self._ensure_session()
+        url = self._base_url() + self._path()
+        async with session.get(
+            url,
+            params={"labelSelector": self.conf.selector},
+            headers=self._headers(),
+            ssl=self._ssl(),
+        ) as resp:
+            resp.raise_for_status()
+            body = await resp.json()
+        self._objects = {
+            o["metadata"]["name"]: o for o in body.get("items", [])
+        }
+        self._rebuild()
+        return body.get("metadata", {}).get("resourceVersion", "0")
+
+    async def _watch(self, resource_version: str) -> None:
+        import aiohttp
+
+        session = await self._ensure_session()
+        url = self._base_url() + self._path()
+        async with session.get(
+            url,
+            params={
+                "labelSelector": self.conf.selector,
+                "watch": "1",
+                "resourceVersion": resource_version,
+                # Standard k8s watch bound: the server closes the stream
+                # after this long, forcing a clean re-list/re-watch even
+                # through half-open connections.
+                "timeoutSeconds": "300",
+            },
+            headers=self._headers(),
+            ssl=self._ssl(),
+            # sock_read bounds a silent half-open connection (total stays
+            # None — the watch is long-lived by design).
+            timeout=aiohttp.ClientTimeout(total=None, sock_read=330),
+        ) as resp:
+            resp.raise_for_status()
+            async for line in _iter_lines(resp.content):
+                if not self._running:
+                    return
+                ev = json.loads(line)
+                typ = ev.get("type")
+                obj = ev.get("object", {})
+                if typ == "ERROR":
+                    # e.g. 410 Gone — resourceVersion too old; re-list
+                    raise RuntimeError(f"watch error event: {obj}")
+                name = obj.get("metadata", {}).get("name")
+                if not name:
+                    continue
+                if typ == "DELETED":
+                    self._objects.pop(name, None)
+                else:  # ADDED | MODIFIED
+                    self._objects[name] = obj
+                self._rebuild()
+
+    # -- peer extraction (kubernetes.go:188-245) ------------------------------
+
+    def _rebuild(self) -> None:
+        peers: List[PeerInfo] = []
+        if self.conf.mechanism == "endpoints":
+            for obj in self._objects.values():
+                for subset in obj.get("subsets") or []:
+                    for addr in subset.get("addresses") or []:
+                        ip = addr.get("ip", "")
+                        if not ip:
+                            continue
+                        peers.append(
+                            PeerInfo(
+                                grpc_address=f"{ip}:{self.conf.pod_port}",
+                                is_owner=ip == self.conf.pod_ip,
+                            )
+                        )
+        else:
+            for obj in self._objects.values():
+                status = obj.get("status", {})
+                ip = status.get("podIP", "")
+                if not ip:
+                    continue
+                # Running is `state.running: {}` (possibly empty) — check
+                # presence, not truthiness (reference kubernetes.go:202:
+                # `status.State.Running == nil`).
+                ready = all(
+                    cs.get("ready")
+                    and (cs.get("state") or {}).get("running") is not None
+                    for cs in status.get("containerStatuses") or [{}]
+                )
+                if not ready:
+                    continue
+                peers.append(
+                    PeerInfo(
+                        grpc_address=f"{ip}:{self.conf.pod_port}",
+                        is_owner=ip == self.conf.pod_ip,
+                    )
+                )
+        self.on_update(peers)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def close(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._task.cancel()
+        if self._session is not None:
+            asyncio.ensure_future(self._session.close())
+
+    async def aclose(self) -> None:
+        self._running = False
+        self._task.cancel()
+        try:
+            await self._task
+        except (asyncio.CancelledError, Exception):
+            pass
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

@@ -129,6 +129,13 @@ def setup_tls(conf: TlsConfig, hosts: Optional[List[str]] = None) -> TlsConfig:
         conf.client_auth_cert_pem = _read(conf.client_auth_cert_file)
     if conf.client_auth_key_file:
         conf.client_auth_key_pem = _read(conf.client_auth_key_file)
+    if bool(conf.client_auth_cert_pem) != bool(conf.client_auth_key_pem):
+        # Half a dialing identity would silently pair with the server's
+        # key/cert and fail every mTLS handshake with an opaque SSL error.
+        raise ValueError(
+            "GUBER_TLS_CLIENT_AUTH_CERT and GUBER_TLS_CLIENT_AUTH_KEY must "
+            "be set together"
+        )
     if conf.auto_tls and not conf.cert_pem:
         ca, ca_key, cert, key = generate_self_signed(hosts or ["localhost", "127.0.0.1"])
         if not conf.ca_pem:

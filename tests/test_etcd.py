@@ -1,0 +1,430 @@
+"""etcd discovery backend against an in-process fake etcd v3 server
+(real gRPC, real wire messages): registration with a TTL lease, watch-
+driven peer updates, lease-loss re-registration (reference
+etcd.go:221-315), and graceful deregistration."""
+
+import asyncio
+import json
+import time
+
+import grpc
+import pytest
+
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.service.config import EtcdConfig
+from gubernator_tpu.service.etcd import EtcdClient, EtcdPool, prefix_range_end
+from gubernator_tpu.service.protos import etcd_pb2 as epb
+
+
+class FakeEtcd:
+    """In-memory etcd v3 subset: KV + Lease + Watch semantics needed by
+    the pool (prefix ranges, leases expiring keys, watch events)."""
+
+    def __init__(self):
+        self.kv = {}  # key(bytes) -> (value(bytes), lease_id)
+        self.revision = 1
+        self.leases = {}  # id -> deadline (monotonic)
+        self.ttl_s = {}  # id -> granted ttl
+        self.next_lease = 100
+        self.watchers = []  # (key, range_end, asyncio.Queue)
+        self.frozen = False  # drop keepalives (simulates partition)
+        self.events = []  # (revision, Event) log for start_revision replay
+
+    def _emit(self, ev_type, key, value=b""):
+        self.revision += 1
+        ev = epb.Event(
+            type=ev_type,
+            kv=epb.KeyValue(key=key, value=value, mod_revision=self.revision),
+        )
+        self.events.append((self.revision, ev))
+        for wkey, wend, q in list(self.watchers):
+            if wkey <= key < (wend or wkey + b"\x00"):
+                q.put_nowait(ev)
+
+    def expire_lease(self, lease_id):
+        self.leases.pop(lease_id, None)
+        for k, (v, lid) in list(self.kv.items()):
+            if lid == lease_id:
+                del self.kv[k]
+                self._emit(epb.Event.DELETE, k)
+
+    # -- servicer methods -----------------------------------------------------
+
+    async def Range(self, req, ctx):
+        kvs = [
+            epb.KeyValue(key=k, value=v, lease=lid)
+            for k, (v, lid) in sorted(self.kv.items())
+            if req.key <= k and (not req.range_end or k < req.range_end)
+        ]
+        return epb.RangeResponse(
+            header=epb.ResponseHeader(revision=self.revision),
+            kvs=kvs,
+            count=len(kvs),
+        )
+
+    async def Put(self, req, ctx):
+        if req.lease and req.lease not in self.leases:
+            await ctx.abort(grpc.StatusCode.NOT_FOUND, "lease not found")
+        self.kv[req.key] = (req.value, req.lease)
+        self._emit(epb.Event.PUT, req.key, req.value)
+        return epb.PutResponse(header=epb.ResponseHeader(revision=self.revision))
+
+    async def DeleteRange(self, req, ctx):
+        deleted = 0
+        for k in list(self.kv):
+            if req.key <= k and (not req.range_end or k < req.range_end):
+                if k == req.key or req.range_end:
+                    del self.kv[k]
+                    self._emit(epb.Event.DELETE, k)
+                    deleted += 1
+        return epb.DeleteRangeResponse(
+            header=epb.ResponseHeader(revision=self.revision), deleted=deleted
+        )
+
+    async def LeaseGrant(self, req, ctx):
+        lid = self.next_lease
+        self.next_lease += 1
+        self.leases[lid] = time.monotonic() + req.TTL
+        self.ttl_s[lid] = req.TTL
+        return epb.LeaseGrantResponse(
+            header=epb.ResponseHeader(revision=self.revision), ID=lid, TTL=req.TTL
+        )
+
+    async def LeaseRevoke(self, req, ctx):
+        self.expire_lease(req.ID)
+        return epb.LeaseRevokeResponse(
+            header=epb.ResponseHeader(revision=self.revision)
+        )
+
+    async def LeaseKeepAlive(self, request_iterator, ctx):
+        async for req in request_iterator:
+            if self.frozen:
+                continue  # partition: no responses at all
+            if req.ID in self.leases:
+                self.leases[req.ID] = time.monotonic() + self.ttl_s[req.ID]
+                yield epb.LeaseKeepAliveResponse(ID=req.ID, TTL=self.ttl_s[req.ID])
+            else:
+                yield epb.LeaseKeepAliveResponse(ID=req.ID, TTL=0)
+
+    async def Watch(self, request_iterator, ctx):
+        req = await request_iterator.__anext__()
+        cr = req.create_request
+        q = asyncio.Queue()
+        entry = (cr.key, cr.range_end, q)
+        self.watchers.append(entry)
+        # Replay history from start_revision like real etcd — a client
+        # that Ranges at revision R then watches from R+1 must not lose
+        # events emitted in between (registering the live queue first
+        # makes duplicates possible, which the client's re-Range absorbs).
+        if cr.start_revision:
+            for rev, ev in list(self.events):
+                if rev >= cr.start_revision and cr.key <= ev.kv.key < (
+                    cr.range_end or cr.key + b"\x00"
+                ):
+                    q.put_nowait(ev)
+        try:
+            yield epb.WatchResponse(
+                header=epb.ResponseHeader(revision=self.revision),
+                watch_id=1,
+                created=True,
+            )
+            while True:
+                ev = await q.get()
+                yield epb.WatchResponse(
+                    header=epb.ResponseHeader(revision=self.revision),
+                    watch_id=1,
+                    events=[ev],
+                )
+        finally:
+            self.watchers.remove(entry)
+
+
+def _handlers(fake):
+    def unary(m, req_cls, resp_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            m, request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+
+    def ss(m, req_cls, resp_cls):
+        return grpc.stream_stream_rpc_method_handler(
+            m, request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+
+    return [
+        grpc.method_handlers_generic_handler(
+            "etcdserverpb.KV",
+            {
+                "Range": unary(fake.Range, epb.RangeRequest, epb.RangeResponse),
+                "Put": unary(fake.Put, epb.PutRequest, epb.PutResponse),
+                "DeleteRange": unary(
+                    fake.DeleteRange, epb.DeleteRangeRequest, epb.DeleteRangeResponse
+                ),
+            },
+        ),
+        grpc.method_handlers_generic_handler(
+            "etcdserverpb.Lease",
+            {
+                "LeaseGrant": unary(
+                    fake.LeaseGrant, epb.LeaseGrantRequest, epb.LeaseGrantResponse
+                ),
+                "LeaseRevoke": unary(
+                    fake.LeaseRevoke, epb.LeaseRevokeRequest, epb.LeaseRevokeResponse
+                ),
+                "LeaseKeepAlive": ss(
+                    fake.LeaseKeepAlive,
+                    epb.LeaseKeepAliveRequest,
+                    epb.LeaseKeepAliveResponse,
+                ),
+            },
+        ),
+        grpc.method_handlers_generic_handler(
+            "etcdserverpb.Watch",
+            {"Watch": ss(fake.Watch, epb.WatchRequest, epb.WatchResponse)},
+        ),
+    ]
+
+
+async def start_fake_etcd():
+    fake = FakeEtcd()
+    server = grpc.aio.server()
+    for h in _handlers(fake):
+        server.add_generic_rpc_handlers((h,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    await server.start()
+    return fake, server, f"127.0.0.1:{port}"
+
+
+def _conf(addr, ttl=0.6):
+    return EtcdConfig(
+        endpoints=[addr], key_prefix="/gubernator/peers/", lease_ttl_s=ttl
+    )
+
+
+async def wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return cond()
+
+
+def test_prefix_range_end():
+    assert prefix_range_end(b"/gubernator/peers/") == b"/gubernator/peers0"
+    assert prefix_range_end(b"\xff\xff") == b"\x00"
+
+
+def test_etcd_pool_register_watch_and_lease_loss(loop_thread):
+    async def scenario():
+        fake, server, addr = await start_fake_etcd()
+        updates_a, updates_b = [], []
+        a = EtcdPool(
+            _conf(addr),
+            PeerInfo(grpc_address="10.0.0.1:81", http_address="10.0.0.1:80"),
+            updates_a.append,
+        )
+        b = EtcdPool(
+            _conf(addr),
+            PeerInfo(grpc_address="10.0.0.2:81", http_address="10.0.0.2:80"),
+            updates_b.append,
+        )
+        try:
+            # Both register; watch events converge both views to 2 peers.
+            ok = await wait_for(
+                lambda: updates_a
+                and {p.grpc_address for p in updates_a[-1]}
+                == {"10.0.0.1:81", "10.0.0.2:81"}
+                and updates_b
+                and {p.grpc_address for p in updates_b[-1]}
+                == {"10.0.0.1:81", "10.0.0.2:81"}
+            )
+            assert ok, (updates_a[-1:], updates_b[-1:])
+            # Self-detection: each pool marks itself as owner.
+            mine = [p for p in updates_a[-1] if p.grpc_address == "10.0.0.1:81"]
+            assert mine and mine[0].is_owner
+            # Registered value is reference-shaped PeerInfo JSON.
+            raw = fake.kv[b"/gubernator/peers/10.0.0.1:81"][0]
+            d = json.loads(raw)
+            assert d["GRPCAddress"] == "10.0.0.1:81"
+            assert d["HTTPAddress"] == "10.0.0.1:80"
+
+            # Lease loss: expire A's lease server-side. A's keepalive sees
+            # TTL=0 and re-registers with a fresh lease (reference
+            # etcd.go:261-312); B sees A vanish then return.
+            regs_before = a.registrations
+            lease_a = fake.kv[b"/gubernator/peers/10.0.0.1:81"][1]
+            fake.expire_lease(lease_a)
+            ok = await wait_for(lambda: a.registrations > regs_before)
+            assert ok, "pool did not re-register after lease loss"
+            ok = await wait_for(
+                lambda: b"/gubernator/peers/10.0.0.1:81" in fake.kv
+            )
+            assert ok, "key did not reappear after re-registration"
+            new_lease = fake.kv[b"/gubernator/peers/10.0.0.1:81"][1]
+            assert new_lease != lease_a
+            ok = await wait_for(
+                lambda: updates_b
+                and {p.grpc_address for p in updates_b[-1]}
+                == {"10.0.0.1:81", "10.0.0.2:81"}
+            )
+            assert ok
+
+            # Graceful close deregisters: B converges to itself only.
+            await a.aclose()
+            ok = await wait_for(
+                lambda: updates_b
+                and {p.grpc_address for p in updates_b[-1]} == {"10.0.0.2:81"}
+            )
+            assert ok, updates_b[-1:]
+            assert b"/gubernator/peers/10.0.0.1:81" not in fake.kv
+        finally:
+            try:
+                await a.aclose()
+            except Exception:
+                pass
+            await b.aclose()
+            await server.stop(grace=0.1)
+
+    loop_thread.run(scenario(), timeout=60)
+
+
+def test_etcd_pool_keepalive_silence_reregisters(loop_thread):
+    """A partition (keepalive requests silently dropped) must also
+    trigger re-registration once the lease would have expired."""
+
+    async def scenario():
+        fake, server, addr = await start_fake_etcd()
+        updates = []
+        pool = EtcdPool(
+            _conf(addr, ttl=0.4),
+            PeerInfo(grpc_address="10.0.0.3:81"),
+            updates.append,
+        )
+        try:
+            ok = await wait_for(lambda: pool.registrations >= 1)
+            assert ok
+            regs = pool.registrations
+            fake.frozen = True  # server stops answering keepalives
+            lease = fake.kv[b"/gubernator/peers/10.0.0.3:81"][1]
+            fake.expire_lease(lease)
+            await asyncio.sleep(0.1)
+            fake.frozen = False
+            ok = await wait_for(lambda: pool.registrations > regs, timeout=15)
+            assert ok, "no re-registration after keepalive silence"
+        finally:
+            await pool.aclose()
+            await server.stop(grace=0.1)
+
+    loop_thread.run(scenario(), timeout=60)
+
+
+def test_etcd_value_backward_compat(loop_thread):
+    """A bare (non-JSON) value registers as a plain gRPC address
+    (reference etcd.go:162-172)."""
+
+    async def scenario():
+        fake, server, addr = await start_fake_etcd()
+        updates = []
+        pool = EtcdPool(
+            _conf(addr), PeerInfo(grpc_address="10.0.0.4:81"), updates.append
+        )
+        try:
+            await wait_for(lambda: pool.registrations >= 1)
+            # Simulate an old-version peer registering a bare address.
+            fake.kv[b"/gubernator/peers/10.9.9.9:81"] = (b"10.9.9.9:81", 0)
+            fake._emit(epb.Event.PUT, b"/gubernator/peers/10.9.9.9:81", b"10.9.9.9:81")
+            ok = await wait_for(
+                lambda: updates
+                and "10.9.9.9:81" in {p.grpc_address for p in updates[-1]}
+            )
+            assert ok
+        finally:
+            await pool.aclose()
+            await server.stop(grace=0.1)
+
+    loop_thread.run(scenario(), timeout=60)
+
+
+def test_daemons_discover_via_etcd(loop_thread):
+    """End-to-end: two real daemons using discovery='etcd' against the
+    fake etcd converge into one cluster and share counters."""
+    from gubernator_tpu.service.config import DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.client import GubernatorClient
+    from gubernator_tpu.api.types import RateLimitReq
+
+    async def scenario():
+        fake, server, addr = await start_fake_etcd()
+
+        def dconf():
+            return DaemonConfig(
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                cache_size=2048,
+                discovery="etcd",
+                etcd=_conf(addr, ttl=5),
+            )
+
+        d1 = await Daemon.spawn(dconf())
+        d2 = await Daemon.spawn(dconf())
+        try:
+            ok = await wait_for(
+                lambda: d1.svc.picker is not None
+                and len(d1.svc.picker.peers()) == 2
+                and len(d2.svc.picker.peers()) == 2,
+                timeout=10,
+            )
+            assert ok, "daemons did not discover each other via etcd"
+            # Same key through both daemons shares one counter.
+            async with GubernatorClient(d1.grpc_address) as c1, GubernatorClient(
+                d2.grpc_address
+            ) as c2:
+                req = RateLimitReq(
+                    name="etcd_e2e", unique_key="k", duration=60_000,
+                    limit=100, hits=5,
+                )
+                r1 = (await c1.get_rate_limits([req]))[0]
+                r2 = (await c2.get_rate_limits([req]))[0]
+                assert r1.remaining == 95 and r2.remaining == 90, (r1, r2)
+        finally:
+            await d1.close()
+            await d2.close()
+            await server.stop(grace=0.1)
+
+    loop_thread.run(scenario(), timeout=120)
+
+
+def test_etcd_endpoint_failover(loop_thread):
+    """With the first configured endpoint dead, the client must rotate to
+    the healthy member and register there."""
+
+    async def scenario():
+        fake, server, addr = await start_fake_etcd()
+        # Reserve-and-release a port so the 'dead' endpoint refuses fast.
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        updates = []
+        conf = EtcdConfig(
+            endpoints=[dead, addr],
+            key_prefix="/gubernator/peers/",
+            lease_ttl_s=2,
+            dial_timeout_s=1.0,
+        )
+        pool = EtcdPool(
+            conf, PeerInfo(grpc_address="10.0.0.7:81"), updates.append
+        )
+        try:
+            ok = await wait_for(lambda: pool.registrations >= 1, timeout=30)
+            assert ok, "pool never failed over to the healthy endpoint"
+            assert b"/gubernator/peers/10.0.0.7:81" in fake.kv
+        finally:
+            await pool.aclose()
+            await server.stop(grace=0.1)
+
+    loop_thread.run(scenario(), timeout=90)

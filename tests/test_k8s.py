@@ -1,0 +1,233 @@
+"""K8s discovery against a fake kube apiserver (real HTTP list+watch
+chunked streams): endpoints mode, pods mode with readiness filtering,
+watch-driven updates, 410-Gone re-list (reference kubernetes.go:35-247)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp import web
+
+from gubernator_tpu.service.config import K8sConfig
+from gubernator_tpu.service.k8s import K8sPool
+
+
+class FakeApiServer:
+    def __init__(self):
+        self.endpoints = {}  # name -> object
+        self.pods = {}
+        self.rv = 1
+        self.watchers = []  # queues
+        self.lists = 0
+
+    def emit(self, typ, obj):
+        self.rv += 1
+        for q in list(self.watchers):
+            q.put_nowait({"type": typ, "object": obj})
+
+    def app(self) -> web.Application:
+        async def handler(request: web.Request) -> web.StreamResponse:
+            kind = request.match_info["kind"]
+            store = self.endpoints if kind == "endpoints" else self.pods
+            if request.query.get("watch") != "1":
+                self.lists += 1
+                return web.json_response(
+                    {
+                        "kind": "List",
+                        "metadata": {"resourceVersion": str(self.rv)},
+                        "items": list(store.values()),
+                    }
+                )
+            resp = web.StreamResponse()
+            resp.content_type = "application/json"
+            await resp.prepare(request)
+            q = asyncio.Queue()
+            self.watchers.append(q)
+            try:
+                while True:
+                    ev = await q.get()
+                    await resp.write(json.dumps(ev).encode() + b"\n")
+            except (asyncio.CancelledError, ConnectionResetError):
+                pass
+            finally:
+                self.watchers.remove(q)
+            return resp
+
+        app = web.Application()
+        app.router.add_get("/api/v1/namespaces/{ns}/{kind}", handler)
+        return app
+
+
+def make_endpoints(name, ips):
+    return {
+        "metadata": {"name": name},
+        "subsets": [{"addresses": [{"ip": ip} for ip in ips]}],
+    }
+
+
+def make_pod(name, ip, ready=True):
+    return {
+        "metadata": {"name": name},
+        "status": {
+            "podIP": ip,
+            "containerStatuses": [
+                {
+                    "ready": ready,
+                    "state": {"running": {}} if ready else {"waiting": {}},
+                }
+            ],
+        },
+    }
+
+
+async def start_fake():
+    fake = FakeApiServer()
+    # Watch handlers block on their event queue forever; don't let the
+    # fake server's cleanup wait for them.
+    runner = web.AppRunner(fake.app(), shutdown_timeout=0.25)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return fake, runner, f"http://127.0.0.1:{port}"
+
+
+async def wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return cond()
+
+
+def test_k8s_endpoints_watch(loop_thread):
+    async def scenario():
+        fake, runner, url = await start_fake()
+        fake.endpoints["gub"] = make_endpoints("gub", ["10.1.0.1", "10.1.0.2"])
+        updates = []
+        pool = K8sPool(
+            K8sConfig(
+                namespace="default",
+                selector="app=gubernator",
+                pod_ip="10.1.0.1",
+                pod_port="81",
+                api_server=url,
+            ),
+            updates.append,
+        )
+        try:
+            ok = await wait_for(
+                lambda: updates
+                and {p.grpc_address for p in updates[-1]}
+                == {"10.1.0.1:81", "10.1.0.2:81"}
+            )
+            assert ok, updates[-1:]
+            me = [p for p in updates[-1] if p.grpc_address == "10.1.0.1:81"]
+            assert me and me[0].is_owner
+
+            # Scale up via a watch event.
+            obj = make_endpoints("gub", ["10.1.0.1", "10.1.0.2", "10.1.0.3"])
+            fake.endpoints["gub"] = obj
+            fake.emit("MODIFIED", obj)
+            ok = await wait_for(
+                lambda: updates
+                and {p.grpc_address for p in updates[-1]}
+                == {"10.1.0.1:81", "10.1.0.2:81", "10.1.0.3:81"}
+            )
+            assert ok, updates[-1:]
+
+            # Delete the endpoints object entirely.
+            fake.emit("DELETED", obj)
+            ok = await wait_for(lambda: updates and updates[-1] == [])
+            assert ok, updates[-1:]
+        finally:
+            await pool.aclose()
+            await runner.cleanup()
+
+    loop_thread.run(scenario(), timeout=60)
+
+
+def test_k8s_pods_readiness_filter(loop_thread):
+    async def scenario():
+        fake, runner, url = await start_fake()
+        fake.pods["p1"] = make_pod("p1", "10.2.0.1", ready=True)
+        fake.pods["p2"] = make_pod("p2", "10.2.0.2", ready=False)
+        updates = []
+        pool = K8sPool(
+            K8sConfig(
+                namespace="default",
+                selector="app=gubernator",
+                pod_port="81",
+                mechanism="pods",
+                api_server=url,
+            ),
+            updates.append,
+        )
+        try:
+            # Only the ready pod appears (kubernetes.go:200-207).
+            ok = await wait_for(
+                lambda: updates
+                and {p.grpc_address for p in updates[-1]} == {"10.2.0.1:81"}
+            )
+            assert ok, updates[-1:]
+            # p2 becomes ready.
+            obj = make_pod("p2", "10.2.0.2", ready=True)
+            fake.pods["p2"] = obj
+            fake.emit("MODIFIED", obj)
+            ok = await wait_for(
+                lambda: updates
+                and {p.grpc_address for p in updates[-1]}
+                == {"10.2.0.1:81", "10.2.0.2:81"}
+            )
+            assert ok, updates[-1:]
+        finally:
+            await pool.aclose()
+            await runner.cleanup()
+
+    loop_thread.run(scenario(), timeout=60)
+
+
+def test_k8s_watch_error_relists(loop_thread):
+    """An ERROR watch event (e.g. 410 Gone) must trigger a fresh list +
+    watch rather than a dead pool."""
+
+    async def scenario():
+        fake, runner, url = await start_fake()
+        fake.endpoints["gub"] = make_endpoints("gub", ["10.3.0.1"])
+        updates = []
+        pool = K8sPool(
+            K8sConfig(
+                namespace="default", selector="x", pod_port="81", api_server=url
+            ),
+            updates.append,
+        )
+        try:
+            await wait_for(lambda: fake.lists >= 1 and len(fake.watchers) == 1)
+            lists = fake.lists
+            # State changes while the watch is broken; the re-list must
+            # pick it up.
+            fake.endpoints["gub"] = make_endpoints("gub", ["10.3.0.9"])
+            fake.emit(
+                "ERROR",
+                {"kind": "Status", "code": 410, "message": "too old"},
+            )
+            ok = await wait_for(lambda: fake.lists > lists, timeout=10)
+            assert ok, "pool did not re-list after watch ERROR"
+            ok = await wait_for(
+                lambda: updates
+                and {p.grpc_address for p in updates[-1]} == {"10.3.0.9:81"},
+                timeout=10,
+            )
+            assert ok, updates[-1:]
+        finally:
+            await pool.aclose()
+            await runner.cleanup()
+
+    loop_thread.run(scenario(), timeout=60)
+
+
+def test_k8s_requires_selector():
+    with pytest.raises(ValueError, match="selector"):
+        K8sPool(K8sConfig(), lambda p: None)
