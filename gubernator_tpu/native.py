@@ -90,6 +90,21 @@ def hash128(key: str) -> Tuple[int, int]:
     return to_signed(hi.value), to_signed(lo.value)
 
 
+def hash128_batch_raw(
+    data: bytes, offsets: np.ndarray, num_groups: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Batch hash over pre-concatenated key bytes (the columnar edge path
+    hands these straight from the wire parser — no string objects)."""
+    lib = load()
+    assert lib is not None
+    n = len(offsets) - 1
+    hi = np.empty(n, dtype=np.uint64)
+    lo = np.empty(n, dtype=np.uint64)
+    group = np.empty(n, dtype=np.int32)
+    lib.guber_hash128_batch(data, offsets, n, num_groups, hi, lo, group)
+    return hi.view(np.int64), lo.view(np.int64), group
+
+
 def hash128_batch(
     keys: List[str], num_groups: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

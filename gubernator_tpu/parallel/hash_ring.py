@@ -61,6 +61,7 @@ class ReplicatedConsistentHash:
         self._peers: Dict[str, object] = {}
         self._ring_hashes: List[int] = []
         self._ring_peers: List[object] = []
+        self._mask_cache = None  # (ring uint64 array, is_owner bool array)
 
     def new(self) -> "ReplicatedConsistentHash":
         return ReplicatedConsistentHash(self.hash_fn, self.replicas)
@@ -76,6 +77,7 @@ class ReplicatedConsistentHash:
         )
         self._ring_hashes = [h for h, _ in merged]
         self._ring_peers = [p for _, p in merged]
+        self._mask_cache = None
 
     def size(self) -> int:
         return len(self._peers)
@@ -95,3 +97,28 @@ class ReplicatedConsistentHash:
         if idx == len(self._ring_hashes):
             idx = 0
         return self._ring_peers[idx]
+
+    def local_mask(self, key_hashes) -> "object":
+        """Vectorized ownership check for the columnar edge: True per key
+        iff this node owns it. `key_hashes` are uint64 values of the SAME
+        hash function as hash_fn (the native fnv1 batch). Identical
+        placement to get(): bisect_left on the sorted ring with
+        wraparound. The ring arrays are cached (invalidated by add()) —
+        rebuilding replicas*peers entries per call would dominate the
+        edge's per-call budget."""
+        import numpy as np
+
+        cache = self._mask_cache
+        if cache is None:
+            cache = (
+                np.asarray(self._ring_hashes, dtype=np.uint64),
+                np.asarray(
+                    [bool(p.info.is_owner) for p in self._ring_peers],
+                    dtype=bool,
+                ),
+            )
+            self._mask_cache = cache
+        ring, is_owner = cache
+        idx = np.searchsorted(ring, key_hashes, side="left")
+        idx = np.where(idx == len(ring), 0, idx)
+        return is_owner[idx]

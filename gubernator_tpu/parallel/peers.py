@@ -246,6 +246,15 @@ class PeerMesh:
     def peers(self) -> List[Peer]:
         return self.local_ring.peers()
 
+    @property
+    def hash_fn(self):
+        """Ring hash (columnar edge computes it natively in batch)."""
+        return self.local_ring.hash_fn
+
+    def local_mask(self, key_hashes):
+        """Vectorized ownership check (see hash_ring.local_mask)."""
+        return self.local_ring.local_mask(key_hashes)
+
     def region_peers(self) -> List[Peer]:
         return self.region_picker.peers()
 

@@ -169,4 +169,7 @@ class DaemonConfig:
             ways=ways,
             batch_wait_s=self.behaviors.batch_wait_s,
             batch_limit=self.behaviors.batch_limit,
+            # Daemons serve the columnar edge; sized kernel buckets
+            # compile in the background at boot.
+            fast_buckets=True,
         )

@@ -90,6 +90,15 @@ class Daemon:
             metrics=metrics,
             force_global=conf.behaviors.force_global,
         )
+        # Columnar serving edge: eligible only without persistence plugins
+        # (the Store needs the object path's read-through/write-behind; a
+        # Loader needs the key-string dictionary complete for snapshots)
+        # and without force_global (every item would take the GLOBAL path).
+        self.svc.fast_edge = (
+            conf.store is None
+            and conf.loader is None
+            and not conf.behaviors.force_global
+        )
 
         # gRPC server hosting both services (reference daemon.go:139-167)
         # with the reference's hardening: 1MB receive cap (daemon.go:122)

@@ -1,0 +1,96 @@
+"""Columnar serving edge: bytes -> columns -> kernel -> bytes.
+
+The object path (protobuf message -> dataclass -> pump -> demux) costs
+~10-20µs of Python per request item; this path serves an entire
+GetRateLimits/GetPeerRateLimits call with no per-item Python at all
+(native wire parse, vectorized wave assembly, one jitted decide per
+wave, native response build). It is an OPTIMIZATION, not a semantic
+fork: every batch it cannot serve byte-identically falls back to the
+object path (equivalence is fuzz-tested in tests/test_fastpath.py).
+
+Fallback triggers:
+- native library unavailable, malformed/empty/oversized batch;
+- any item carrying metadata (trace context), GLOBAL or
+  DURATION_IS_GREGORIAN behaviors, or failing validation (those need
+  per-item error strings);
+- a key this node does not own (peer forwarding), checked with the
+  vectorized ring mask — GetPeerRateLimits skips this check because
+  forwarded items are owned by construction;
+- engine not eligible (Store attached, wave/lane overflow) — also a
+  daemon with a Loader keeps the object path so the key-string
+  dictionary stays complete for snapshots.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from gubernator_tpu import wire
+from gubernator_tpu.api.types import Behavior
+from gubernator_tpu.parallel import hash_ring
+
+MAX_BATCH_SIZE = 1000
+
+_SLOW_BEHAVIOR = int(Behavior.GLOBAL) | int(Behavior.DURATION_IS_GREGORIAN)
+
+_RING_VARIANT = {
+    hash_ring.fnv1_64: "fnv1",
+    hash_ring.fnv1a_64: "fnv1a",
+}
+
+
+import os
+
+_DISABLED = os.environ.get("GUBER_DISABLE_FAST_EDGE", "") in ("1", "true")
+
+
+def enabled(svc) -> bool:
+    """Static eligibility for this service instance."""
+    return (
+        not _DISABLED
+        and getattr(svc, "fast_edge", False)
+        and wire.available()
+        and hasattr(svc.engine, "check_columns")
+    )
+
+
+def try_serve(svc, data: bytes, peer_call: bool) -> Optional[bytes]:
+    """Serve one call's raw request bytes columnar-fast, or None to fall
+    back to the object path."""
+    cols = wire.parse_requests(data)
+    if cols is None or cols.n == 0 or cols.n > MAX_BATCH_SIZE:
+        return None
+    if cols.slow.any():
+        return None
+    if np.any((cols.behavior & _SLOW_BEHAVIOR) != 0):
+        return None
+    # Validation needs per-item error strings -> object path.
+    key_lens = np.diff(cols.key_offsets)
+    if np.any(cols.name_lens == 0) or np.any(
+        key_lens - cols.name_lens - 1 == 0
+    ):
+        return None
+    if not peer_call:
+        picker = svc.picker
+        if picker is not None and picker.peers():
+            variant = _RING_VARIANT.get(getattr(picker, "hash_fn", None))
+            if variant is None:
+                return None
+            hashes = wire.fnv1_batch(cols.key_data, cols.key_offsets, variant)
+            if not picker.local_mask(hashes).all():
+                return None  # at least one key is peer-owned
+    try:
+        out = svc.engine.check_columns(cols)
+    except Exception:
+        # Engine failure: fall back so the object path produces its
+        # per-item error contract instead of an opaque RPC failure.
+        return None
+    if out is None:
+        return None
+    status, limit, remaining, reset_time = out
+    m = getattr(svc, "_m_local", None)
+    if m is not None:
+        m.inc(cols.n)
+    return wire.build_responses(status, limit, remaining, reset_time)

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import time
 
@@ -38,11 +39,34 @@ async def _abort(context, e: ApiError):
 
 
 class V1Servicer:
+    """GetRateLimits runs in BYTES mode (identity deserializer): the
+    columnar fast path serves eligible calls without building a single
+    per-item Python object; everything else parses and takes the object
+    path with identical semantics (service/fastpath.py)."""
+
     def __init__(self, svc: V1Service):
         self.svc = svc
+        from gubernator_tpu.service import fastpath
 
-    async def GetRateLimits(self, request, context):
+        self._fast = fastpath
+
+    async def GetRateLimits(self, request_bytes, context):
         async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/GetRateLimits"):
+            if self._fast.enabled(self.svc):
+                # Executor keeps the event loop responsive while the
+                # kernel runs (the C parse and the jitted decide release
+                # the GIL, so calls genuinely overlap).
+                raw = await asyncio.get_running_loop().run_in_executor(
+                    None, self._fast.try_serve, self.svc, request_bytes, False
+                )
+                if raw is not None:
+                    return raw
+            try:
+                request = pb.pb.GetRateLimitsReq.FromString(request_bytes)
+            except Exception:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed request"
+                )
             reqs = [pb.req_from_pb(r) for r in request.requests]
             try:
                 out = await self.svc.get_rate_limits(reqs)
@@ -51,7 +75,7 @@ class V1Servicer:
             resp = pb.pb.GetRateLimitsResp()
             for r in out:
                 resp.responses.append(pb.resp_to_pb(r))
-            return resp
+            return resp.SerializeToString()
 
     async def HealthCheck(self, request, context):
         async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/HealthCheck"):
@@ -61,11 +85,33 @@ class V1Servicer:
 class PeersV1Servicer:
     def __init__(self, svc: V1Service):
         self.svc = svc
+        from gubernator_tpu.service import fastpath
 
-    async def GetPeerRateLimits(self, request, context):
+        self._fast = fastpath
+
+    async def GetPeerRateLimits(self, request_bytes, context):
         async with _instrumented(
             self.svc.metrics, "/pb.gubernator.PeersV1/GetPeerRateLimits"
         ):
+            # Forwarded batches are owned by construction — the owner-side
+            # hot path (SURVEY.md §3.2) skips the ring check. The response
+            # field (rate_limits = 1) shares its wire shape with
+            # GetRateLimitsResp.responses, so the same native builder
+            # serves both.
+            if self._fast.enabled(self.svc):
+                raw = await asyncio.get_running_loop().run_in_executor(
+                    None, self._fast.try_serve, self.svc, request_bytes, True
+                )
+                if raw is not None:
+                    return raw
+            try:
+                request = pb.peers_pb.GetPeerRateLimitsReq.FromString(
+                    request_bytes
+                )
+            except Exception:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed request"
+                )
             reqs = [pb.req_from_pb(r) for r in request.requests]
             try:
                 out = await self.svc.get_peer_rate_limits(reqs)
@@ -74,7 +120,7 @@ class PeersV1Servicer:
             resp = pb.peers_pb.GetPeerRateLimitsResp()
             for r in out:
                 resp.rate_limits.append(pb.resp_to_pb(r))
-            return resp
+            return resp.SerializeToString()
 
     async def UpdatePeerGlobals(self, request, context):
         async with _instrumented(

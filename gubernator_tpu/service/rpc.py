@@ -21,10 +21,13 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(
         V1_SERVICE,
         {
+            # BYTES mode: identity (de)serializers — the servicer parses
+            # via the native columnar path or protobuf itself
+            # (service/fastpath.py).
             "GetRateLimits": grpc.unary_unary_rpc_method_handler(
                 servicer.GetRateLimits,
-                request_deserializer=pb.pb.GetRateLimitsReq.FromString,
-                response_serializer=pb.pb.GetRateLimitsResp.SerializeToString,
+                request_deserializer=None,
+                response_serializer=None,
             ),
             "HealthCheck": grpc.unary_unary_rpc_method_handler(
                 servicer.HealthCheck,
@@ -40,10 +43,11 @@ def peers_handler(servicer) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(
         PEERS_SERVICE,
         {
+            # BYTES mode (see v1_handler note).
             "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
                 servicer.GetPeerRateLimits,
-                request_deserializer=pb.peers_pb.GetPeerRateLimitsReq.FromString,
-                response_serializer=pb.peers_pb.GetPeerRateLimitsResp.SerializeToString,
+                request_deserializer=None,
+                response_serializer=None,
             ),
             "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
                 servicer.UpdatePeerGlobals,

@@ -1,0 +1,202 @@
+"""Columnar wire path: C++ protobuf parse/build for the serving edge.
+
+The Python protobuf round trip costs ~10µs per request item; at the
+north-star request rates that is the entire budget. This module loads
+native/_wirepath.so (built on demand like the batch hasher) and exposes:
+
+- parse_requests(data) -> RequestColumns | None: one pass over a
+  GetRateLimitsReq's bytes into numpy columns + concatenated
+  `name + "_" + unique_key` key bytes. None means the native library is
+  unavailable or the payload is malformed (caller falls back to the
+  protobuf object path; malformed bytes then fail with the proper gRPC
+  decode error).
+- build_responses(status, limit, remaining, reset_time) -> bytes: a
+  GetRateLimitsResp built straight from response columns.
+- fnv1_batch(key_data, offsets, variant) -> uint64 hashes for vectorized
+  ring routing (same fnv1/fnv1a as parallel/hash_ring.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "wirepath.cc")
+_SO = os.path.join(_NATIVE_DIR, "_wirepath.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            u8 = ctypes.POINTER(ctypes.c_uint8)
+            lib.guber_count_requests.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.guber_count_requests.restype = ctypes.c_int
+            lib.guber_parse_requests.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int64),   # hits
+                np.ctypeslib.ndpointer(np.int64),   # limit
+                np.ctypeslib.ndpointer(np.int64),   # duration
+                np.ctypeslib.ndpointer(np.int32),   # algo
+                np.ctypeslib.ndpointer(np.int64),   # behavior
+                np.ctypeslib.ndpointer(np.int64),   # burst
+                np.ctypeslib.ndpointer(np.int64),   # created_at
+                np.ctypeslib.ndpointer(np.uint8),   # has_created
+                np.ctypeslib.ndpointer(np.uint8),   # slow
+                np.ctypeslib.ndpointer(np.int64),   # name_lens
+                np.ctypeslib.ndpointer(np.uint8),   # key_data
+                np.ctypeslib.ndpointer(np.int64),   # key_offsets
+            ]
+            lib.guber_parse_requests.restype = ctypes.c_int
+            lib.guber_build_responses.argtypes = [
+                ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int8),
+                np.ctypeslib.ndpointer(np.int64),
+                np.ctypeslib.ndpointer(np.int64),
+                np.ctypeslib.ndpointer(np.int64),
+                np.ctypeslib.ndpointer(np.uint8),
+            ]
+            lib.guber_build_responses.restype = ctypes.c_int64
+            lib.guber_responses_size.argtypes = [ctypes.c_int]
+            lib.guber_responses_size.restype = ctypes.c_int64
+            for name in ("guber_fnv1_batch", "guber_fnv1a_batch"):
+                fn = getattr(lib, name)
+                fn.argtypes = [
+                    np.ctypeslib.ndpointer(np.uint8),
+                    np.ctypeslib.ndpointer(np.int64),
+                    ctypes.c_int,
+                    np.ctypeslib.ndpointer(np.uint64),
+                ]
+            _lib = lib
+            _ = u8
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+@dataclasses.dataclass
+class RequestColumns:
+    """Columnar view of a GetRateLimitsReq."""
+
+    n: int
+    hits: np.ndarray  # int64
+    limit: np.ndarray  # int64
+    duration: np.ndarray  # int64
+    algo: np.ndarray  # int32
+    behavior: np.ndarray  # int64
+    burst: np.ndarray  # int64
+    created_at: np.ndarray  # int64
+    has_created: np.ndarray  # uint8
+    slow: np.ndarray  # uint8 (metadata present)
+    name_lens: np.ndarray  # int64 (for vectorized validation)
+    key_data: np.ndarray  # uint8, concatenated hash keys
+    key_offsets: np.ndarray  # int64, n+1
+
+    def key_string(self, i: int) -> str:
+        lo, hi = int(self.key_offsets[i]), int(self.key_offsets[i + 1])
+        return bytes(self.key_data[lo:hi]).decode("utf-8", errors="replace")
+
+
+def parse_requests(data: bytes) -> Optional[RequestColumns]:
+    lib = load()
+    if lib is None:
+        return None
+    kb = ctypes.c_int64()
+    n = lib.guber_count_requests(data, len(data), ctypes.byref(kb))
+    if n < 0:
+        return None
+    if n == 0:
+        z64 = np.empty(0, dtype=np.int64)
+        return RequestColumns(
+            0, z64, z64, z64, np.empty(0, np.int32), z64, z64, z64,
+            np.empty(0, np.uint8), np.empty(0, np.uint8), z64,
+            np.empty(0, np.uint8), np.zeros(1, np.int64),
+        )
+    hits = np.empty(n, np.int64)
+    limit = np.empty(n, np.int64)
+    duration = np.empty(n, np.int64)
+    algo = np.empty(n, np.int32)
+    behavior = np.empty(n, np.int64)
+    burst = np.empty(n, np.int64)
+    created = np.empty(n, np.int64)
+    has_created = np.empty(n, np.uint8)
+    slow = np.empty(n, np.uint8)
+    name_lens = np.empty(n, np.int64)
+    key_data = np.empty(max(int(kb.value), 1), np.uint8)
+    key_offsets = np.empty(n + 1, np.int64)
+    got = lib.guber_parse_requests(
+        data, len(data), hits, limit, duration, algo, behavior, burst,
+        created, has_created, slow, name_lens, key_data, key_offsets,
+    )
+    if got != n:
+        return None
+    return RequestColumns(
+        n, hits, limit, duration, algo, behavior, burst, created,
+        has_created, slow, name_lens, key_data, key_offsets,
+    )
+
+
+def build_responses(status, limit, remaining, reset_time) -> bytes:
+    lib = load()
+    assert lib is not None
+    n = len(status)
+    out = np.empty(int(lib.guber_responses_size(n)), np.uint8)
+    written = lib.guber_build_responses(
+        n,
+        np.ascontiguousarray(status, dtype=np.int8),
+        np.ascontiguousarray(limit, dtype=np.int64),
+        np.ascontiguousarray(remaining, dtype=np.int64),
+        np.ascontiguousarray(reset_time, dtype=np.int64),
+        out,
+    )
+    return out[:written].tobytes()
+
+
+def fnv1_batch(key_data: np.ndarray, key_offsets: np.ndarray, variant: str = "fnv1") -> np.ndarray:
+    lib = load()
+    assert lib is not None
+    n = len(key_offsets) - 1
+    out = np.empty(n, np.uint64)
+    fn = lib.guber_fnv1_batch if variant == "fnv1" else lib.guber_fnv1a_batch
+    fn(key_data, key_offsets, n, out)
+    return out

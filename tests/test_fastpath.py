@@ -1,0 +1,221 @@
+"""Columnar serving path differential tests: wire.parse_requests +
+DeviceEngine.check_columns must produce byte-identical decisions to the
+protobuf-object path for the same request stream (incl. in-batch
+duplicate keys, whose per-key order the wave logic must preserve)."""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import wire
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.service import pb
+
+NOW = 1_753_700_000_000
+
+pytestmark = pytest.mark.skipif(
+    not wire.available(), reason="native wirepath unavailable"
+)
+
+
+def to_proto_bytes(reqs):
+    msg = pb.pb.GetRateLimitsReq()
+    for r in reqs:
+        msg.requests.append(pb.req_to_pb(r))
+    return msg.SerializeToString()
+
+
+def mk_engine(clock):
+    return DeviceEngine(
+        EngineConfig(num_groups=1 << 8, batch_size=64, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_columns_match_object_path(seed):
+    rng = random.Random(seed)
+    clock = {"now": NOW}
+    eng_a = mk_engine(clock)  # columnar
+    eng_b = mk_engine(clock)  # object path
+    keys = [f"fp{i}" for i in range(10)]
+    try:
+        for step in range(60):
+            if rng.random() < 0.2:
+                clock["now"] += rng.choice([5, 700, 70_000])
+            batch = []
+            for _ in range(rng.randint(1, 40)):
+                behavior = 0
+                if rng.random() < 0.1:
+                    behavior |= Behavior.RESET_REMAINING
+                if rng.random() < 0.1:
+                    behavior |= Behavior.DRAIN_OVER_LIMIT
+                batch.append(
+                    RateLimitReq(
+                        name="fp",
+                        unique_key=rng.choice(keys),
+                        algorithm=rng.choice(
+                            [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                        ),
+                        behavior=behavior,
+                        duration=rng.choice([100, 60_000]),
+                        limit=rng.choice([3, 10, 50]),
+                        hits=rng.choice([0, 1, 2, 5, 60]),
+                        burst=rng.choice([0, 0, 7]),
+                    )
+                )
+            cols = wire.parse_requests(to_proto_bytes(batch))
+            assert cols is not None and cols.n == len(batch)
+            got = eng_a.check_columns(cols, now=clock["now"])
+            assert got is not None
+            status, limit, remaining, reset_time = got
+            want = eng_b.check_batch([dataclasses.replace(r) for r in batch])
+            for i, w in enumerate(want):
+                assert (
+                    int(status[i]), int(limit[i]), int(remaining[i]),
+                    int(reset_time[i]),
+                ) == (int(w.status), w.limit, w.remaining, w.reset_time), (
+                    f"seed {seed} step {step} item {i}: {batch[i]}"
+                )
+    finally:
+        eng_a.close()
+        eng_b.close()
+
+
+def test_columns_duplicate_key_sequencing():
+    """Same key N times in one batch: strictly sequential consumption,
+    and over-limit must not consume (the reference's serialized-worker
+    contract)."""
+    clock = {"now": NOW}
+    eng = mk_engine(clock)
+    try:
+        reqs = [
+            RateLimitReq(name="fp", unique_key="dup", duration=60_000,
+                         limit=10, hits=4)
+            for _ in range(4)
+        ]
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        status, limit, remaining, _ = eng.check_columns(cols, now=clock["now"])
+        assert list(remaining) == [6, 2, 2, 2]
+        assert list(status) == [0, 0, 1, 1]
+    finally:
+        eng.close()
+
+
+def test_columns_response_wire_bytes():
+    """End-to-end bytes: parse -> decide -> build_responses must decode
+    as a correct GetRateLimitsResp."""
+    clock = {"now": NOW}
+    eng = mk_engine(clock)
+    try:
+        reqs = [
+            RateLimitReq(name="fp", unique_key=f"w{i}", duration=60_000,
+                         limit=100, hits=i)
+            for i in range(5)
+        ]
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        status, limit, remaining, reset_time = eng.check_columns(
+            cols, now=clock["now"]
+        )
+        raw = wire.build_responses(status, limit, remaining, reset_time)
+        out = pb.pb.GetRateLimitsResp.FromString(raw)
+        assert len(out.responses) == 5
+        for i, r in enumerate(out.responses):
+            assert r.remaining == 100 - i
+            assert r.limit == 100
+    finally:
+        eng.close()
+
+
+def test_local_mask_matches_get():
+    """Vectorized ring ownership must place every key exactly like the
+    scalar get() (bisect_left + wraparound)."""
+    from gubernator_tpu.parallel.hash_ring import ReplicatedConsistentHash
+
+    class P:
+        def __init__(self, addr, own):
+            class I:
+                pass
+
+            self.info = I()
+            self.info.grpc_address = addr
+            self.info.is_owner = own
+
+    ring = ReplicatedConsistentHash()
+    peers = [P(f"10.0.0.{i}:81", i == 2) for i in range(5)]
+    for p in peers:
+        ring.add(p)
+
+    keys = [f"bench_mask_{i}" for i in range(2000)]
+    import numpy as np
+
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    data = b"".join(k.encode() for k in keys)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    hashes = wire.fnv1_batch(
+        np.frombuffer(data, np.uint8).copy(), offsets, "fnv1"
+    )
+    mask = ring.local_mask(hashes)
+    for i, k in enumerate(keys):
+        assert bool(mask[i]) == bool(ring.get(k).info.is_owner), k
+
+
+def test_malformed_and_invalid_utf8_fall_back(loop_thread):
+    """Adversarial wire bytes: huge length varints must not crash the
+    daemon, and invalid-UTF-8 keys get the object path's INVALID_ARGUMENT
+    instead of being silently served."""
+    import grpc as grpc_mod
+
+    from gubernator_tpu.service.config import DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    async def scenario():
+        d = await Daemon.spawn(DaemonConfig(cache_size=1024))
+        try:
+            async with grpc_mod.aio.insecure_channel(d.grpc_address) as ch:
+                call = ch.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                # huge length varint inside the message
+                bad = bytes(
+                    [0x0A, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+                     0xFF, 0x01, 0x01]
+                )
+                try:
+                    await call(bad)
+                    assert False, "malformed bytes accepted"
+                except grpc_mod.aio.AioRpcError as e:
+                    assert e.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+                # invalid UTF-8 unique_key -> INVALID_ARGUMENT via fallback
+                msg = pb.pb.GetRateLimitsReq()
+                msg.requests.append(
+                    pb.pb.RateLimitReq(
+                        name="u", unique_key="marker", duration=60000,
+                        limit=5, hits=1,
+                    )
+                )
+                raw = bytearray(msg.SerializeToString())
+                ix = bytes(raw).index(b"marker")
+                raw[ix] = 0xFF
+                try:
+                    await call(bytes(raw))
+                    assert False, "invalid utf-8 accepted"
+                except grpc_mod.aio.AioRpcError as e:
+                    assert e.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+                # and the daemon still serves normal traffic
+                ok_msg = pb.pb.GetRateLimitsReq()
+                ok_msg.requests.append(
+                    pb.pb.RateLimitReq(
+                        name="u", unique_key="fine", duration=60000,
+                        limit=5, hits=1,
+                    )
+                )
+                out = pb.pb.GetRateLimitsResp.FromString(
+                    await call(ok_msg.SerializeToString())
+                )
+                assert out.responses[0].remaining == 4
+        finally:
+            await d.close()
+
+    loop_thread.run(scenario(), timeout=120)
