@@ -81,16 +81,11 @@ def _inject_impl(table: SlotTable, items: InjectBatch, now, ways: int = 8):
         created_at=items.stamp,
         active=items.active,
     )
-    slot, exists, _ev = _choose_slot(table, probe, now, ways)
+    slot, exists, _ev, evicted_hi, evicted_lo = _choose_slot(
+        table, probe, now, ways
+    )
     n = table.num_slots
     idx = jnp.where(items.active, slot, n)
-
-    # Surface displaced occupants (same contract as decide's evicted_hi/lo).
-    from gubernator_tpu.ops.decide import displaced_occupants
-
-    evicted_hi, evicted_lo = displaced_occupants(
-        table, slot, exists, items.active, items.key_hi, items.key_lo
-    )
 
     def upd(arr, val):
         return arr.at[idx].set(val, mode="drop")
