@@ -195,25 +195,36 @@ def _run_guarded(timeout_s: float = 480.0) -> None:
         )
     deadline = time.monotonic() + timeout_s
     child_rc = None
+
+    def try_relay() -> bool:
+        if not os.path.exists(out_path):
+            return False
+        try:
+            with open(out_path) as f:
+                line = f.read().strip()
+        except OSError:
+            return False
+        if not line:
+            return False
+        print(line, flush=True)
+        try:
+            os.unlink(out_path)
+            os.unlink(err_path)
+        except OSError:
+            pass
+        return True
+
     while time.monotonic() < deadline:
-        if os.path.exists(out_path):
-            try:
-                with open(out_path) as f:
-                    line = f.read().strip()
-                if line:
-                    print(line, flush=True)
-                    try:
-                        os.unlink(out_path)
-                        os.unlink(err_path)
-                    except OSError:
-                        pass
-                    return
-            except OSError:
-                pass
+        if try_relay():
+            return
         child_rc = child.poll()
         if child_rc is not None and not os.path.exists(out_path):
             break  # child died without a result
         time.sleep(1.0)
+    # Final re-check: a result (or exit) can land during the last sleep.
+    if try_relay():
+        return
+    child_rc = child.poll()
     if child_rc is not None:
         tail = ""
         try:

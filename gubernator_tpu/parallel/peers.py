@@ -19,6 +19,7 @@ Reimplements the reference's PeerClient/SetPeers machinery
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -236,7 +237,12 @@ class PeerMesh:
         self.local_ring = ReplicatedConsistentHash(hash_fn, replicas)
         self.region_picker = RegionPicker(ReplicatedConsistentHash(hash_fn, replicas))
         self._all: Dict[str, Peer] = {}
-        self._errors: List[tuple] = []  # (ts, message)
+        # Bounded like the reference's TTL'd error cache (peer_client.go
+        # :206-235 caps ~100 entries): append is O(1) and pruning happens
+        # only on READ. An unbounded list rebuilt per insert livelocks the
+        # event loop under an error storm (O(n^2) over a 5-minute TTL) —
+        # found by soak: goodput collapsed to zero and never recovered.
+        self._errors: "collections.deque" = collections.deque(maxlen=100)
 
     # -- PeerPicker interface ------------------------------------------------
 
@@ -333,9 +339,9 @@ class PeerMesh:
     # -- health (reference gubernator.go:542-586) ----------------------------
 
     def record_error(self, msg: str) -> None:
-        now = time.monotonic()
-        self._errors.append((now, msg))
-        self._errors = [(t, m) for t, m in self._errors if now - t < _ERROR_TTL_S]
+        # O(1): the deque's maxlen bounds memory; TTL filtering happens in
+        # recent_errors() (scrape/health cadence, not the failure path).
+        self._errors.append((time.monotonic(), msg))
 
     def recent_errors(self) -> List[str]:
         now = time.monotonic()

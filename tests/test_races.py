@@ -110,3 +110,25 @@ def test_engine_concurrent_mixed_operations():
         now_fn=lambda: NOW,
     )
     eng2.close()
+
+
+def test_error_storm_is_constant_time():
+    """Soak finding (round 2): an error storm must not livelock the
+    node. record_error is O(1) with bounded memory; the TTL filter runs
+    only on read (health/scrape cadence), matching the reference's
+    capped TTL error cache (peer_client.go:206-235)."""
+    import time as _time
+
+    from gubernator_tpu.parallel.peers import PeerMesh
+    from gubernator_tpu.service.config import BehaviorConfig
+
+    # Real construction — the guard must fail if __init__'s error store
+    # ever reverts to an unbounded structure.
+    mesh = PeerMesh(svc=None, behaviors=BehaviorConfig())
+    t0 = _time.perf_counter()
+    for i in range(200_000):
+        mesh.record_error(f"e{i}")
+    dt = _time.perf_counter() - t0
+    assert dt < 2.0, f"200k error records took {dt:.1f}s"
+    assert len(mesh._errors) <= 1000, "error store must be bounded"
+    assert mesh.recent_errors(), "recent errors must still be reported"
