@@ -637,7 +637,13 @@ class DeviceEngine(EngineBase):
 
     # ---- columnar fast path (the serving edge; see service/fastpath.py) ----
 
-    def check_columns(self, cols, now: Optional[int] = None):
+    def check_columns(
+        self,
+        cols,
+        now: Optional[int] = None,
+        select: Optional[np.ndarray] = None,
+        hashes: Optional[tuple] = None,
+    ):
         """Vectorized decide over wire columns: no per-item Python objects
         anywhere — hashing, wave/lane assignment, encoding, and response
         demux are all batch array ops. Returns (status, limit, remaining,
@@ -654,20 +660,33 @@ class DeviceEngine(EngineBase):
 
         The caller guarantees: no GLOBAL / DURATION_IS_GREGORIAN items,
         no per-item metadata, and validation already handled.
+
+        `select` serves a SUBSET of the batch (the mixed-ownership edge:
+        locally-owned lanes go columnar while the rest forward), with
+        `hashes` = (hi, lo, grp) precomputed over the FULL batch so key
+        bytes need no re-slicing. Results align with `select`'s order.
         """
         from gubernator_tpu import native as _native
         from gubernator_tpu.models.bucket import MAX_COUNT, MAX_DURATION_MS
 
         cfg = self.cfg
-        n = cols.n
-        if n == 0 or self.store is not None:
+        if cols.n == 0 or self.store is not None:
             return None
         if now is None:
             now = self.now_fn()
 
-        hi, lo, grp = _native.hash128_batch_raw(
-            cols.key_data.tobytes(), cols.key_offsets, cfg.num_groups
-        )
+        if hashes is None:
+            hi, lo, grp = _native.hash128_batch_raw(
+                cols.key_data.tobytes(), cols.key_offsets, cfg.num_groups
+            )
+        else:
+            hi, lo, grp = hashes
+        if select is not None:
+            if len(select) == 0:
+                return None
+            hi, lo, grp = hi[select], lo[select], grp[select]
+            cols = _select_columns(cols, select)
+        n = cols.n
 
         # Wave = occurrence rank within the group (stable sort keeps
         # arrival order, preserving per-key sequencing); lane = arrival
@@ -1031,6 +1050,32 @@ class DeviceEngine(EngineBase):
             self.table = SlotTable(**fields)
         with self._keys_lock:
             self._key_strings = dict(snap.get("key_strings", {}))
+
+
+def _select_columns(cols, select: np.ndarray):
+    """Subset view of RequestColumns for check_columns(select=...): field
+    arrays are fancy-indexed; key bytes are NOT re-sliced (the caller
+    passes precomputed hashes, and key_string() is only used on the
+    original columns)."""
+    import dataclasses as _dc
+
+    empty = np.zeros(1, np.int64)
+    return _dc.replace(
+        cols,
+        n=int(len(select)),
+        hits=cols.hits[select],
+        limit=cols.limit[select],
+        duration=cols.duration[select],
+        algo=cols.algo[select],
+        behavior=cols.behavior[select],
+        burst=cols.burst[select],
+        created_at=cols.created_at[select],
+        has_created=cols.has_created[select],
+        slow=cols.slow[select],
+        name_lens=cols.name_lens[select],
+        key_data=cols.key_data,
+        key_offsets=empty,  # unusable after select; hashes are precomputed
+    )
 
 
 class _Bulk:

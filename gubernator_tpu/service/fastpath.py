@@ -56,9 +56,17 @@ def enabled(svc) -> bool:
     )
 
 
-def try_serve(svc, data: bytes, peer_call: bool) -> Optional[bytes]:
-    """Serve one call's raw request bytes columnar-fast, or None to fall
-    back to the object path."""
+def try_serve(svc, data: bytes, peer_call: bool):
+    """Serve one call's raw request bytes columnar-fast.
+
+    Returns:
+    - bytes — the complete response (all items served columnar);
+    - ("mixed", n, local_pos, local_arrays, nonlocal_reqs) — locally
+      owned items already DECIDED columnar; the async caller forwards
+      `nonlocal_reqs` through the object path and splices with
+      merge_mixed() (V1 only; peer calls are all-local by construction);
+    - None — fall back to the object path entirely.
+    """
     cols = wire.parse_requests(data)
     if cols is None or cols.n == 0 or cols.n > MAX_BATCH_SIZE:
         return None
@@ -72,25 +80,122 @@ def try_serve(svc, data: bytes, peer_call: bool) -> Optional[bytes]:
         key_lens - cols.name_lens - 1 == 0
     ):
         return None
+    local = None
     if not peer_call:
         picker = svc.picker
         if picker is not None and picker.peers():
             variant = _RING_VARIANT.get(getattr(picker, "hash_fn", None))
             if variant is None:
                 return None
-            hashes = wire.fnv1_batch(cols.key_data, cols.key_offsets, variant)
-            if not picker.local_mask(hashes).all():
-                return None  # at least one key is peer-owned
+            ring_h = wire.fnv1_batch(cols.key_data, cols.key_offsets, variant)
+            mask = picker.local_mask(ring_h)
+            if not mask.all():
+                local = np.asarray(mask, dtype=bool)
+    if local is None:
+        # NOTE: only check_columns is guarded — a failure BEFORE the
+        # table commits falls back safely; anything after the commit must
+        # fail LOUD (a silent fallback would re-apply every hit).
+        try:
+            out = svc.engine.check_columns(cols)
+        except Exception:
+            return None
+        if out is None:
+            return None
+        m = getattr(svc, "_m_local", None)
+        if m is not None:
+            m.inc(cols.n)
+        return wire.build_responses(*out)
+    if not local.any():
+        return None  # nothing local to decide: pure forwarding batch
+    # Mixed ownership: decide the local subset columnar now (with the
+    # identity hashes computed once over the full batch); hand the
+    # peer-owned subset back as objects for the forwarding path. The
+    # request objects build BEFORE the decide so a construction failure
+    # cannot strand already-committed hits.
+    from gubernator_tpu import native as _native
+
+    local_pos = np.nonzero(local)[0]
+    nonlocal_pos = np.nonzero(~local)[0]
+    nonlocal_reqs = [_req_from_columns(cols, int(i)) for i in nonlocal_pos]
+    hashes = _native.hash128_batch_raw(
+        cols.key_data.tobytes(), cols.key_offsets,
+        svc.engine.cfg.num_groups,
+    )
     try:
-        out = svc.engine.check_columns(cols)
+        out = svc.engine.check_columns(cols, select=local_pos, hashes=hashes)
     except Exception:
-        # Engine failure: fall back so the object path produces its
-        # per-item error contract instead of an opaque RPC failure.
         return None
     if out is None:
         return None
-    status, limit, remaining, reset_time = out
     m = getattr(svc, "_m_local", None)
     if m is not None:
-        m.inc(cols.n)
-    return wire.build_responses(status, limit, remaining, reset_time)
+        m.inc(len(local_pos))
+    return ("mixed", cols.n, local_pos, out, nonlocal_reqs)
+
+
+def _req_from_columns(cols, i: int):
+    """RateLimitReq object for one (peer-owned) lane — the forwarding
+    path needs objects; only the non-local fraction pays this cost."""
+    from gubernator_tpu.api.types import RateLimitReq
+
+    ks = cols.key_string(i)
+    nl = int(cols.name_lens[i])
+    created = int(cols.created_at[i])
+    return RateLimitReq(
+        name=ks[:nl],
+        unique_key=ks[nl + 1 :],
+        algorithm=int(cols.algo[i]),
+        behavior=int(cols.behavior[i]),
+        hits=int(cols.hits[i]),
+        limit=int(cols.limit[i]),
+        duration=int(cols.duration[i]),
+        burst=int(cols.burst[i]),
+        created_at=created if cols.has_created[i] and created != 0 else None,
+    )
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def merge_mixed(n: int, local_pos, local_out, nonlocal_resps) -> bytes:
+    """Splice columnar-decided local items with forwarded object-path
+    responses, preserving request order. Repeated message items frame
+    independently, so native-built runs and protobuf-serialized items
+    concatenate into one valid GetRateLimitsResp."""
+    from gubernator_tpu.service import pb
+
+    status, limit, remaining, reset_time = local_out
+    local_set = set(int(i) for i in local_pos)
+    chunks = []
+    li = 0  # pointer into local arrays
+    ni = 0  # pointer into nonlocal responses
+
+    def flush_run(count):
+        nonlocal li
+        if count:
+            s = slice(li - count, li)
+            chunks.append(
+                wire.build_responses(
+                    status[s], limit[s], remaining[s], reset_time[s]
+                )
+            )
+
+    run = 0
+    for i in range(n):
+        if i in local_set:
+            li += 1
+            run += 1
+        else:
+            flush_run(run)
+            run = 0
+            body = pb.resp_to_pb(nonlocal_resps[ni]).SerializeToString()
+            ni += 1
+            chunks.append(b"\x0a" + _varint(len(body)) + body)
+    flush_run(run)
+    return b"".join(chunks)

@@ -56,11 +56,28 @@ class V1Servicer:
                 # Executor keeps the event loop responsive while the
                 # kernel runs (the C parse and the jitted decide release
                 # the GIL, so calls genuinely overlap).
-                raw = await asyncio.get_running_loop().run_in_executor(
+                res = await asyncio.get_running_loop().run_in_executor(
                     None, self._fast.try_serve, self.svc, request_bytes, False
                 )
-                if raw is not None:
-                    return raw
+                if isinstance(res, bytes):
+                    return res
+                if res is not None:  # mixed ownership: forward the rest
+                    _, n, local_pos, local_out, nl_reqs = res
+                    # Local hits are already committed — a forwarding
+                    # failure must degrade the REMOTE items to per-item
+                    # errors, never fail the RPC (a client retry would
+                    # double-charge every local key).
+                    from gubernator_tpu.api.types import RateLimitResp
+
+                    try:
+                        nl_resps = await self.svc.get_rate_limits(nl_reqs)
+                    except Exception as e:
+                        nl_resps = [
+                            RateLimitResp(error=str(e)) for _ in nl_reqs
+                        ]
+                    return self._fast.merge_mixed(
+                        n, local_pos, local_out, nl_resps
+                    )
             try:
                 request = pb.pb.GetRateLimitsReq.FromString(request_bytes)
             except Exception:
@@ -102,7 +119,7 @@ class PeersV1Servicer:
                 raw = await asyncio.get_running_loop().run_in_executor(
                     None, self._fast.try_serve, self.svc, request_bytes, True
                 )
-                if raw is not None:
+                if isinstance(raw, bytes):  # peer calls are never "mixed"
                     return raw
             try:
                 request = pb.peers_pb.GetPeerRateLimitsReq.FromString(
