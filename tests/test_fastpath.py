@@ -274,3 +274,55 @@ def test_mixed_ownership_split(loop_thread):
             await c.stop()
 
     loop_thread.run(scenario(), timeout=120)
+
+
+@pytest.mark.parametrize("seed", [31])
+def test_columns_adversarial_domain(seed):
+    """In-domain adversarial values (limits near MAX_COUNT, huge hits,
+    big time jumps): columnar and object paths must stay identical."""
+    from gubernator_tpu.models.bucket import MAX_COUNT
+
+    rng = random.Random(seed)
+    clock = {"now": NOW}
+    eng_a = mk_engine(clock)
+    eng_b = mk_engine(clock)
+    keys = [f"adv{i}" for i in range(6)]
+    try:
+        for step in range(60):
+            if rng.random() < 0.25:
+                clock["now"] += rng.choice([3, 900, 70_000, 10_000_000])
+            batch = []
+            for _ in range(rng.randint(1, 24)):
+                b = 0
+                if rng.random() < 0.12:
+                    b |= Behavior.RESET_REMAINING
+                if rng.random() < 0.12:
+                    b |= Behavior.DRAIN_OVER_LIMIT
+                batch.append(
+                    RateLimitReq(
+                        name="xf", unique_key=rng.choice(keys),
+                        algorithm=rng.choice(
+                            [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                        ),
+                        behavior=b,
+                        duration=rng.choice([50, 60_000, 3_600_000]),
+                        limit=rng.choice([1, 7, MAX_COUNT, MAX_COUNT - 1]),
+                        hits=rng.choice([-5, 0, 1, 120, 1 << 20]),
+                        burst=rng.choice([0, 11, MAX_COUNT]),
+                    )
+                )
+            cols = wire.parse_requests(to_proto_bytes(batch))
+            got = eng_a.check_columns(cols, now=clock["now"])
+            assert got is not None
+            status, limit, remaining, reset_time = got
+            want = eng_b.check_batch([dataclasses.replace(r) for r in batch])
+            for i, w in enumerate(want):
+                assert (
+                    int(status[i]), int(limit[i]), int(remaining[i]),
+                    int(reset_time[i]),
+                ) == (int(w.status), w.limit, w.remaining, w.reset_time), (
+                    f"seed {seed} step {step} item {i}: {batch[i]}"
+                )
+    finally:
+        eng_a.close()
+        eng_b.close()
