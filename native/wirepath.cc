@@ -47,6 +47,18 @@ struct Reader {
     return len;
   }
 
+  // Decode a tag and reject what conformant parsers reject: field 0 and
+  // field numbers above 2^29-1 (protobuf's FieldDescriptor::kMaxNumber).
+  // Without this cap, (uint32_t)(tag >> 3) truncation lets a huge field
+  // number alias onto name/unique_key — key material the object path
+  // would refuse with DecodeError.
+  uint64_t tag_checked() {
+    uint64_t tag = varint();
+    uint64_t field = tag >> 3;
+    if (field == 0 || field > 536870911ULL) ok = false;
+    return tag;
+  }
+
   // Skip a field of the given wire type (after its tag).
   void skip(uint32_t wt) {
     switch (wt) {
@@ -130,7 +142,8 @@ int guber_count_requests(const uint8_t* buf, int len, int64_t* key_bytes) {
   int n = 0;
   int64_t kb = 0;
   while (r.p < r.end && r.ok) {
-    uint64_t tag = r.varint();
+    uint64_t tag = r.tag_checked();
+    if (!r.ok) return -1;
     uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
     if (field == 1 && wt == 2) {
       uint64_t mlen = r.len_checked();
@@ -139,7 +152,7 @@ int guber_count_requests(const uint8_t* buf, int len, int64_t* key_bytes) {
       Reader m{r.p, mend};
       int64_t name_len = 0, key_len = 0;
       while (m.p < m.end && m.ok) {
-        uint64_t t2 = m.varint();
+        uint64_t t2 = m.tag_checked();
         uint32_t f2 = (uint32_t)(t2 >> 3), w2 = (uint32_t)(t2 & 7);
         if (f2 == 1 && w2 == 2) {
           uint64_t l = m.len_checked();
@@ -181,7 +194,8 @@ int guber_parse_requests(const uint8_t* buf, int len, int64_t* hits,
   int64_t kpos = 0;
   key_offsets[0] = 0;
   while (r.p < r.end && r.ok) {
-    uint64_t tag = r.varint();
+    uint64_t tag = r.tag_checked();
+    if (!r.ok) return -1;
     uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
     if (field == 1 && wt == 2) {
       uint64_t mlen = r.len_checked();
@@ -202,7 +216,7 @@ int guber_parse_requests(const uint8_t* buf, int len, int64_t* hits,
       const uint8_t* key_p = nullptr;
       int64_t key_len = 0;
       while (m.p < m.end && m.ok) {
-        uint64_t t2 = m.varint();
+        uint64_t t2 = m.tag_checked();
         uint32_t f2 = (uint32_t)(t2 >> 3), w2 = (uint32_t)(t2 & 7);
         switch (f2) {
           case 1:
@@ -225,31 +239,58 @@ int guber_parse_requests(const uint8_t* buf, int len, int64_t* hits,
               m.skip(w2);
             }
             break;
+          // Scalar varint fields: consume the value ONLY for wire type 0.
+          // A mis-typed field must advance the reader exactly like the
+          // count pass's m.skip(w2) does — otherwise the two passes can
+          // disagree on where field boundaries are and the second pass
+          // writes past the count-sized key buffers (wire-type confusion).
           case 3:
-            hits[n] = zigzag_passthrough(m.varint());
+            if (w2 == 0)
+              hits[n] = zigzag_passthrough(m.varint());
+            else
+              m.skip(w2);
             break;
           case 4:
-            limit[n] = zigzag_passthrough(m.varint());
+            if (w2 == 0)
+              limit[n] = zigzag_passthrough(m.varint());
+            else
+              m.skip(w2);
             break;
           case 5:
-            duration[n] = zigzag_passthrough(m.varint());
+            if (w2 == 0)
+              duration[n] = zigzag_passthrough(m.varint());
+            else
+              m.skip(w2);
             break;
           case 6:
-            algo[n] = (int32_t)m.varint();
+            if (w2 == 0)
+              algo[n] = (int32_t)m.varint();
+            else
+              m.skip(w2);
             break;
           case 7:
-            behavior[n] = zigzag_passthrough(m.varint());
+            if (w2 == 0)
+              behavior[n] = zigzag_passthrough(m.varint());
+            else
+              m.skip(w2);
             break;
           case 8:
-            burst[n] = zigzag_passthrough(m.varint());
+            if (w2 == 0)
+              burst[n] = zigzag_passthrough(m.varint());
+            else
+              m.skip(w2);
             break;
           case 9:
             slow[n] = 1;
             m.skip(w2);
             break;
           case 10:
-            created_at[n] = zigzag_passthrough(m.varint());
-            has_created[n] = 1;
+            if (w2 == 0) {
+              created_at[n] = zigzag_passthrough(m.varint());
+              has_created[n] = 1;
+            } else {
+              m.skip(w2);
+            }
             break;
           default:
             m.skip(w2);

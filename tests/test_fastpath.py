@@ -221,6 +221,135 @@ def test_malformed_and_invalid_utf8_fall_back(loop_thread):
     loop_thread.run(scenario(), timeout=120)
 
 
+def _tag(field: int, wt: int) -> bytes:
+    assert field < 16
+    return bytes([(field << 3) | wt])
+
+
+def _varint(v: int) -> bytes:
+    v &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def test_wire_type_confusion_adversarial():
+    """The exact shape from the round-2 security review: a scalar field
+    (hits=3) encoded as wire-type 2 whose payload embeds a fake field-2
+    length record. The count pass skips it by wire type; the parse pass
+    must do the same — never reinterpret the payload as key bytes (that
+    disagreement was a heap overflow into the count-sized key buffer)."""
+    inner = b""
+    inner += _tag(1, 2) + _varint(1) + b"n"
+    inner += _tag(2, 2) + _varint(1) + b"k"
+    fake = _tag(2, 2) + _varint(40) + b"x" * 40  # fake unique_key record
+    inner += _tag(3, 2) + _varint(len(fake)) + fake
+    data = _tag(1, 2) + _varint(len(inner)) + inner
+
+    msg = pb.pb.GetRateLimitsReq.FromString(data)
+    assert len(msg.requests) == 1
+    assert msg.requests[0].hits == 0  # mis-typed field -> unknown, skipped
+
+    cols = wire.parse_requests(data)
+    assert cols is not None and cols.n == 1
+    assert cols.key_string(0) == "n_k"
+    assert int(cols.hits[0]) == 0
+    # count and parse agree on key bytes (the overflow invariant)
+    assert int(cols.key_offsets[-1]) == len(cols.key_data)
+
+
+def test_invalid_field_numbers_rejected():
+    """Field 0 and field numbers above 2^29-1 are DecodeErrors for the
+    object path; the fast path must reject them too — a huge field
+    number must never truncate onto name/unique_key and become key
+    material."""
+    def wrap(inner: bytes) -> bytes:
+        return _tag(1, 2) + _varint(len(inner)) + inner
+
+    base = _tag(1, 2) + _varint(1) + b"n" + _tag(2, 2) + _varint(1) + b"k"
+    # field 0 tag inside an item
+    assert wire.parse_requests(wrap(base + b"\x00")) is None
+    # field 2^32 + 2 aliases to field 2 under 32-bit truncation
+    huge = _varint(((1 << 32) + 2) << 3 | 2) + _varint(5) + b"alias"
+    assert wire.parse_requests(wrap(base + huge)) is None
+    # field 0 / huge field at the top level
+    assert wire.parse_requests(b"\x00" + wrap(base)) is None
+    assert wire.parse_requests(_varint((1 << 33) << 3 | 2) + _varint(0)) is None
+    # protobuf agrees these are all malformed
+    for data in (wrap(base + b"\x00"), wrap(base + huge)):
+        with pytest.raises(Exception):
+            pb.pb.GetRateLimitsReq.FromString(data)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_wire_type_mutation_fuzz(seed):
+    """Differential fuzz with randomized wire types on every field: the
+    columnar parser must agree with the protobuf object path whenever
+    protobuf accepts the bytes, and must cleanly reject (None) or agree —
+    never crash or mis-slice key bytes — when it does not."""
+    rng = random.Random(seed)
+    for _ in range(300):
+        n_items = rng.randint(0, 4)
+        body = b""
+        expect_parseable = True
+        for _i in range(n_items):
+            inner = b""
+            for _f in range(rng.randint(0, 8)):
+                field = rng.randint(1, 12)
+                wt = rng.choice([0, 0, 0, 2, 2, 1, 5, rng.choice([3, 4])])
+                inner += _tag(field, wt)
+                if wt == 0:
+                    inner += _varint(rng.choice([0, 1, 7, 2**31, 2**63, 2**64 - 1]))
+                elif wt == 1:
+                    inner += rng.randbytes(8)
+                elif wt == 5:
+                    inner += rng.randbytes(4)
+                elif wt == 2:
+                    if field in (1, 2) and rng.random() < 0.7:
+                        payload = bytes(
+                            rng.choice(b"abcdefgh")
+                            for _ in range(rng.randint(0, 6))
+                        )
+                    else:
+                        payload = rng.randbytes(rng.randint(0, 12))
+                    inner += _varint(len(payload)) + payload
+                else:
+                    expect_parseable = False  # group wire types: reject
+            body += _tag(1, 2) + _varint(len(inner)) + inner
+        try:
+            msg = pb.pb.GetRateLimitsReq.FromString(body)
+        except Exception:
+            msg = None
+        cols = wire.parse_requests(body)
+        if cols is None:
+            continue  # clean rejection -> object path handles it
+        # key-buffer invariant must hold no matter what
+        assert int(cols.key_offsets[-1]) <= len(cols.key_data)
+        assert np.all(np.diff(cols.key_offsets) >= 0)
+        if msg is None or not expect_parseable:
+            continue
+        assert cols.n == len(msg.requests)
+        for i, req in enumerate(msg.requests):
+            assert cols.key_string(i) == f"{req.name}_{req.unique_key}", (
+                f"seed {seed} item {i}"
+            )
+            assert int(cols.hits[i]) == req.hits
+            assert int(cols.limit[i]) == req.limit
+            assert int(cols.duration[i]) == req.duration
+            want_algo = req.algorithm & 0xFFFFFFFF
+            if want_algo >= 1 << 31:
+                want_algo -= 1 << 32
+            assert int(cols.algo[i]) == want_algo
+            assert int(cols.behavior[i]) == req.behavior
+            assert int(cols.burst[i]) == req.burst
+
+
 def test_mixed_ownership_split(loop_thread):
     """A V1 batch mixing locally-owned and peer-owned keys: local lanes
     decide columnar, the rest forward — responses splice in request
