@@ -335,6 +335,10 @@ def main() -> None:
         "kernel10m: BASELINE config 5 — 10M-key Zipfian mixed behaviors "
         "on a 16M-slot table",
     )
+    parser.add_argument(
+        "--layout", default="fused", choices=("wide", "packed", "fused"),
+        help="table layout for kernel modes (ops/kernels.py)",
+    )
     args, _ = parser.parse_known_args()
 
     child_out = os.environ.get("GUBER_BENCH_CHILD")
@@ -362,14 +366,26 @@ def main() -> None:
     if args.mode == "global":
         emit(bench_global())
         return
+    emit(bench_kernel(args.mode, args.layout))
 
-    from gubernator_tpu.ops import SlotTable, decide, decide_scan
+
+def bench_kernel(mode: str = "kernel", layout: str = "fused") -> dict:
+    """Device decide() throughput. mode="kernel": BASELINE config (3),
+    1M-key Zipfian on a 2M-slot table. mode="kernel10m": config (5),
+    10M-key Zipfian mixed behaviors on a 16M-slot table. layout selects
+    the table layout ("wide" | "packed", see ops/kernels.py)."""
+    import jax
+
+    from gubernator_tpu.ops.kernels import get_kernels
     from gubernator_tpu.ops.layout import RequestBatch
 
+    K = get_kernels(layout)
+
+    dev = jax.devices()[0]
     platform = dev.platform
 
     NOW = 1_753_700_000_000
-    if args.mode == "kernel10m":
+    if mode == "kernel10m":
         # BASELINE config (5): 10M-key Zipfian, mixed token+leaky with
         # RESET_REMAINING + DRAIN_OVER_LIMIT, 16M-slot table (~1.7GB).
         NUM_GROUPS = 1 << 21  # 2M groups x 8 ways = 16M slots
@@ -412,7 +428,7 @@ def main() -> None:
         )
         b.group[:n] = grp[:n].astype(np.int32)
         b.algo[:n] = (keys[:n] % 4 == 0).astype(np.int8)  # 25% leaky
-        if args.mode == "kernel10m":
+        if mode == "kernel10m":
             # config (5) behavior mix: RESET_REMAINING + DRAIN_OVER_LIMIT
             from gubernator_tpu.api.types import Behavior
 
@@ -431,18 +447,26 @@ def main() -> None:
         b.active[:n] = True
         return b
 
-    table = SlotTable.create(NUM_GROUPS, WAYS)
+    table = K.create(NUM_GROUPS, WAYS)
 
     # Stacked chunk of batches for decide_scan (one dispatch per chunk).
     batches = [make_batch() for _ in range(STEPS_PER_CHUNK)]
     stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
     active_per_chunk = int(sum(b.active.sum() for b in batches))
     nows = np.arange(NOW, NOW + STEPS_PER_CHUNK, dtype=np.int64)
+    single = batches[0]
 
-    # Warmup/compile
+    # Compile EVERYTHING up front and report each phase as it lands
+    # (a flaky device tunnel must not discard already-measured numbers).
+    t0 = time.perf_counter()
+    table, out1 = K.decide(table, single, NOW - 10, WAYS, False)
+    jax.block_until_ready(out1.status)
+    print(f"[bench] decide compiled in {time.perf_counter() - t0:.1f}s ({layout})", flush=True)
+    t0 = time.perf_counter()
     for _ in range(WARM_CHUNKS):
-        table, out = decide_scan(table, stacked, nows, ways=WAYS)
+        table, out = K.decide_scan(table, stacked, nows, WAYS, False)
     jax.block_until_ready(out.status)
+    print(f"[bench] decide_scan compiled+warm in {time.perf_counter() - t0:.1f}s", flush=True)
 
     # Throughput: chunks of scanned decide steps. Eviction counters stay
     # on device until after the timed loop — materializing them per chunk
@@ -450,7 +474,7 @@ def main() -> None:
     t0 = time.perf_counter()
     evic_dev = []
     for _ in range(CHUNKS):
-        table, out = decide_scan(table, stacked, nows, ways=WAYS)
+        table, out = K.decide_scan(table, stacked, nows, WAYS, False)
         evic_dev.append(out.unexpired_evictions)
     jax.block_until_ready(out.status)
     dt = time.perf_counter() - t0
@@ -460,22 +484,31 @@ def main() -> None:
     # Eviction rate under Zipf skew (VERDICT r1 item 8): how often a live
     # entry is displaced by capacity pressure, per decision.
     evict_rate = evictions / max(decisions, 1)
+    print(f"[bench] THROUGHPUT {throughput:.0f} decisions/s "
+          f"(evict_rate={evict_rate:.2e})", flush=True)
 
-    # Latency: single decide() dispatch round-trips (batch B)
-    single = batches[0]
-    lat = []
-    for i in range(50):
-        t1 = time.perf_counter()
-        table, out1 = decide(table, single, NOW + 1000 + i, ways=WAYS)
-        jax.block_until_ready(out1.status)
-        lat.append(time.perf_counter() - t1)
-    lat_ms = np.array(lat) * 1000
-    p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
+    # Latency: single decide() dispatch round-trips (batch B). Guarded:
+    # a tunnel hiccup here must not lose the throughput number.
+    p50 = p99 = float("nan")
+    try:
+        lat = []
+        for i in range(50):
+            t1 = time.perf_counter()
+            table, out1 = K.decide(table, single, NOW + 1000 + i, WAYS, False)
+            jax.block_until_ready(out1.status)
+            lat.append(time.perf_counter() - t1)
+        lat_ms = np.array(lat) * 1000
+        p50 = float(np.percentile(lat_ms, 50))
+        p99 = float(np.percentile(lat_ms, 99))
+        print(f"[bench] LATENCY p50={p50:.2f}ms p99={p99:.2f}ms", flush=True)
+    except Exception as e:  # report throughput anyway
+        print(f"[bench] latency phase failed: {e!r}", flush=True)
 
     result = {
         "metric": (
             f"rate-limit decisions/sec/chip @{N_KEYS//1_000_000}M keys zipf "
-            f"(kernel{'10m' if args.mode == 'kernel10m' else ''}, {platform}); "
+            f"(kernel{'10m' if mode == 'kernel10m' else ''}, {platform}, "
+            f"{layout} layout); "
             f"batch={B}, p50_batch={p50:.2f}ms, p99_batch={p99:.2f}ms, "
             f"unexpired_evictions/decision={evict_rate:.2e}"
         ),
@@ -484,7 +517,7 @@ def main() -> None:
         # reference production headline ~2000 req/s x 2 checks = 4000/s/node
         "vs_baseline": round(throughput / 4000.0, 1),
     }
-    emit(result)
+    return result
 
 
 if __name__ == "__main__":

@@ -1,0 +1,124 @@
+"""Layout-agnostic kernel facade.
+
+The engine selects a table layout by name (EngineConfig.layout):
+
+- "wide": one int64 column per field (ops/layout.py + ops/decide.py) —
+  the reference-shaped baseline.
+- "packed": narrowed/packed columns with a 3-gather probe (ops/packed.py).
+- "fused": ONE (N, C) tensor, one gather + one scatter (ops/fused.py) —
+  the fastest at scale (the SoA layouts hit XLA defensive whole-table
+  copies; see ops/fused.py's module docstring) and the flagship default.
+
+Both are bit-exact against the oracle (tests/test_kernel_fuzz.py runs the
+whole differential suite per layout). Snapshots are ALWAYS exchanged in
+the wide format (to_wide/from_wide), so Loader files are portable across
+layouts.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from gubernator_tpu.ops.decide import (
+    decide as _wd,
+    decide_scan as _wds,
+    gather_rows as _wgr,
+    probe_exists as _wpe,
+)
+from gubernator_tpu.ops.inject import inject as _wi
+from gubernator_tpu.ops.layout import SlotTable
+
+
+class Kernels(NamedTuple):
+    layout: str
+    create: object  # (num_groups, ways) -> table
+    decide: object  # (table, batch, now, ways, with_store) -> (table, out)
+    decide_scan: object  # (table, batches, nows, ways, with_store)
+    inject: object  # (table, items, now, ways) -> (table, ehi, elo)
+    probe_exists: object  # (table, hi, lo, group, now, ways) -> bool[B]
+    gather_rows: object  # (table, slots) -> SlotTable rows (wide view)
+    to_wide: object  # table -> SlotTable
+    from_wide: object  # SlotTable -> table
+
+
+def _wide_decide(table, batch, now, ways, with_store=False):
+    return _wd(table, batch, now, ways=ways)
+
+
+def _wide_scan(table, batches, nows, ways, with_store=False):
+    return _wds(table, batches, nows, ways=ways)
+
+
+_WIDE = Kernels(
+    layout="wide",
+    create=SlotTable.create,
+    decide=_wide_decide,
+    decide_scan=_wide_scan,
+    inject=lambda table, items, now, ways: _wi(table, items, now, ways=ways),
+    probe_exists=lambda table, hi, lo, group, now, ways: _wpe(
+        table, hi, lo, group, now, ways=ways
+    ),
+    gather_rows=_wgr,
+    to_wide=lambda t: t,
+    from_wide=lambda t: t,
+)
+
+
+def _packed():
+    from gubernator_tpu.ops import packed as _p
+
+    return Kernels(
+        layout="packed",
+        create=_p.PackedTable.create,
+        decide=lambda table, batch, now, ways, with_store=False: _p.decide_packed(
+            table, batch, now, ways=ways, with_store=with_store
+        ),
+        decide_scan=lambda table, batches, nows, ways, with_store=False: (
+            _p.decide_scan_packed(
+                table, batches, nows, ways=ways, with_store=with_store
+            )
+        ),
+        inject=lambda table, items, now, ways: _p.inject_packed(
+            table, items, now, ways=ways
+        ),
+        probe_exists=lambda table, hi, lo, group, now, ways: (
+            _p.probe_exists_packed(table, hi, lo, group, now, ways=ways)
+        ),
+        gather_rows=_p.gather_rows_packed,
+        to_wide=_p.unpack_table,
+        from_wide=_p.pack_table,
+    )
+
+
+def _fused():
+    from gubernator_tpu.ops import fused as _f
+
+    return Kernels(
+        layout="fused",
+        create=_f.FusedTable.create,
+        decide=lambda table, batch, now, ways, with_store=False: _f.decide_fused(
+            table, batch, now, ways=ways
+        ),
+        decide_scan=lambda table, batches, nows, ways, with_store=False: (
+            _f.decide_scan_fused(table, batches, nows, ways=ways)
+        ),
+        inject=lambda table, items, now, ways: _f.inject_fused(
+            table, items, now, ways=ways
+        ),
+        probe_exists=lambda table, hi, lo, group, now, ways: (
+            _f.probe_exists_fused(table, hi, lo, group, now, ways=ways)
+        ),
+        gather_rows=_f.gather_rows_fused,
+        to_wide=_f.unpack_table,
+        from_wide=_f.pack_table,
+    )
+
+
+def get_kernels(layout: str) -> Kernels:
+    if layout == "wide":
+        return _WIDE
+    if layout == "packed":
+        return _packed()
+    if layout == "fused":
+        return _fused()
+    raise ValueError(f"unknown table layout: {layout!r}")

@@ -1,0 +1,453 @@
+"""Packed slot-table layout: fewer, narrower columns on the probe path.
+
+The wide layout (ops/layout.py) probes 6 int64-ish columns per way
+(key_hi, key_lo, used, expire_at, invalid_at, lru — ~41 bytes x W ways
+per lane); at 16M slots the gathers are memory-bound and dominate the
+kernel (the round-2 10M-key collapse). This layout cuts the probe to 3
+gathers x 24 bytes per way:
+
+- `key_lo` (int64): the 64-bit probe identity. The full 128-bit compare
+  is completed by verifying `key_hi` at the matched way only (one
+  per-lane gather). Distinct keys therefore NEVER merge counters; the
+  residual risk is two live keys in one group sharing all 64 key_lo
+  bits (expected colliding pairs at 10M keys: ~3e-6), which degrades to
+  re-insertion (a fresh bucket), the same failure class as LRU eviction.
+- `meta` (int64): lru_stamp_ms << 4 | status << 2 | algo << 1 | used.
+  One gather yields the used bit and the LRU ordering; algo/status ride
+  free for the state phase.
+- `expire_at` (int64): full epoch-ms expiry — no epoch-rebase machinery,
+  no precision loss for Gregorian-year windows.
+- `invalid_at` is gathered ONLY when a Store is attached (static
+  `with_store` kernel variant): the store's re-fetch hint
+  (reference cache.go:35-40) is meaningless without one. Store-less
+  kernels never read or write the column.
+
+Cold (per-lane, not per-way) columns: limit/burst narrow to int32 (the
+2^31-1 count clamp is already the documented encode contract,
+models/bucket.py MAX_COUNT); remaining stays int64 (leaky Q44.20 needs
+51 bits, and the reference lets negative hits push token remaining past
+the limit, algorithms.go:196); duration/stamp stay int64 (Gregorian-year
+durations exceed int32 ms).
+
+Per-slot bytes: 64 (vs 83 wide). Probe bytes per way: 24 (vs 41).
+
+Branch semantics are IDENTICAL to the wide kernel: this module reuses
+_token_paths/_leaky_paths from ops/decide.py verbatim and is fuzz-pinned
+against the same oracle (tests/test_kernel_fuzz.py runs both layouts).
+Bucket field contract: reference store.go:29-43.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.api.types import Algorithm, Behavior, Status
+from gubernator_tpu.ops.decide import _leaky_paths, _token_paths
+from gubernator_tpu.ops.layout import DecideOutput, RequestBatch, SlotTable
+
+I64 = jnp.int64
+
+META_USED = 1
+META_ALGO_SHIFT = 1
+META_STATUS_SHIFT = 2
+META_LRU_SHIFT = 4
+
+
+class PackedTable(NamedTuple):
+    """Packed struct-of-arrays counter table; a JAX pytree."""
+
+    key_hi: jnp.ndarray  # (N,) int64
+    key_lo: jnp.ndarray  # (N,) int64
+    meta: jnp.ndarray  # (N,) int64: lru<<4 | status<<2 | algo<<1 | used
+    expire_at: jnp.ndarray  # (N,) int64 epoch ms
+    limit: jnp.ndarray  # (N,) int32
+    duration: jnp.ndarray  # (N,) int64
+    remaining: jnp.ndarray  # (N,) int64 (token: tokens; leaky: Q44.20)
+    stamp: jnp.ndarray  # (N,) int64
+    burst: jnp.ndarray  # (N,) int32
+    invalid_at: jnp.ndarray  # (N,) int64, 0 = unset (store hint)
+
+    @property
+    def num_slots(self) -> int:
+        return self.key_hi.shape[0]
+
+    # Wide-compatible views (host introspection: live_count, key pruning)
+    @property
+    def used(self) -> jnp.ndarray:
+        return (self.meta & META_USED) != 0
+
+    @property
+    def algo(self) -> jnp.ndarray:
+        return ((self.meta >> META_ALGO_SHIFT) & 1).astype(jnp.int8)
+
+    @property
+    def status(self) -> jnp.ndarray:
+        return ((self.meta >> META_STATUS_SHIFT) & 3).astype(jnp.int8)
+
+    @property
+    def lru(self) -> jnp.ndarray:
+        return self.meta >> META_LRU_SHIFT
+
+    @staticmethod
+    def create(num_groups: int, ways: int = 8) -> "PackedTable":
+        n = num_groups * ways
+        i64 = lambda: jnp.zeros((n,), dtype=jnp.int64)  # noqa: E731
+        i32 = lambda: jnp.zeros((n,), dtype=jnp.int32)  # noqa: E731
+        return PackedTable(
+            key_hi=i64(), key_lo=i64(), meta=i64(), expire_at=i64(),
+            limit=i32(), duration=i64(), remaining=i64(), stamp=i64(),
+            burst=i32(), invalid_at=i64(),
+        )
+
+
+def _pack_meta(used, algo, status, lru):
+    return (
+        (lru.astype(I64) << META_LRU_SHIFT)
+        | (status.astype(I64) & 3) << META_STATUS_SHIFT
+        | (algo.astype(I64) & 1) << META_ALGO_SHIFT
+        | used.astype(I64)
+    )
+
+
+@jax.jit
+def pack_table(wide: SlotTable) -> PackedTable:
+    """Wide -> packed conversion (snapshot interop; counts clamp to the
+    int32 contract MAX_COUNT already enforced at encode time)."""
+    return PackedTable(
+        key_hi=wide.key_hi,
+        key_lo=wide.key_lo,
+        meta=_pack_meta(wide.used, wide.algo, wide.status, wide.lru),
+        expire_at=wide.expire_at,
+        limit=wide.limit.astype(jnp.int32),
+        duration=wide.duration,
+        remaining=wide.remaining,
+        stamp=wide.stamp,
+        burst=wide.burst.astype(jnp.int32),
+        invalid_at=wide.invalid_at,
+    )
+
+
+@jax.jit
+def unpack_table(packed: PackedTable) -> SlotTable:
+    """Packed -> wide conversion (canonical Loader snapshot format)."""
+    return SlotTable(
+        key_hi=packed.key_hi,
+        key_lo=packed.key_lo,
+        used=packed.used,
+        algo=packed.algo,
+        status=packed.status,
+        limit=packed.limit.astype(I64),
+        duration=packed.duration,
+        remaining=packed.remaining,
+        stamp=packed.stamp,
+        expire_at=packed.expire_at,
+        invalid_at=packed.invalid_at,
+        burst=packed.burst.astype(I64),
+        lru=packed.lru,
+    )
+
+
+def _choose_slot_packed(
+    table: PackedTable, batch: RequestBatch, now, ways: int, with_store: bool
+):
+    """3-gather probe (4 with a store): key_lo + meta + expire_at per way;
+    key_hi verified at the chosen way only. Same insertion priority as the
+    wide kernel: matched-expired > empty > expired > LRU."""
+    grp_base = batch.group.astype(I64) * ways
+    way_ix = grp_base[:, None] + jnp.arange(ways, dtype=I64)[None, :]  # (B, W)
+
+    w_key_lo = table.key_lo[way_ix]
+    w_meta = table.meta[way_ix]
+    w_expire = table.expire_at[way_ix]
+    w_used = (w_meta & META_USED) != 0
+    w_lru = w_meta >> META_LRU_SHIFT
+
+    if with_store:
+        w_invalid = table.invalid_at[way_ix]
+        w_expired = w_used & (
+            (w_expire < now) | ((w_invalid != 0) & (w_invalid < now))
+        )
+    else:
+        w_expired = w_used & (w_expire < now)
+
+    lo_match = w_used & (w_key_lo == batch.key_lo[:, None])
+    live_lo = lo_match & ~w_expired
+    lo_exists = jnp.any(live_lo, axis=1)
+    matched_way = jnp.argmax(live_lo, axis=1)
+
+    cat = jnp.where(
+        lo_match & w_expired,
+        0,
+        jnp.where(~w_used, 1, jnp.where(w_expired, 2, 3)),
+    ).astype(I64)
+    tie = jnp.where(
+        cat == 3, jnp.clip(w_lru, 0, (1 << 44) - 1), way_ix - grp_base[:, None]
+    )
+    score = (cat << 44) + tie
+    insert_way = jnp.argmin(score, axis=1)
+
+    # Complete the 128-bit identity check on the matched way only.
+    hi_at_match = table.key_hi[grp_base + matched_way]
+    exists = lo_exists & (hi_at_match == batch.key_hi)
+
+    way = jnp.where(exists, matched_way, insert_way)
+    slot = grp_base + way
+    pick = jax.vmap(lambda r, w: r[w])
+    sel = pick(cat, insert_way)
+    evicts_live = (~exists) & (sel == 3) & batch.active
+
+    # Displaced occupant's key: hi needs one more per-lane gather (only
+    # the insert way's occupant can be displaced).
+    old_hi = jnp.where(exists, hi_at_match, table.key_hi[grp_base + insert_way])
+    old_lo = pick(w_key_lo, way)
+    old_used = pick(w_used, way)
+    displaced = (
+        batch.active
+        & ~exists
+        & old_used
+        & ((old_hi != batch.key_hi) | (old_lo != batch.key_lo))
+    )
+    evicted_hi = jnp.where(displaced, old_hi, 0)
+    evicted_lo = jnp.where(displaced, old_lo, 0)
+    w_state = dict(meta=pick(w_meta, way), expire=pick(w_expire, way))
+    return slot, exists, evicts_live, evicted_hi, evicted_lo, w_state
+
+
+def _decide_packed_impl(
+    table: PackedTable, batch: RequestBatch, now, *, ways: int, with_store: bool
+):
+    now = jnp.asarray(now, dtype=I64)
+    slot, exists, evicts_live, evicted_hi, evicted_lo, w_state = (
+        _choose_slot_packed(table, batch, now, ways, with_store)
+    )
+
+    # State phase: per-lane gathers of the cold columns; algo/status come
+    # from the already-gathered meta word.
+    meta_sel = w_state["meta"]
+    st = dict(
+        algo=((meta_sel >> META_ALGO_SHIFT) & 1).astype(jnp.int8),
+        status=((meta_sel >> META_STATUS_SHIFT) & 3).astype(jnp.int8),
+        limit=table.limit[slot].astype(I64),
+        duration=table.duration[slot],
+        remaining=table.remaining[slot],
+        stamp=table.stamp[slot],
+        expire_at=w_state["expire"],
+        burst=table.burst[slot].astype(I64),
+    )
+    if with_store:
+        st["invalid_at"] = table.invalid_at[slot]
+    for k in st:
+        st[k] = jnp.where(exists, st[k], jnp.zeros_like(st[k]))
+
+    bhv = batch.behavior
+    b_greg = (bhv & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    b_reset = (bhv & int(Behavior.RESET_REMAINING)) != 0
+    b_drain = (bhv & int(Behavior.DRAIN_OVER_LIMIT)) != 0
+
+    tok_state, tok_resp = _token_paths(batch, st, b_greg, b_reset, b_drain, exists, now)
+    lky_state, lky_resp = _leaky_paths(batch, st, b_greg, b_reset, b_drain, exists, now)
+
+    is_leaky = batch.algo == jnp.int8(Algorithm.LEAKY_BUCKET)
+
+    def pick(t, l):
+        return jnp.where(is_leaky, l, t)
+
+    new_state = {k: pick(tok_state[k], lky_state[k]) for k in tok_state}
+    resp = {k: pick(tok_resp[k], lky_resp[k]) for k in tok_resp}
+
+    n = table.num_slots
+    idx = jnp.where(batch.active, slot, n)
+    freed = ~new_state["used"]
+
+    def upd(arr, val):
+        return arr.at[idx].set(val, mode="drop")
+
+    meta_new = jnp.where(
+        freed,
+        0,
+        _pack_meta(
+            jnp.ones_like(freed),
+            batch.algo,
+            new_state["status"],
+            jnp.broadcast_to(now, idx.shape),
+        ),
+    )
+    kwargs = dict(
+        key_hi=upd(table.key_hi, jnp.where(freed, 0, batch.key_hi)),
+        key_lo=upd(table.key_lo, jnp.where(freed, 0, batch.key_lo)),
+        meta=upd(table.meta, meta_new),
+        expire_at=upd(table.expire_at, new_state["expire_at"]),
+        limit=upd(table.limit, new_state["limit"].astype(jnp.int32)),
+        duration=upd(table.duration, new_state["duration"]),
+        remaining=upd(table.remaining, new_state["remaining"]),
+        stamp=upd(table.stamp, new_state["stamp"]),
+        burst=upd(table.burst, new_state["burst"].astype(jnp.int32)),
+    )
+    if with_store:
+        kwargs["invalid_at"] = upd(
+            table.invalid_at,
+            jnp.where(
+                exists & ~freed, st["invalid_at"], jnp.zeros_like(batch.key_hi)
+            ),
+        )
+    else:
+        # Store-less kernels never touch the column (stale marks are
+        # harmless until a store attaches, and the with_store probe's
+        # insert path self-heals them).
+        kwargs["invalid_at"] = table.invalid_at
+    new_table = PackedTable(**kwargs)
+
+    act = batch.active
+    out = DecideOutput(
+        status=jnp.where(act, resp["status"], jnp.int8(0)),
+        limit=jnp.where(act, batch.limit, 0),
+        remaining=jnp.where(act, resp["remaining"], 0),
+        reset_time=jnp.where(act, resp["reset_time"], 0),
+        slot=idx,
+        evicted_hi=evicted_hi,
+        evicted_lo=evicted_lo,
+        freed=act & freed,
+        hits=jnp.sum(act & exists),
+        misses=jnp.sum(act & ~exists),
+        unexpired_evictions=jnp.sum(evicts_live),
+        over_limit=jnp.sum(act & resp["over"]),
+    )
+    return new_table, out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ways", "with_store"), donate_argnums=(0,)
+)
+def decide_packed(
+    table: PackedTable, batch: RequestBatch, now, ways: int = 8,
+    with_store: bool = False,
+):
+    """Jitted packed-layout decide step with donated table buffers."""
+    return _decide_packed_impl(table, batch, now, ways=ways, with_store=with_store)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ways", "with_store"), donate_argnums=(0,)
+)
+def decide_scan_packed(
+    table: PackedTable, batches: RequestBatch, nows, ways: int = 8,
+    with_store: bool = False,
+):
+    """Scan twin of ops.decide.decide_scan for the packed layout."""
+
+    def step(tbl, xs):
+        b, now = xs
+        tbl, out = _decide_packed_impl(
+            tbl, b, now, ways=ways, with_store=with_store
+        )
+        return tbl, out
+
+    return jax.lax.scan(step, table, (batches, nows))
+
+
+@functools.partial(jax.jit, static_argnames=("ways",))
+def probe_exists_packed(table: PackedTable, key_hi, key_lo, group, now, ways: int = 8):
+    """Residency probe (store read-through seam), packed layout. Always
+    consults invalid_at — this path only runs with a store attached."""
+    now = jnp.asarray(now, dtype=I64)
+    grp_base = group.astype(I64) * ways
+    way_ix = grp_base[:, None] + jnp.arange(ways, dtype=I64)[None, :]
+    w_meta = table.meta[way_ix]
+    w_used = (w_meta & META_USED) != 0
+    w_invalid = table.invalid_at[way_ix]
+    w_expired = w_used & (
+        (table.expire_at[way_ix] < now) | ((w_invalid != 0) & (w_invalid < now))
+    )
+    live = (
+        w_used
+        & ~w_expired
+        & (table.key_lo[way_ix] == key_lo[:, None])
+        & (table.key_hi[way_ix] == key_hi[:, None])
+    )
+    return jnp.any(live, axis=1)
+
+
+@jax.jit
+def gather_rows_packed(table: PackedTable, slots) -> SlotTable:
+    """Post-decide row readback, expanded to the wide row struct so the
+    engine's store write-behind code is layout-agnostic."""
+    n = table.num_slots
+    safe = jnp.clip(slots, 0, n - 1)
+    valid = slots < n
+
+    def g(arr):
+        v = arr[safe]
+        return jnp.where(valid, v, jnp.zeros_like(v))
+
+    meta = g(table.meta)
+    return SlotTable(
+        key_hi=g(table.key_hi),
+        key_lo=g(table.key_lo),
+        used=(meta & META_USED) != 0,
+        algo=((meta >> META_ALGO_SHIFT) & 1).astype(jnp.int8),
+        status=((meta >> META_STATUS_SHIFT) & 3).astype(jnp.int8),
+        limit=g(table.limit).astype(I64),
+        duration=g(table.duration),
+        remaining=g(table.remaining),
+        stamp=g(table.stamp),
+        expire_at=g(table.expire_at),
+        invalid_at=g(table.invalid_at),
+        burst=g(table.burst).astype(I64),
+        lru=meta >> META_LRU_SHIFT,
+    )
+
+
+def _inject_packed_impl(table: PackedTable, items, now, ways: int):
+    """Packed twin of ops.inject._inject_impl: overwrite rows with
+    authoritative state (Loader restore, Store read-through, GLOBAL
+    UpdatePeerGlobals landing)."""
+    now = jnp.asarray(now, dtype=I64)
+    # Reuse the packed probe to find each item's slot (match or insert).
+    batch_like = RequestBatch.zeros(items.key_hi.shape[0])._replace(
+        key_hi=items.key_hi,
+        key_lo=items.key_lo,
+        group=items.group,
+        active=items.active,
+    )
+    slot, exists, _ev, evicted_hi, evicted_lo, _w = _choose_slot_packed(
+        table, batch_like, now, ways, with_store=True
+    )
+    n = table.num_slots
+    idx = jnp.where(items.active, slot, n)
+
+    def upd(arr, val):
+        return arr.at[idx].set(val, mode="drop")
+
+    new_table = PackedTable(
+        key_hi=upd(table.key_hi, items.key_hi),
+        key_lo=upd(table.key_lo, items.key_lo),
+        meta=upd(
+            table.meta,
+            _pack_meta(
+                jnp.ones_like(items.active),
+                items.algo,
+                items.status,
+                jnp.broadcast_to(now, idx.shape),
+            ),
+        ),
+        expire_at=upd(table.expire_at, items.expire_at),
+        limit=upd(table.limit, items.limit.astype(jnp.int32)),
+        duration=upd(table.duration, items.duration),
+        remaining=upd(table.remaining, items.remaining),
+        stamp=upd(table.stamp, items.stamp),
+        burst=upd(table.burst, items.burst.astype(jnp.int32)),
+        invalid_at=upd(table.invalid_at, items.invalid_at),
+    )
+    # evicted_hi/lo are already masked to displaced lanes by the probe —
+    # same contract as ops.inject.inject.
+    return new_table, evicted_hi, evicted_lo
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def inject_packed(table: PackedTable, items, now, ways: int = 8):
+    return _inject_packed_impl(table, items, now, ways)

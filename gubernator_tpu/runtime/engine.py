@@ -41,7 +41,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
-from gubernator_tpu.ops.decide import decide, gather_rows, probe_exists
+from gubernator_tpu.ops.kernels import get_kernels
 from gubernator_tpu.utils import clock as _clock
 
 
@@ -65,6 +65,11 @@ class EngineConfig:
     # the columnar edge can size the kernel to each call's occupancy.
     fast_buckets: bool = False
     device: Optional[object] = None  # jax device for the table
+    # Table layout: "wide" (one int64 column per field), "packed"
+    # (narrowed columns, 3-gather probe), or "fused" (one (N, C) tensor,
+    # one gather + one scatter — fastest at scale, see ops/fused.py).
+    # All are oracle-exact; Loader snapshots are portable across them.
+    layout: str = "fused"
 
 
 class EngineMetrics:
@@ -327,8 +332,9 @@ class DeviceEngine(EngineBase):
             raise ValueError("max_waves must be >= 1")
         dev = config.device
 
+        self.K = get_kernels(config.layout)
         with jax.default_device(dev) if dev is not None else _nullcontext():
-            self.table: SlotTable = SlotTable.create(config.num_groups, config.ways)
+            self.table = self.K.create(config.num_groups, config.ways)
 
         self._warmup()
         self._init_base("gubernator-tpu-engine")
@@ -336,7 +342,10 @@ class DeviceEngine(EngineBase):
         # fast path only uses already-warm shapes (a cold compile mid-
         # request would blow through forwarding timeouts — same reason
         # _warmup exists). batch_size itself is warm from _warmup.
-        self._warm_shapes = {config.batch_size}
+        # Published as an immutable tuple swapped atomically by the warmer
+        # thread; readers iterate whatever snapshot they observe (mutating
+        # a shared set mid-iteration can raise in the reader).
+        self._warm_shapes = (config.batch_size,)
         if config.fast_buckets:
             threading.Thread(
                 target=self._warm_buckets, name="gubernator-warm-buckets",
@@ -371,32 +380,62 @@ class DeviceEngine(EngineBase):
                 # lands in a different jit cache entry and the "warm"
                 # shape still cold-compiles on first real use.
                 with jax.default_device(dev) if dev is not None else _nullcontext():
-                    scratch = SlotTable.create(cfg.num_groups, cfg.ways)
-                    scratch, out = decide(
-                        scratch, RequestBatch.zeros(B), self.now_fn(), ways=cfg.ways
+                    scratch = self.K.create(cfg.num_groups, cfg.ways)
+                    scratch, out = self.K.decide(
+                        scratch, RequestBatch.zeros(B), self.now_fn(),
+                        cfg.ways, self.store is not None,
                     )
                     np.asarray(out.status)
                     del scratch
             except Exception:
                 return  # engine closing / device issue: keep batch_size only
-            self._warm_shapes.add(B)
+            self._warm_shapes = self._warm_shapes + (B,)
 
     def _warmup(self) -> None:
         """Compile the decide AND inject kernels before serving: first XLA
         compilation takes seconds (tens of seconds on TPU), which would
         blow through peer-forwarding / GLOBAL broadcast timeouts (500ms
         default) on the first request."""
-        from gubernator_tpu.ops.inject import InjectBatch, inject
+        from gubernator_tpu.ops.inject import InjectBatch
 
         now = self.now_fn()
         wb = RequestBatch.zeros(self.cfg.batch_size)
-        table, out = decide(self.table, wb, now, ways=self.cfg.ways)
+        table, out = self.K.decide(
+            self.table, wb, now, self.cfg.ways, self.store is not None
+        )
         np.asarray(out.status)
-        table, _, _ = inject(
-            table, InjectBatch.zeros(self.cfg.batch_size), now, ways=self.cfg.ways
+        table, _, _ = self.K.inject(
+            table, InjectBatch.zeros(self.cfg.batch_size), now, self.cfg.ways
         )
         np.asarray(table.used[:1])
         self.table = table
+
+    def warm_store_path(self) -> None:
+        """Compile the store-path kernels (the with_store decide variant,
+        probe_exists, gather_rows) at serving shapes so the first flush
+        doesn't cold-compile under the serving lock. Called by
+        attach_store — at daemon init, before traffic, so briefly holding
+        the lock here is free."""
+        B = self.cfg.batch_size
+        cfg = self.cfg
+        z64 = np.zeros(B, np.int64)
+        now = self.now_fn()
+        with self._lock:
+            table, out = self.K.decide(
+                self.table, RequestBatch.zeros(B), now, cfg.ways, True
+            )
+            np.asarray(out.status)
+            self.table = table
+            np.asarray(
+                self.K.probe_exists(
+                    table, z64, z64, np.zeros(B, np.int32), now, cfg.ways
+                )
+            )
+            np.asarray(
+                self.K.gather_rows(
+                    table, np.full(B, table.num_slots, np.int64)
+                ).used
+            )
 
     # ---- introspection -----------------------------------------------------
 
@@ -534,10 +573,12 @@ class DeviceEngine(EngineBase):
                             table, wb, wave_lane_req[w], now,
                             prefetched, served, wave_rows_host, events,
                         )
-                    table, out = decide(table, wb, now, ways=cfg.ways)
+                    table, out = self.K.decide(
+                        table, wb, now, cfg.ways, self.store is not None
+                    )
                     outs.append(out)
                     if self.store is not None:
-                        rows = gather_rows(table, out.slot)
+                        rows = self.K.gather_rows(table, out.slot)
                         wave_rows_host.append(jax.tree.map(np.asarray, rows))
                         ehi = np.asarray(out.evicted_hi)
                         elo = np.asarray(out.evicted_lo)
@@ -672,6 +713,7 @@ class DeviceEngine(EngineBase):
         cfg = self.cfg
         if cols.n == 0 or self.store is not None:
             return None
+        t_start = time.perf_counter()
         if now is None:
             now = self.now_fn()
 
@@ -714,7 +756,7 @@ class DeviceEngine(EngineBase):
         # are used (batch_size always is; smaller buckets appear as the
         # background warmer finishes compiling them).
         B = cfg.batch_size
-        for s in tuple(self._warm_shapes):  # warmer thread adds concurrently
+        for s in self._warm_shapes:  # immutable snapshot; warmer swaps atomically
             if s > max_lane and s < B:
                 B = s
 
@@ -775,7 +817,7 @@ class DeviceEngine(EngineBase):
             try:
                 for w in range(W):
                     one = jax.tree.map(lambda a: a[w], wb)
-                    table, out = decide(table, one, now, ways=cfg.ways)
+                    table, out = self.K.decide(table, one, now, cfg.ways, False)
                     outs.append(out)
                 self.table = table
             except Exception:
@@ -792,7 +834,8 @@ class DeviceEngine(EngineBase):
         tot_evic = sum(int(o.unexpired_evictions) for o in outs)
         tot_over = sum(int(o.over_limit) for o in outs)
         self.metrics.observe(
-            tot_hits, tot_miss, tot_evic, tot_over, W, n, 0.0
+            tot_hits, tot_miss, tot_evic, tot_over, W, n,
+            time.perf_counter() - t_start,
         )
         return (status[ix], r_limit[ix], remaining[ix], reset_time[ix])
 
@@ -822,11 +865,11 @@ class DeviceEngine(EngineBase):
 
         Runs under self._lock; store outages degrade to misses, never
         table-fatal."""
-        from gubernator_tpu.ops.inject import InjectBatch, inject
+        from gubernator_tpu.ops.inject import InjectBatch
 
         cfg = self.cfg
         exists = np.asarray(
-            probe_exists(table, wb.key_hi, wb.key_lo, wb.group, now, ways=cfg.ways)
+            self.K.probe_exists(table, wb.key_hi, wb.key_lo, wb.group, now, cfg.ways)
         )
         rows = []
         for lane, (req, hi, lo) in lane_req.items():
@@ -871,7 +914,7 @@ class DeviceEngine(EngineBase):
             ib.invalid_at[j] = int(getattr(s, "invalid_at", 0))
             ib.burst[j] = s.burst
             ib.active[j] = True
-        table, ehi, elo = inject(table, ib, now, ways=cfg.ways)
+        table, ehi, elo = self.K.inject(table, ib, now, cfg.ways)
         ehi = np.asarray(ehi)
         elo = np.asarray(elo)
         for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
@@ -947,7 +990,7 @@ class DeviceEngine(EngineBase):
         except Exception:
             deleted = True
         if deleted:
-            self.table = SlotTable.create(self.cfg.num_groups, self.cfg.ways)
+            self.table = self.K.create(self.cfg.num_groups, self.cfg.ways)
             with self._keys_lock:
                 self._key_strings.clear()
 
@@ -987,7 +1030,7 @@ class DeviceEngine(EngineBase):
     def inject_snapshots(self, items: Sequence) -> None:
         """Write raw per-key state rows into the table (Loader restore and
         Store read-through feed; reference workers.go:537-580)."""
-        from gubernator_tpu.ops.inject import InjectBatch, inject
+        from gubernator_tpu.ops.inject import InjectBatch
 
         if not items:
             return
@@ -1023,7 +1066,7 @@ class DeviceEngine(EngineBase):
         with self._lock:
             table = self.table
             for ib in asm.waves:
-                table, _ehi, _elo = inject(table, ib, now, ways=cfg.ways)
+                table, _ehi, _elo = self.K.inject(table, ib, now, cfg.ways)
             self.table = table
 
     # ---- snapshot / restore (Loader seam, task: store) ---------------------
@@ -1032,7 +1075,7 @@ class DeviceEngine(EngineBase):
         """Device -> host snapshot of the table (the Loader.Save analog,
         reference store.go:76-78; SURVEY.md §5 checkpoint/resume)."""
         with self._lock:
-            tbl = self.table
+            tbl = self.K.to_wide(self.table)  # canonical wide snapshot
             host = {f: np.asarray(getattr(tbl, f)) for f in tbl._fields}
         with self._keys_lock:
             host["key_strings"] = dict(self._key_strings)
@@ -1047,7 +1090,7 @@ class DeviceEngine(EngineBase):
         read-through probe consults directly."""
         fields = {f: jax.numpy.asarray(snap[f]) for f in SlotTable._fields}
         with self._lock:
-            self.table = SlotTable(**fields)
+            self.table = self.K.from_wide(SlotTable(**fields))
         with self._keys_lock:
             self._key_strings = dict(snap.get("key_strings", {}))
 

@@ -252,6 +252,10 @@ class Daemon:
         deadline = asyncio.get_running_loop().time() + timeout_s
         for addr in addrs:
             host, port = addr.rsplit(":", 1)
+            # Bracketed IPv6 hosts ("[::]:81" -> "[::]") must be unwrapped
+            # before the wildcard check, or the dial below targets the
+            # literal string "[::]" and times out.
+            host = host.strip("[]")
             if host in ("0.0.0.0", "::"):
                 host = "127.0.0.1"
             while True:

@@ -161,3 +161,11 @@ def attach_store(engine, store: Store) -> None:
     never-seen keys OUTSIDE the device lock and keeps Loader snapshots
     carrying original key strings."""
     engine.store = store
+    # Warm the store-path kernels now: the first flush otherwise
+    # cold-compiles probe_exists/gather_rows while holding the serving
+    # lock (~1s on CPU, tens of seconds on TPU), stalling forwarded
+    # batches past their timeout and inviting client-retry double-apply —
+    # the same rationale as the engine's _warmup for decide/inject.
+    warm = getattr(engine, "warm_store_path", None)
+    if warm is not None:
+        warm()

@@ -18,20 +18,25 @@ from gubernator_tpu.api.types import (
     SECOND,
 )
 from gubernator_tpu.models.oracle import OracleEngine
-from gubernator_tpu.ops import SlotTable, decide
 from gubernator_tpu.ops.encode import encode_batch
+from gubernator_tpu.ops.kernels import get_kernels
 from gubernator_tpu.utils.gregorian import GREGORIAN_MINUTES
 
 NOW = 1_753_700_000_000
 NUM_GROUPS = 512
 WAYS = 8
 
+# Every golden/fuzz case runs against BOTH table layouts (see
+# ops/kernels.py); they must be bit-exact twins of the oracle.
+LAYOUTS = ["wide", "packed", "fused"]
+
 
 class KernelHarness:
     """Single-request-per-call harness around the jitted kernel."""
 
-    def __init__(self, num_groups=NUM_GROUPS, ways=WAYS, batch=1):
-        self.table = SlotTable.create(num_groups, ways)
+    def __init__(self, num_groups=NUM_GROUPS, ways=WAYS, batch=1, layout="wide"):
+        self.K = get_kernels(layout)
+        self.table = self.K.create(num_groups, ways)
         self.num_groups = num_groups
         self.ways = ways
         self.batch = batch
@@ -41,7 +46,7 @@ class KernelHarness:
 
         rc = copy.replace(r) if hasattr(copy, "replace") else r
         b = encode_batch([rc], now_ms, self.num_groups, self.batch)
-        self.table, out = decide(self.table, b, now_ms, ways=self.ways)
+        self.table, out = self.K.decide(self.table, b, now_ms, self.ways, False)
         return (
             int(out.status[0]),
             int(out.limit[0]),
@@ -50,7 +55,7 @@ class KernelHarness:
         )
 
 
-def check_seq(seq, num_groups=NUM_GROUPS):
+def check_seq(seq, num_groups=NUM_GROUPS, layout="wide"):
     """Run (req, now) pairs through oracle and kernel; compare each step.
 
     The kernel side runs the whole sequence in ONE dispatch via decide_scan
@@ -61,7 +66,7 @@ def check_seq(seq, num_groups=NUM_GROUPS):
 
     import jax
 
-    from gubernator_tpu.ops import decide_scan
+    K = get_kernels(layout)
 
     oracle = OracleEngine()
     wants = []
@@ -76,8 +81,8 @@ def check_seq(seq, num_groups=NUM_GROUPS):
     ]
     stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
     nows = np.array([now for _, now in seq], dtype=np.int64)
-    table = SlotTable.create(num_groups, WAYS)
-    _, outs = decide_scan(table, stacked, nows, ways=WAYS)
+    table = K.create(num_groups, WAYS)
+    _, outs = K.decide_scan(table, stacked, nows, WAYS, False)
 
     for i, (r, _) in enumerate(seq):
         got = (
@@ -89,16 +94,18 @@ def check_seq(seq, num_groups=NUM_GROUPS):
         assert got == wants[i], f"step {i}: {r} got={got} want={wants[i]}"
 
 
-def test_kernel_token_basic():
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_kernel_token_basic(layout):
     r = lambda **kw: RateLimitReq(  # noqa: E731
         name="t", unique_key="k", algorithm=Algorithm.TOKEN_BUCKET,
         duration=5, limit=2, hits=1, **kw,
     )
     seq = [(r(), NOW), (r(), NOW), (r(), NOW + 100)]
-    check_seq(seq)
+    check_seq(seq, layout=layout)
 
 
-def test_kernel_leaky_table():
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_kernel_leaky_table(layout):
     r = lambda h: RateLimitReq(  # noqa: E731
         name="l", unique_key="k", algorithm=Algorithm.LEAKY_BUCKET,
         duration=30 * SECOND, limit=10, hits=h,
@@ -110,10 +117,11 @@ def test_kernel_leaky_table():
                      (10, 29_000), (9, 3000), (1, 1000)]:
         seq.append((r(h), now))
         now += sleep
-    check_seq(seq)
+    check_seq(seq, layout=layout)
 
 
-def test_kernel_behaviors():
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_kernel_behaviors(layout):
     def mk(**kw):
         kw.setdefault("duration", 30_000)
         kw.setdefault("limit", 10)
@@ -133,10 +141,11 @@ def test_kernel_behaviors():
         # duration change + renewal
         (mk(hits=1, limit=20, duration=10), NOW + 40_000),
     ]
-    check_seq(seq)
+    check_seq(seq, layout=layout)
 
 
-def test_kernel_gregorian():
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_kernel_gregorian(layout):
     mk = lambda **kw: RateLimitReq(  # noqa: E731
         name="g", unique_key="k",
         behavior=Behavior.DURATION_IS_GREGORIAN,
@@ -150,11 +159,12 @@ def test_kernel_gregorian():
         (mk(hits=58), start + 2000),
         (mk(hits=0), start + 61_000),
     ]
-    check_seq(seq)
+    check_seq(seq, layout=layout)
 
 
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
-def test_kernel_fuzz(seed):
+def test_kernel_fuzz(seed, layout):
     rng = random.Random(seed)
     keys = [f"acct:{i}" for i in range(25)]
     names = ["rl_a", "rl_b"]
@@ -183,14 +193,15 @@ def test_kernel_fuzz(seed):
         )
         seq.append((r, now))
         now += rng.choice([0, 0, 1, 7, 50, 500, 3000, 61_000])
-    check_seq(seq)
+    check_seq(seq, layout=layout)
 
 
 GREGORIAN_HOURS_SAFE = 1  # GREGORIAN_HOURS
 
 
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("seed", [100, 104])
-def test_kernel_fuzz_adversarial(seed):
+def test_kernel_fuzz_adversarial(seed, layout):
     """Extreme domain (caught an oracle/kernel int64-wrap divergence in
     round 1): 2^40 durations, +/-2^30 hits, 2^31-1 limits, huge bursts."""
     rng = random.Random(seed)
@@ -226,14 +237,15 @@ def test_kernel_fuzz_adversarial(seed):
             )
         )
         now += rng.choice([0, 1, 50, 3000, 61_000, 10**7])
-    check_seq(seq)
+    check_seq(seq, layout=layout)
 
 
-def test_kernel_batch_parallel_lanes():
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_kernel_batch_parallel_lanes(layout):
     """Multiple distinct-group keys decided in one batched call must match
     per-key sequential oracle results."""
     oracle = OracleEngine()
-    kern = KernelHarness(batch=16)
+    kern = KernelHarness(batch=16, layout=layout)
     reqs = [
         RateLimitReq(
             name="batch", unique_key=f"k{i}", algorithm=Algorithm.TOKEN_BUCKET,
@@ -252,7 +264,7 @@ def test_kernel_batch_parallel_lanes():
     import dataclasses
 
     b = encode_batch([dataclasses.replace(r) for r in reqs], NOW, NUM_GROUPS, 16)
-    kern.table, out = decide(kern.table, b, NOW, ways=WAYS)
+    kern.table, out = kern.K.decide(kern.table, b, NOW, WAYS, False)
     for i, r in enumerate(reqs):
         want = oracle.decide(dataclasses.replace(r), NOW)
         got = (int(out.status[i]), int(out.limit[i]), int(out.remaining[i]), int(out.reset_time[i]))
@@ -261,10 +273,11 @@ def test_kernel_batch_parallel_lanes():
     assert int(out.limit[15]) == 0
 
 
-def test_kernel_eviction_lru():
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_kernel_eviction_lru(layout):
     """Group overflow evicts the least-recently-used way
     (reference lrucache.go:138-161 policy, per group)."""
-    kern = KernelHarness(num_groups=1, ways=2, batch=1)
+    kern = KernelHarness(num_groups=1, ways=2, batch=1, layout=layout)
     mk = lambda k, h=1: RateLimitReq(  # noqa: E731
         name="e", unique_key=k, duration=60_000, limit=10, hits=h,
     )
