@@ -71,12 +71,10 @@ def _packed():
         layout="packed",
         create=_p.PackedTable.create,
         decide=lambda table, batch, now, ways, with_store=False: _p.decide_packed(
-            table, batch, now, ways=ways, with_store=with_store
+            table, batch, now, ways=ways
         ),
         decide_scan=lambda table, batches, nows, ways, with_store=False: (
-            _p.decide_scan_packed(
-                table, batches, nows, ways=ways, with_store=with_store
-            )
+            _p.decide_scan_packed(table, batches, nows, ways=ways)
         ),
         inject=lambda table, items, now, ways: _p.inject_packed(
             table, items, now, ways=ways

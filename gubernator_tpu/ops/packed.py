@@ -17,10 +17,10 @@ gathers x 24 bytes per way:
   free for the state phase.
 - `expire_at` (int64): full epoch-ms expiry — no epoch-rebase machinery,
   no precision loss for Gregorian-year windows.
-- `invalid_at` is gathered ONLY when a Store is attached (static
-  `with_store` kernel variant): the store's re-fetch hint
-  (reference cache.go:35-40) is meaningless without one. Store-less
-  kernels never read or write the column.
+- `invalid_at` (the store's re-fetch hint, reference cache.go:35-40) is
+  always consulted and maintained, exactly like the wide and fused
+  kernels — a snapshot taken on a store-attached daemon must decide
+  identically on every layout.
 
 Cold (per-lane, not per-way) columns: limit/burst narrow to int32 (the
 2^31-1 count clamp is already the documented encode contract,
@@ -152,10 +152,8 @@ def unpack_table(packed: PackedTable) -> SlotTable:
     )
 
 
-def _choose_slot_packed(
-    table: PackedTable, batch: RequestBatch, now, ways: int, with_store: bool
-):
-    """3-gather probe (4 with a store): key_lo + meta + expire_at per way;
+def _choose_slot_packed(table: PackedTable, batch: RequestBatch, now, ways: int):
+    """4-gather probe: key_lo + meta + expire_at + invalid_at per way;
     key_hi verified at the chosen way only. Same insertion priority as the
     wide kernel: matched-expired > empty > expired > LRU."""
     grp_base = batch.group.astype(I64) * ways
@@ -167,13 +165,10 @@ def _choose_slot_packed(
     w_used = (w_meta & META_USED) != 0
     w_lru = w_meta >> META_LRU_SHIFT
 
-    if with_store:
-        w_invalid = table.invalid_at[way_ix]
-        w_expired = w_used & (
-            (w_expire < now) | ((w_invalid != 0) & (w_invalid < now))
-        )
-    else:
-        w_expired = w_used & (w_expire < now)
+    w_invalid = table.invalid_at[way_ix]
+    w_expired = w_used & (
+        (w_expire < now) | ((w_invalid != 0) & (w_invalid < now))
+    )
 
     lo_match = w_used & (w_key_lo == batch.key_lo[:, None])
     live_lo = lo_match & ~w_expired
@@ -218,12 +213,10 @@ def _choose_slot_packed(
     return slot, exists, evicts_live, evicted_hi, evicted_lo, w_state
 
 
-def _decide_packed_impl(
-    table: PackedTable, batch: RequestBatch, now, *, ways: int, with_store: bool
-):
+def _decide_packed_impl(table: PackedTable, batch: RequestBatch, now, *, ways: int):
     now = jnp.asarray(now, dtype=I64)
     slot, exists, evicts_live, evicted_hi, evicted_lo, w_state = (
-        _choose_slot_packed(table, batch, now, ways, with_store)
+        _choose_slot_packed(table, batch, now, ways)
     )
 
     # State phase: per-lane gathers of the cold columns; algo/status come
@@ -238,9 +231,8 @@ def _decide_packed_impl(
         stamp=table.stamp[slot],
         expire_at=w_state["expire"],
         burst=table.burst[slot].astype(I64),
+        invalid_at=table.invalid_at[slot],
     )
-    if with_store:
-        st["invalid_at"] = table.invalid_at[slot]
     for k in st:
         st[k] = jnp.where(exists, st[k], jnp.zeros_like(st[k]))
 
@@ -288,18 +280,12 @@ def _decide_packed_impl(
         stamp=upd(table.stamp, new_state["stamp"]),
         burst=upd(table.burst, new_state["burst"].astype(jnp.int32)),
     )
-    if with_store:
-        kwargs["invalid_at"] = upd(
-            table.invalid_at,
-            jnp.where(
-                exists & ~freed, st["invalid_at"], jnp.zeros_like(batch.key_hi)
-            ),
-        )
-    else:
-        # Store-less kernels never touch the column (stale marks are
-        # harmless until a store attaches, and the with_store probe's
-        # insert path self-heals them).
-        kwargs["invalid_at"] = table.invalid_at
+    kwargs["invalid_at"] = upd(
+        table.invalid_at,
+        jnp.where(
+            exists & ~freed, st["invalid_at"], jnp.zeros_like(batch.key_hi)
+        ),
+    )
     new_table = PackedTable(**kwargs)
 
     act = batch.active
@@ -320,31 +306,19 @@ def _decide_packed_impl(
     return new_table, out
 
 
-@functools.partial(
-    jax.jit, static_argnames=("ways", "with_store"), donate_argnums=(0,)
-)
-def decide_packed(
-    table: PackedTable, batch: RequestBatch, now, ways: int = 8,
-    with_store: bool = False,
-):
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def decide_packed(table: PackedTable, batch: RequestBatch, now, ways: int = 8):
     """Jitted packed-layout decide step with donated table buffers."""
-    return _decide_packed_impl(table, batch, now, ways=ways, with_store=with_store)
+    return _decide_packed_impl(table, batch, now, ways=ways)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("ways", "with_store"), donate_argnums=(0,)
-)
-def decide_scan_packed(
-    table: PackedTable, batches: RequestBatch, nows, ways: int = 8,
-    with_store: bool = False,
-):
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def decide_scan_packed(table: PackedTable, batches: RequestBatch, nows, ways: int = 8):
     """Scan twin of ops.decide.decide_scan for the packed layout."""
 
     def step(tbl, xs):
         b, now = xs
-        tbl, out = _decide_packed_impl(
-            tbl, b, now, ways=ways, with_store=with_store
-        )
+        tbl, out = _decide_packed_impl(tbl, b, now, ways=ways)
         return tbl, out
 
     return jax.lax.scan(step, table, (batches, nows))
@@ -415,7 +389,7 @@ def _inject_packed_impl(table: PackedTable, items, now, ways: int):
         active=items.active,
     )
     slot, exists, _ev, evicted_hi, evicted_lo, _w = _choose_slot_packed(
-        table, batch_like, now, ways, with_store=True
+        table, batch_like, now, ways
     )
     n = table.num_slots
     idx = jnp.where(items.active, slot, n)

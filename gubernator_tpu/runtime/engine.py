@@ -45,6 +45,13 @@ from gubernator_tpu.ops.kernels import get_kernels
 from gubernator_tpu.utils import clock as _clock
 
 
+class TableCommittedError(RuntimeError):
+    """A device/store failure occurred AFTER waves of this flush already
+    committed hits to a still-valid table. Callers must NOT silently
+    retry through another path (that would re-apply the committed hits);
+    surface the failure to the client instead."""
+
+
 @dataclasses.dataclass
 class EngineConfig:
     """Sizing and batching knobs (defaults mirror the reference's
@@ -553,51 +560,16 @@ class DeviceEngine(EngineBase):
         # write-behind persists the value the caller observed even if a
         # later wave displaces the slot (OnChange runs within the request,
         # algorithms.go:149-153).
+        wave_lane_req: List[Dict[int, tuple]] = [dict() for _ in waves]
         if self.store is not None:
-            wave_lane_req: List[Dict[int, tuple]] = [dict() for _ in waves]
             for i, place in enumerate(placements):
                 if isinstance(place, tuple):
                     wave_lane_req[place[0]][place[1]] = (
                         items[i][0], place[2], place[3],
                     )
-        outs = []
-        wave_rows_host: List[object] = []  # materialized post-decide rows
-        served: Dict[Tuple[int, int], Tuple[int, int]] = {}  # key->(w,lane)
-        events: List[Tuple[str, Tuple[int, int]]] = []  # ('d'|'i', key)
-        with self._lock:
-            table = self.table
-            try:
-                for w, wb in enumerate(waves):
-                    if self.store is not None:
-                        table = self._wave_readthrough(
-                            table, wb, wave_lane_req[w], now,
-                            prefetched, served, wave_rows_host, events,
-                        )
-                    table, out = self.K.decide(
-                        table, wb, now, cfg.ways, self.store is not None
-                    )
-                    outs.append(out)
-                    if self.store is not None:
-                        rows = self.K.gather_rows(table, out.slot)
-                        wave_rows_host.append(jax.tree.map(np.asarray, rows))
-                        ehi = np.asarray(out.evicted_hi)
-                        elo = np.asarray(out.evicted_lo)
-                        for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
-                            events.append(("d", (int(ehi[j]), int(elo[j]))))
-                        for lane, (req, hi, lo) in wave_lane_req[w].items():
-                            served[(hi, lo)] = (w, lane)
-                            events.append(("i", (hi, lo)))
-                self.table = table
-            except Exception:
-                # Keep the last valid intermediate state if we still hold
-                # it; a failed jitted call may have consumed the donated
-                # table buffers, in which case recovery rebuilds an empty
-                # table so the engine keeps serving (counter loss on
-                # failure matches the reference's accepted cache-loss-on-
-                # restart semantics, docs/architecture.md:5-11).
-                self.table = table
-                self._recover_table_locked()
-                raise
+        outs, wave_rows_host, events = self._execute_waves(
+            waves, wave_lane_req, now, prefetched
+        )
 
         # Materialize results (one host sync per wave) and demux.
         host = [
@@ -614,22 +586,8 @@ class DeviceEngine(EngineBase):
             for o in outs
         ]
 
-        # Key-dictionary hygiene (store path): a key whose LAST flush event
-        # was a displacement is gone from the table — drop its string so
-        # its next request prefetches store state OUTSIDE the device lock.
-        # A key re-inserted after its displacement (read-through or a later
-        # wave) keeps its entry; Loader snapshots need strings for every
-        # live key. Read-through correctness never depends on this — the
-        # per-wave probe is ground truth.
-        if keep and events:
-            last: Dict[Tuple[int, int], str] = {}
-            for ev, k in events:
-                last[k] = ev
-            dead = [k for k, ev in last.items() if ev == "d"]
-            if dead:
-                with self._keys_lock:
-                    for k in dead:
-                        self._key_strings.pop(k, None)
+        if keep:
+            self._drop_displaced_strings(events)
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
         self.metrics.observe(
             tot[0], tot[1], tot[2], tot[3], len(waves),
@@ -689,8 +647,11 @@ class DeviceEngine(EngineBase):
         anywhere — hashing, wave/lane assignment, encoding, and response
         demux are all batch array ops. Returns (status, limit, remaining,
         reset_time) int arrays in request order, or None when this batch
-        needs the object path (a Store is attached, wave/lane bounds are
-        exceeded, or the batch is empty).
+        needs the object path (wave/lane bounds are exceeded, or the
+        batch is empty). A Store does NOT force a fallback: the store
+        path runs the object path's per-wave sequence here (probe ->
+        read-through -> decide -> write-behind) with request objects
+        built only for actual miss lanes.
 
         Semantics mirror encode_one/encode_rows + the pump's wave
         assembler exactly (equivalence is fuzz-tested against the object
@@ -711,7 +672,8 @@ class DeviceEngine(EngineBase):
         from gubernator_tpu.models.bucket import MAX_COUNT, MAX_DURATION_MS
 
         cfg = self.cfg
-        if cols.n == 0 or self.store is not None:
+        store = self.store
+        if cols.n == 0:
             return None
         t_start = time.perf_counter()
         if now is None:
@@ -723,12 +685,21 @@ class DeviceEngine(EngineBase):
             )
         else:
             hi, lo, grp = hashes
+        # Key strings resolve through the ORIGINAL columns (select drops
+        # key_offsets); only the store path pays for string decodes.
+        orig_cols, sel_map = cols, None
         if select is not None:
             if len(select) == 0:
                 return None
             hi, lo, grp = hi[select], lo[select], grp[select]
             cols = _select_columns(cols, select)
+            sel_map = select
         n = cols.n
+
+        def key_str(j: int) -> str:
+            return orig_cols.key_string(
+                int(sel_map[j]) if sel_map is not None else j
+            )
 
         # Wave = occurrence rank within the group (stable sort keeps
         # arrival order, preserving per-key sequencing); lane = arrival
@@ -756,9 +727,13 @@ class DeviceEngine(EngineBase):
         # are used (batch_size always is; smaller buckets appear as the
         # background warmer finishes compiling them).
         B = cfg.batch_size
-        for s in self._warm_shapes:  # immutable snapshot; warmer swaps atomically
-            if s > max_lane and s < B:
-                B = s
+        if store is None:
+            # With a store, only batch_size-wide store-path kernels are
+            # warmed (warm_store_path); narrower buckets would cold-
+            # compile probe/inject/gather under the serving lock.
+            for s in self._warm_shapes:  # immutable snapshot; warmer swaps atomically
+                if s > max_lane and s < B:
+                    B = s
 
         # Encode columns (the encode_one clamps, vectorized).
         hits = np.clip(cols.hits, -MAX_COUNT, MAX_COUNT)
@@ -811,24 +786,86 @@ class DeviceEngine(EngineBase):
         wb.created_at[ix] = created
         wb.active[ix] = True
 
-        outs = []
-        with self._lock:
-            table = self.table
-            try:
-                for w in range(W):
-                    one = jax.tree.map(lambda a: a[w], wb)
-                    table, out = self.K.decide(table, one, now, cfg.ways, False)
-                    outs.append(out)
-                self.table = table
-            except Exception:
-                self.table = table
-                self._recover_table_locked()
-                raise
+        # Store path pre-work (the columnar twin of _process's read-through
+        # plumbing): request objects are built LAZILY, only for miss lanes;
+        # key strings are decoded once for the dictionary + write-behind;
+        # never-seen keys prefetch OUTSIDE the device lock.
+        prefetched: Dict[Tuple[int, int], object] = {}
+        strs = None
+        if store is not None:
+            from gubernator_tpu import wire as _wire
+
+            if sel_map is None:
+                strs = cols.key_strings_all()
+            else:
+                strs = [key_str(j) for j in range(n)]
+
+            def req_of(j: int) -> RateLimitReq:
+                i = int(sel_map[j]) if sel_map is not None else j
+                return _wire.req_from_columns(orig_cols, i)
+
+            # One-shot tolist conversions: per-item numpy scalar boxing
+            # (int(hi[j]) etc.) dominated this path's host cost.
+            hi_l, lo_l = hi.tolist(), lo.tolist()
+            wave_l, lane_l = wave.tolist(), lane.tolist()
+            keys_l = list(zip(hi_l, lo_l))
+            keep = cfg.keep_key_strings
+            if keep:
+                # Prefetch never-seen keys OUTSIDE the lock (the dict is
+                # a superset of table residency, as in _process). Without
+                # the dictionary there is no never-seen predicate: rely
+                # on the in-lock per-wave probe alone rather than issuing
+                # a blocking store.get for every key of every flush.
+                need = []
+                seen = set()
+                with self._keys_lock:
+                    for j, k in enumerate(keys_l):
+                        if k not in self._key_strings and k not in seen:
+                            seen.add(k)
+                            need.append((j, k))
+                    self._key_strings.update(zip(keys_l, strs))
+                for j, k in need:
+                    try:
+                        snap = store.get(req_of(j))
+                    except Exception:
+                        snap = None  # store outage == cache miss
+                    if snap is not None:
+                        prefetched[k] = snap
+                self._maybe_prune_key_strings()
+            # item indices per wave (for the lazy lane_req dicts)
+            by_wave = [[] for _ in range(W)]
+            for j, w_ in enumerate(wave_l):
+                by_wave[w_].append(j)
+
+        wave_slices = [jax.tree.map(lambda a, w=w: a[w], wb) for w in range(W)]
+        lane_reqs: List[Dict[int, tuple]] = [{} for _ in range(W)]
+        resolver = None
+        if store is not None:
+            resolver = req_of
+            for w in range(W):
+                lane_reqs[w] = {
+                    lane_l[j]: (j, hi_l[j], lo_l[j]) for j in by_wave[w]
+                }
+        outs, wave_rows_host, events = self._execute_waves(
+            wave_slices, lane_reqs, now, prefetched, req_resolver=resolver
+        )
 
         status = np.stack([np.asarray(o.status) for o in outs])
         r_limit = np.stack([np.asarray(o.limit) for o in outs])
         remaining = np.stack([np.asarray(o.remaining) for o in outs])
         reset_time = np.stack([np.asarray(o.reset_time) for o in outs])
+
+        if store is not None:
+            # Write-behind from the per-wave gathered rows (last-op-wins
+            # per key, request order) + key-dictionary hygiene — same
+            # semantics as the object path's flush.
+            self._store_write_behind_core(
+                list(zip(strs, wave_l, lane_l, hi_l, lo_l)),
+                outs, wave_rows_host,
+            )
+            if cfg.keep_key_strings:
+                self._drop_displaced_strings(events)
+
         tot_hits = sum(int(o.hits) for o in outs)
         tot_miss = sum(int(o.misses) for o in outs)
         tot_evic = sum(int(o.unexpired_evictions) for o in outs)
@@ -838,6 +875,86 @@ class DeviceEngine(EngineBase):
             time.perf_counter() - t_start,
         )
         return (status[ix], r_limit[ix], remaining[ix], reset_time[ix])
+
+    def _execute_waves(
+        self, waves, lane_reqs, now, prefetched, req_resolver=None
+    ):
+        """Run decide over scatter-disjoint waves under the device lock,
+        with the store's per-wave sequence when a Store is attached:
+        probe (cache lookup) -> Store.Get for misses -> insert -> decide
+        -> gather touched rows (reference algorithms.go:45-51, 149-153 —
+        the gathered rows let write-behind persist the value the caller
+        observed even if a later wave displaces the slot).
+
+        lane_reqs: per-wave {lane: (req_or_index, key_hi, key_lo)}; with
+        req_resolver set, the first element is an index resolved lazily
+        (columnar path). Returns (outs, wave_rows_host, events).
+
+        On failure: keeps the last valid intermediate state if still
+        held; a failed jitted call may have consumed the donated table
+        buffers, in which case recovery rebuilds an empty table so the
+        engine keeps serving (counter loss on failure matches the
+        reference's accepted cache-loss-on-restart semantics,
+        docs/architecture.md:5-11). If waves already committed to a
+        SURVIVING table, raises TableCommittedError so no caller retries
+        the batch through another path (double-apply)."""
+        store = self.store
+        cfg = self.cfg
+        outs: List[object] = []
+        wave_rows_host: List[object] = []  # materialized post-decide rows
+        served: Dict[Tuple[int, int], Tuple[int, int]] = {}  # key->(w,lane)
+        events: List[Tuple[str, Tuple[int, int]]] = []  # ('d'|'i', key)
+        with self._lock:
+            table = self.table
+            try:
+                for w, wb in enumerate(waves):
+                    if store is not None:
+                        table = self._wave_readthrough(
+                            table, wb, lane_reqs[w], now,
+                            prefetched, served, wave_rows_host, events,
+                            req_resolver=req_resolver,
+                        )
+                    table, out = self.K.decide(
+                        table, wb, now, cfg.ways, store is not None
+                    )
+                    outs.append(out)
+                    if store is not None:
+                        rows = self.K.gather_rows(table, out.slot)
+                        wave_rows_host.append(jax.tree.map(np.asarray, rows))
+                        ehi = np.asarray(out.evicted_hi)
+                        elo = np.asarray(out.evicted_lo)
+                        for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
+                            events.append(("d", (int(ehi[j]), int(elo[j]))))
+                        for lane, entry in lane_reqs[w].items():
+                            served[(entry[1], entry[2])] = (w, lane)
+                            events.append(("i", (entry[1], entry[2])))
+                self.table = table
+            except Exception as e:
+                self.table = table
+                rebuilt = self._recover_table_locked()
+                if outs and not rebuilt:
+                    raise TableCommittedError(str(e)) from e
+                raise
+        return outs, wave_rows_host, events
+
+    def _drop_displaced_strings(self, events) -> None:
+        """Key-dictionary hygiene (store path): a key whose LAST flush
+        event was a displacement is gone from the table — drop its string
+        so its next request prefetches store state OUTSIDE the device
+        lock. A key re-inserted after its displacement (read-through or a
+        later wave) keeps its entry; Loader snapshots need strings for
+        every live key. Read-through correctness never depends on this —
+        the per-wave probe is ground truth."""
+        if not events:
+            return
+        last: Dict[Tuple[int, int], str] = {}
+        for ev, k in events:
+            last[k] = ev
+        dead = [k for k, ev in last.items() if ev == "d"]
+        if dead:
+            with self._keys_lock:
+                for k in dead:
+                    self._key_strings.pop(k, None)
 
     def _wave_readthrough(
         self,
@@ -849,6 +966,7 @@ class DeviceEngine(EngineBase):
         served: Dict,
         wave_rows_host: List,
         events: List,
+        req_resolver=None,
     ):
         """Reference miss path at wave granularity: probe the table for
         each lane's key; for actual misses, recover the freshest state and
@@ -875,6 +993,11 @@ class DeviceEngine(EngineBase):
         for lane, (req, hi, lo) in lane_req.items():
             if exists[lane]:
                 continue
+            if req_resolver is not None:
+                # Columnar path: lane_req carries item indices; request
+                # objects are built lazily, only for actual misses
+                # (steady state has none).
+                req = req_resolver(req)
             snap = None
             sv = served.get((hi, lo))
             if sv is not None:
@@ -924,42 +1047,96 @@ class DeviceEngine(EngineBase):
         return table
 
     def _store_write_behind(self, items, placements, outs, rows) -> None:
+        def seq():
+            for (req, _), place in zip(items, placements):
+                if place is None or place == "carry":
+                    continue
+                w, lane, hi, lo = place
+                yield req.hash_key(), w, lane, hi, lo
+
+        self._store_write_behind_core(seq(), outs, rows)
+
+    _WB_FIELDS = (
+        "used", "key_hi", "key_lo", "algo", "status", "limit", "duration",
+        "remaining", "stamp", "expire_at", "invalid_at", "burst",
+    )
+
+    def _store_write_behind_core(self, seq, outs, rows) -> None:
+        """seq yields (hash_key, wave, lane, hi, lo) in REQUEST order.
+
+        Rows were gathered per-wave from the intermediate tables (and
+        already materialized), so each lane sees exactly the state its
+        own decide produced even when a later wave in the same flush
+        displaced or freed the slot.
+        """
         from gubernator_tpu.store.store import ItemSnapshot
 
-        # Rows were gathered per-wave from the intermediate tables (and
-        # already materialized), so each lane sees exactly the state its
-        # own decide produced even when a later wave in the same flush
-        # displaced or freed the slot.
-        freed = [np.asarray(o.freed) for o in outs]
+        entries = list(seq)
+        if not entries:
+            return
+        # Vectorized row extraction: one advanced-index per field over the
+        # stacked (W, B) wave rows, then plain-list indexing per item —
+        # per-item numpy scalar boxing dominated this loop before.
+        w_arr = np.fromiter((e[1] for e in entries), np.int64, len(entries))
+        l_arr = np.fromiter((e[2] for e in entries), np.int64, len(entries))
+        v = {
+            f: np.stack([np.asarray(getattr(r, f)) for r in rows])[
+                w_arr, l_arr
+            ].tolist()
+            for f in self._WB_FIELDS
+        }
+        freed_v = np.stack([np.asarray(o.freed) for o in outs])[
+            w_arr, l_arr
+        ].tolist()
+
         # Per-key LAST op wins, in request order: a hit followed by a
         # same-flush RESET_REMAINING must end as a remove (not resurrect
         # the pre-reset snapshot via a late batched on_change), and a
         # RESET followed by a new hit must end as the new snapshot.
         ops: Dict[str, Optional[ItemSnapshot]] = {}
-        for (req, _), place in zip(items, placements):
-            if place is None or place == "carry":
-                continue
-            w, lane, hi, lo = place
-            r = rows[w]
-            key = req.hash_key()
+        for i, (key, w, lane, hi, lo) in enumerate(entries):
             # Only a token-bucket RESET_REMAINING free deletes the
             # persisted entry (reference algorithms.go:78-90); the
             # reference keeps Store entries across cache eviction and
             # restores them via Store.Get on the next cache miss.
-            if bool(freed[w][lane]):
+            if freed_v[i]:
                 ops[key] = None
                 continue
-            if not bool(r.used[lane]) or int(r.key_hi[lane]) != hi or int(r.key_lo[lane]) != lo:
+            if not v["used"][i] or v["key_hi"][i] != hi or v["key_lo"][i] != lo:
                 # Shouldn't happen with per-wave gathers; skip defensively
                 # without touching the persisted entry.
                 continue
-            ops[key] = self._snapshot_from_row(r, lane, key)
+            ops[key] = ItemSnapshot(
+                key=key,
+                algorithm=v["algo"][i],
+                status=v["status"][i],
+                limit=v["limit"][i],
+                duration=v["duration"][i],
+                remaining=v["remaining"][i],
+                stamp=v["stamp"][i],
+                expire_at=v["expire_at"][i],
+                invalid_at=v["invalid_at"][i],
+                burst=v["burst"][i],
+            )
         changes = [s for s in ops.values() if s is not None]
-        for key, s in ops.items():
-            if s is None:
-                self.store.remove(key)
-        if changes:
-            self.store.on_change(changes)
+        # Store failures here must NEVER propagate: write-behind runs
+        # AFTER the table commit, and the columnar edge's caller treats a
+        # check_columns exception as "safe to retry via the object path"
+        # — re-applying every already-committed hit. The reference's
+        # Store.OnChange has no error return either (store.go:49-65);
+        # durability degrades, serving does not.
+        try:
+            for key, s in ops.items():
+                if s is None:
+                    self.store.remove(key)
+            if changes:
+                self.store.on_change(changes)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "store write-behind failed (%d changes dropped)", len(changes)
+            )
 
     def _maybe_prune_key_strings(self) -> None:
         """Bound host memory: under key churn the hash->string dict keeps
@@ -981,10 +1158,12 @@ class DeviceEngine(EngineBase):
                 k: v for k, v in self._key_strings.items() if k in live
             }
 
-    def _recover_table_locked(self) -> None:
+    def _recover_table_locked(self) -> bool:
         """Called with the lock held after a failed device call: if the
         donated table buffers were consumed, rebuild an empty table so
-        subsequent requests serve instead of failing forever."""
+        subsequent requests serve instead of failing forever. Returns
+        True when the table was rebuilt (all counters lost — a fallback
+        replay is then safe, not a double-apply)."""
         try:
             deleted = getattr(self.table.key_hi, "is_deleted", lambda: False)()
         except Exception:
@@ -993,6 +1172,7 @@ class DeviceEngine(EngineBase):
             self.table = self.K.create(self.cfg.num_groups, self.cfg.ways)
             with self._keys_lock:
                 self._key_strings.clear()
+        return deleted
 
     # ---- direct state injection (AddCacheItem analog) ----------------------
 

@@ -90,15 +90,16 @@ class Daemon:
             metrics=metrics,
             force_global=conf.behaviors.force_global,
         )
-        # Columnar serving edge: eligible only without persistence plugins
-        # (the Store needs the object path's read-through/write-behind; a
-        # Loader needs the key-string dictionary complete for snapshots)
-        # and without force_global (every item would take the GLOBAL path).
+        # Columnar serving edge. A Store no longer disables it:
+        # check_columns runs the same per-wave probe -> read-through ->
+        # decide -> write-behind sequence as the object path (and records
+        # key strings). A Loader-only daemon keeps the object path so the
+        # key-string dictionary stays complete for snapshots without the
+        # columnar path paying O(n) string decodes; force_global sends
+        # every item down the GLOBAL path anyway.
         self.svc.fast_edge = (
-            conf.store is None
-            and conf.loader is None
-            and not conf.behaviors.force_global
-        )
+            conf.loader is None or conf.store is not None
+        ) and not conf.behaviors.force_global
 
         # gRPC server hosting both services (reference daemon.go:139-167)
         # with the reference's hardening: 1MB receive cap (daemon.go:122)

@@ -16,9 +16,14 @@ Fallback triggers:
 - a key this node does not own (peer forwarding), checked with the
   vectorized ring mask — GetPeerRateLimits skips this check because
   forwarded items are owned by construction;
-- engine not eligible (Store attached, wave/lane overflow) — also a
-  daemon with a Loader keeps the object path so the key-string
-  dictionary stays complete for snapshots.
+- engine not eligible (wave/lane overflow); a daemon with a Loader but
+  no Store keeps the object path so the key-string dictionary stays
+  complete for snapshots without columnar string-decode overhead.
+
+A Store does NOT fall back: check_columns runs the object path's exact
+per-wave sequence (probe -> read-through -> decide -> write-behind,
+reference algorithms.go:45-51, 149-153) with request objects built only
+for actual miss lanes.
 """
 
 from __future__ import annotations
@@ -32,6 +37,12 @@ from gubernator_tpu.api.types import Behavior
 from gubernator_tpu.parallel import hash_ring
 
 MAX_BATCH_SIZE = 1000
+
+
+def _committed_error():
+    from gubernator_tpu.runtime.engine import TableCommittedError
+
+    return TableCommittedError
 
 _SLOW_BEHAVIOR = int(Behavior.GLOBAL) | int(Behavior.DURATION_IS_GREGORIAN)
 
@@ -92,11 +103,14 @@ def try_serve(svc, data: bytes, peer_call: bool):
             if not mask.all():
                 local = np.asarray(mask, dtype=bool)
     if local is None:
-        # NOTE: only check_columns is guarded — a failure BEFORE the
-        # table commits falls back safely; anything after the commit must
-        # fail LOUD (a silent fallback would re-apply every hit).
+        # NOTE: a failure BEFORE the table commits falls back safely;
+        # a failure AFTER waves committed to a surviving table raises
+        # TableCommittedError, which must propagate (a silent fallback
+        # would re-apply every committed hit).
         try:
             out = svc.engine.check_columns(cols)
+        except _committed_error():
+            raise
         except Exception:
             return None
         if out is None:
@@ -123,6 +137,8 @@ def try_serve(svc, data: bytes, peer_call: bool):
     )
     try:
         out = svc.engine.check_columns(cols, select=local_pos, hashes=hashes)
+    except _committed_error():
+        raise
     except Exception:
         return None
     if out is None:
@@ -136,22 +152,7 @@ def try_serve(svc, data: bytes, peer_call: bool):
 def _req_from_columns(cols, i: int):
     """RateLimitReq object for one (peer-owned) lane — the forwarding
     path needs objects; only the non-local fraction pays this cost."""
-    from gubernator_tpu.api.types import RateLimitReq
-
-    ks = cols.key_string(i)
-    nl = int(cols.name_lens[i])
-    created = int(cols.created_at[i])
-    return RateLimitReq(
-        name=ks[:nl],
-        unique_key=ks[nl + 1 :],
-        algorithm=int(cols.algo[i]),
-        behavior=int(cols.behavior[i]),
-        hits=int(cols.hits[i]),
-        limit=int(cols.limit[i]),
-        duration=int(cols.duration[i]),
-        burst=int(cols.burst[i]),
-        created_at=created if cols.has_created[i] and created != 0 else None,
-    )
+    return wire.req_from_columns(cols, i)
 
 
 def _varint(v: int) -> bytes:

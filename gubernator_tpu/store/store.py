@@ -69,10 +69,13 @@ class MemoryStore:
         self.change_calls = 0
 
     def on_change(self, items: List[ItemSnapshot]) -> None:
+        # Ownership of the snapshot objects transfers to the store (the
+        # engine builds them fresh per flush and never mutates them
+        # afterwards), so no defensive copy.
         with self.lock:
             self.change_calls += 1
             for it in items:
-                self.data[it.key] = dataclasses.replace(it)
+                self.data[it.key] = it
 
     def get(self, req: RateLimitReq) -> Optional[ItemSnapshot]:
         with self.lock:

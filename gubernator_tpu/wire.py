@@ -136,6 +136,52 @@ class RequestColumns:
         lo, hi = int(self.key_offsets[i]), int(self.key_offsets[i + 1])
         return bytes(self.key_data[lo:hi]).decode("utf-8", errors="replace")
 
+    def key_strings_all(self) -> list:
+        """All key strings in one pass (one bytes materialization + plain
+        bytes slicing — ~3x cheaper than per-item key_string calls)."""
+        raw = self.key_data.tobytes()
+        offs = self.key_offsets.tolist()
+        return [
+            raw[offs[i] : offs[i + 1]].decode("utf-8", errors="replace")
+            for i in range(self.n)
+        ]
+
+    def name_key_parts(self, i: int) -> tuple:
+        """(name, unique_key) for item i, split at the BYTE level.
+
+        name_lens counts BYTES (wirepath.cc); slicing the decoded string
+        by it would mis-split multi-byte UTF-8 names — so split the raw
+        bytes first, then decode each part."""
+        lo, hi = int(self.key_offsets[i]), int(self.key_offsets[i + 1])
+        raw = bytes(self.key_data[lo:hi])
+        nl = int(self.name_lens[i])
+        return (
+            raw[:nl].decode("utf-8", errors="replace"),
+            raw[nl + 1 :].decode("utf-8", errors="replace"),
+        )
+
+
+def req_from_columns(cols: "RequestColumns", i: int):
+    """RateLimitReq object for one lane — the single shared builder for
+    every consumer that needs objects from wire columns (forwarding path,
+    store read-through). Field semantics must match the protobuf object
+    path exactly."""
+    from gubernator_tpu.api.types import RateLimitReq
+
+    name, unique_key = cols.name_key_parts(i)
+    created = int(cols.created_at[i])
+    return RateLimitReq(
+        name=name,
+        unique_key=unique_key,
+        algorithm=int(cols.algo[i]),
+        behavior=int(cols.behavior[i]),
+        hits=int(cols.hits[i]),
+        limit=int(cols.limit[i]),
+        duration=int(cols.duration[i]),
+        burst=int(cols.burst[i]),
+        created_at=created if cols.has_created[i] and created != 0 else None,
+    )
+
 
 def parse_requests(data: bytes) -> Optional[RequestColumns]:
     lib = load()

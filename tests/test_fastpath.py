@@ -85,6 +85,199 @@ def test_columns_match_object_path(seed):
         eng_b.close()
 
 
+@pytest.mark.parametrize("seed", [7, 8])
+def test_columns_match_object_path_with_store(seed):
+    """Store-attached equivalence: columnar and object paths must produce
+    identical decisions AND identical persisted store state, including
+    across evictions (read-through) and RESET_REMAINING (remove)."""
+    from gubernator_tpu.store.store import MemoryStore, attach_store
+
+    rng = random.Random(seed)
+    clock = {"now": NOW}
+
+    def mk(store):
+        eng = DeviceEngine(
+            EngineConfig(num_groups=1 << 3, ways=2, batch_size=64,
+                         batch_wait_s=0.001),
+            now_fn=lambda: clock["now"],
+        )
+        attach_store(eng, store)
+        return eng
+
+    store_a, store_b = MemoryStore(), MemoryStore()
+    eng_a, eng_b = mk(store_a), mk(store_b)  # columnar vs object
+    keys = [f"st{i}" for i in range(24)]  # 24 keys on 16 slots: churn
+    try:
+        for step in range(50):
+            if rng.random() < 0.25:
+                clock["now"] += rng.choice([5, 700, 70_000])
+            batch = []
+            for _ in range(rng.randint(1, 24)):
+                behavior = 0
+                if rng.random() < 0.12:
+                    behavior |= Behavior.RESET_REMAINING
+                if rng.random() < 0.1:
+                    behavior |= Behavior.DRAIN_OVER_LIMIT
+                batch.append(
+                    RateLimitReq(
+                        name="st", unique_key=rng.choice(keys),
+                        algorithm=rng.choice(
+                            [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                        ),
+                        behavior=behavior,
+                        duration=rng.choice([100, 60_000]),
+                        limit=rng.choice([3, 10, 50]),
+                        hits=rng.choice([0, 1, 2, 5, 60]),
+                    )
+                )
+            cols = wire.parse_requests(to_proto_bytes(batch))
+            got = eng_a.check_columns(cols, now=clock["now"])
+            assert got is not None, f"store path fell back at step {step}"
+            status, limit, remaining, reset_time = got
+            want = eng_b.check_batch([dataclasses.replace(r) for r in batch])
+            for i, w in enumerate(want):
+                assert (
+                    int(status[i]), int(limit[i]), int(remaining[i]),
+                    int(reset_time[i]),
+                ) == (int(w.status), w.limit, w.remaining, w.reset_time), (
+                    f"seed {seed} step {step} item {i}: {batch[i]}"
+                )
+            assert store_a.data == store_b.data, (
+                f"seed {seed} step {step}: persisted state diverged"
+            )
+    finally:
+        eng_a.close()
+        eng_b.close()
+
+
+def test_columns_store_readthrough_after_restart():
+    """A fresh engine (cold table) must recover counters from the store
+    through the columnar path — the reference's read-through contract
+    (algorithms.go:45-51)."""
+    from gubernator_tpu.store.store import MemoryStore, attach_store
+
+    clock = {"now": NOW}
+    store = MemoryStore()
+
+    def spawn():
+        eng = DeviceEngine(
+            EngineConfig(num_groups=1 << 6, batch_size=64, batch_wait_s=0.001),
+            now_fn=lambda: clock["now"],
+        )
+        attach_store(eng, store)
+        return eng
+
+    reqs = [
+        RateLimitReq(name="rt", unique_key="persist", duration=600_000,
+                     limit=10, hits=3)
+    ]
+    eng = spawn()
+    try:
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        _, _, remaining, _ = eng.check_columns(cols, now=clock["now"])
+        assert int(remaining[0]) == 7
+    finally:
+        eng.close()
+    # "restart": new engine, empty table, same store
+    eng = spawn()
+    try:
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        _, _, remaining, _ = eng.check_columns(cols, now=clock["now"])
+        assert int(remaining[0]) == 4, "store state not recovered columnar"
+        assert store.get_calls >= 1
+    finally:
+        eng.close()
+
+
+def test_columns_store_write_behind_failure_never_raises():
+    """A store backend raising from on_change/remove AFTER the table
+    committed must not escape check_columns — the columnar caller treats
+    an exception as 'retry via the object path', which would double-apply
+    every committed hit. Durability degrades, serving does not."""
+    from gubernator_tpu.store.store import MemoryStore, attach_store
+
+    class FlakyStore(MemoryStore):
+        def __init__(self):
+            super().__init__()
+            self.fail = False
+
+        def on_change(self, items):
+            if self.fail:
+                raise RuntimeError("store outage")
+            super().on_change(items)
+
+    clock = {"now": NOW}
+    store = FlakyStore()
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 6, batch_size=64, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    attach_store(eng, store)
+    try:
+        reqs = [
+            RateLimitReq(name="fl", unique_key="k", duration=600_000,
+                         limit=10, hits=2)
+        ]
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        _, _, remaining, _ = eng.check_columns(cols, now=clock["now"])
+        assert int(remaining[0]) == 8
+        store.fail = True
+        out = eng.check_columns(
+            wire.parse_requests(to_proto_bytes(reqs)), now=clock["now"]
+        )
+        assert out is not None, "store outage must not kill the fast path"
+        assert int(out[2][0]) == 6  # counter advanced exactly once
+        store.fail = False
+        out = eng.check_columns(
+            wire.parse_requests(to_proto_bytes(reqs)), now=clock["now"]
+        )
+        assert int(out[2][0]) == 4
+    finally:
+        eng.close()
+
+
+def test_columns_multibyte_name_store_key():
+    """Multi-byte UTF-8 names: name_lens is a BYTE count; the store key
+    must still be the exact name+'_'+unique_key split (a char-count split
+    would persist under a wrong key and read-through would miss forever)."""
+    from gubernator_tpu.store.store import MemoryStore, attach_store
+
+    clock = {"now": NOW}
+    store = MemoryStore()
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 6, batch_size=64, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    attach_store(eng, store)
+    try:
+        reqs = [
+            RateLimitReq(name="café", unique_key="naïve_k", duration=600_000,
+                         limit=10, hits=3)
+        ]
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        _, _, remaining, _ = eng.check_columns(cols, now=clock["now"])
+        assert int(remaining[0]) == 7
+        assert "café_naïve_k" in store.data
+    finally:
+        eng.close()
+    # read-through on a fresh engine finds it
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 6, batch_size=64, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    attach_store(eng, store)
+    try:
+        reqs = [
+            RateLimitReq(name="café", unique_key="naïve_k", duration=600_000,
+                         limit=10, hits=1)
+        ]
+        cols = wire.parse_requests(to_proto_bytes(reqs))
+        _, _, remaining, _ = eng.check_columns(cols, now=clock["now"])
+        assert int(remaining[0]) == 6
+    finally:
+        eng.close()
+
+
 def test_columns_duplicate_key_sequencing():
     """Same key N times in one batch: strictly sequential consumption,
     and over-limit must not consume (the reference's serialized-worker
