@@ -225,6 +225,35 @@ class Metrics:
             "Failed GLOBAL broadcast pushes to peers.",
         )
 
+        # MULTI_REGION behavior (no reference analog — the reference's
+        # RegionPicker ships unimplemented, region_picker.go:19-103;
+        # these observe the DCN-tier async replication this framework
+        # adds on top: parallel/region_sync.py)
+        self.region_send_duration = Summary(
+            "gubernator_multiregion_send_duration",
+            "The timings of MULTI_REGION hit-delta sends to the home "
+            "region in seconds.",
+            registry=r,
+        )
+        self.region_broadcast_duration = Summary(
+            "gubernator_multiregion_broadcast_duration",
+            "The timings of MULTI_REGION authoritative broadcasts to "
+            "other regions in seconds.",
+            registry=r,
+        )
+        self.region_broadcast_counter = counter(
+            "gubernator_multiregion_broadcast_counter",
+            "The count of MULTI_REGION authoritative broadcasts.",
+        )
+        self.region_send_errors = counter(
+            "gubernator_multiregion_send_errors",
+            "Failed MULTI_REGION hit-delta sends to the home region.",
+        )
+        self.region_broadcast_errors = counter(
+            "gubernator_multiregion_broadcast_errors",
+            "Failed MULTI_REGION broadcast pushes to other regions.",
+        )
+
         # gRPC stats (reference grpc_stats.go:51-62)
         self.grpc_request_counts = counter(
             "gubernator_grpc_request_counts",

@@ -40,22 +40,111 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.service.config import BehaviorConfig
 
 
+class BatchQueue:
+    """One accumulate-and-flush leg (the reference's Interval-driven
+    flush policy, global.go:91-140): a keyed dict + an asyncio loop that
+    flushes when the dict reaches batch_limit or after sync_wait,
+    whichever first. Shared by GlobalManager (both legs) and
+    RegionManager (both legs) so the four loops cannot drift.
+
+    The OWNER mutates .items directly (merge semantics differ per leg)
+    and calls notify(); flush(take) receives the swapped-out dict. A
+    flush exception goes to on_error(take, exc) — the loop survives and
+    the callback decides whether to requeue."""
+
+    def __init__(self, wait_s, batch_limit, flush, on_error, on_len=None):
+        self.items: Dict[str, RateLimitReq] = {}
+        self.wait_s = wait_s
+        self.batch_limit = batch_limit
+        self.flush = flush
+        self.on_error = on_error
+        self.on_len = on_len or (lambda n: None)
+        self._wake = asyncio.Event()
+        self._full = asyncio.Event()
+        self._running = True
+        self.task = asyncio.ensure_future(self._loop())
+
+    def notify(self) -> None:
+        self.on_len(len(self.items))
+        if len(self.items) >= self.batch_limit:
+            self._full.set()
+        self._wake.set()
+
+    async def _loop(self) -> None:
+        while self._running:
+            if not self.items:
+                await self._wake.wait()
+                self._wake.clear()
+                if not self._running:
+                    break
+            if len(self.items) < self.batch_limit:
+                try:
+                    await asyncio.wait_for(self._full.wait(), self.wait_s)
+                except asyncio.TimeoutError:
+                    pass
+            self._full.clear()
+            take, self.items = self.items, {}
+            self.on_len(0)
+            if take:
+                try:
+                    await self.flush(take)
+                except Exception as e:
+                    # The loop must survive, but a failing flush is never
+                    # silent (reference logs every leg, global.go:180-186).
+                    self.on_error(take, e)
+
+    async def close(self) -> None:
+        self._running = False
+        self._wake.set()
+        self.task.cancel()
+        await asyncio.gather(self.task, return_exceptions=True)
+
+
 class GlobalManager:
     def __init__(self, svc, behaviors: BehaviorConfig, mode: str = "grpc"):
         self.svc = svc
         self.b = behaviors
         self.mode = mode
-        self.hits: Dict[str, RateLimitReq] = {}
-        self.updates: Dict[str, RateLimitReq] = {}
-        self._hits_wake = asyncio.Event()
-        self._hits_full = asyncio.Event()
-        self._upd_wake = asyncio.Event()
-        self._upd_full = asyncio.Event()
-        self._running = True
-        self._tasks = [
-            asyncio.ensure_future(self._hits_loop()),
-            asyncio.ensure_future(self._broadcast_loop()),
-        ]
+        m = svc.metrics
+
+        def hits_error(take, e):
+            log.exception("GLOBAL hit-update flush failed")
+            m.global_send_errors.inc()
+            from gubernator_tpu.utils import tracing
+
+            with tracing.span(
+                "globalManager.sendHits.error", level="ERROR", error=str(e)
+            ):
+                pass
+
+        def upd_error(take, e):
+            log.exception("GLOBAL broadcast flush failed")
+            m.global_broadcast_errors.inc()
+            from gubernator_tpu.utils import tracing
+
+            with tracing.span(
+                "globalManager.broadcast.error", level="ERROR", error=str(e)
+            ):
+                pass
+
+        self._hits_q = BatchQueue(
+            behaviors.global_sync_wait_s, behaviors.global_batch_limit,
+            self._send_hits, hits_error,
+            on_len=m.global_send_queue_length.set,
+        )
+        self._upd_q = BatchQueue(
+            behaviors.global_sync_wait_s, behaviors.global_batch_limit,
+            self._broadcast, upd_error,
+            on_len=m.global_queue_length.set,
+        )
+
+    @property
+    def hits(self) -> Dict[str, RateLimitReq]:
+        return self._hits_q.items
+
+    @property
+    def updates(self) -> Dict[str, RateLimitReq]:
+        return self._upd_q.items
 
     # -- queueing (reference global.go:74-84) --------------------------------
 
@@ -63,92 +152,24 @@ class GlobalManager:
         if r.hits == 0:
             return
         key = r.hash_key()
-        existing = self.hits.get(key)
+        existing = self._hits_q.items.get(key)
         if existing is not None:
             if has_behavior(r.behavior, Behavior.RESET_REMAINING):
                 existing.behavior |= Behavior.RESET_REMAINING
             existing.hits += r.hits
         else:
-            self.hits[key] = dataclasses.replace(r, metadata=dict(r.metadata))
-        self.svc.metrics.global_send_queue_length.set(len(self.hits))
-        if len(self.hits) >= self.b.global_batch_limit:
-            self._hits_full.set()
-        self._hits_wake.set()
+            self._hits_q.items[key] = dataclasses.replace(
+                r, metadata=dict(r.metadata)
+            )
+        self._hits_q.notify()
 
     def queue_update(self, r: RateLimitReq) -> None:
         if r.hits == 0:
             return
-        self.updates[r.hash_key()] = dataclasses.replace(r, metadata=dict(r.metadata))
-        self.svc.metrics.global_queue_length.set(len(self.updates))
-        if len(self.updates) >= self.b.global_batch_limit:
-            self._upd_full.set()
-        self._upd_wake.set()
-
-    # -- loops (reference global.go:91-140, 193-231) -------------------------
-
-    async def _hits_loop(self) -> None:
-        while self._running:
-            if not self.hits:
-                await self._hits_wake.wait()
-                self._hits_wake.clear()
-                if not self._running:
-                    break
-            if len(self.hits) < self.b.global_batch_limit:
-                try:
-                    await asyncio.wait_for(
-                        self._hits_full.wait(), self.b.global_sync_wait_s
-                    )
-                except asyncio.TimeoutError:
-                    pass
-            self._hits_full.clear()
-            take, self.hits = self.hits, {}
-            self.svc.metrics.global_send_queue_length.set(0)
-            if take:
-                try:
-                    await self._send_hits(take)
-                except Exception as e:
-                    # The loop must survive, but a failing flush is never
-                    # silent (reference logs every leg, global.go:180-186).
-                    log.exception("GLOBAL hit-update flush failed")
-                    self.svc.metrics.global_send_errors.inc()
-                    from gubernator_tpu.utils import tracing
-
-                    with tracing.span(
-                        "globalManager.sendHits.error", level="ERROR",
-                        error=str(e),
-                    ):
-                        pass
-
-    async def _broadcast_loop(self) -> None:
-        while self._running:
-            if not self.updates:
-                await self._upd_wake.wait()
-                self._upd_wake.clear()
-                if not self._running:
-                    break
-            if len(self.updates) < self.b.global_batch_limit:
-                try:
-                    await asyncio.wait_for(
-                        self._upd_full.wait(), self.b.global_sync_wait_s
-                    )
-                except asyncio.TimeoutError:
-                    pass
-            self._upd_full.clear()
-            take, self.updates = self.updates, {}
-            self.svc.metrics.global_queue_length.set(0)
-            if take:
-                try:
-                    await self._broadcast(take)
-                except Exception as e:
-                    log.exception("GLOBAL broadcast flush failed")
-                    self.svc.metrics.global_broadcast_errors.inc()
-                    from gubernator_tpu.utils import tracing
-
-                    with tracing.span(
-                        "globalManager.broadcast.error", level="ERROR",
-                        error=str(e),
-                    ):
-                        pass
+        self._upd_q.items[r.hash_key()] = dataclasses.replace(
+            r, metadata=dict(r.metadata)
+        )
+        self._upd_q.notify()
 
     # -- send hits to owners (reference global.go:144-187) -------------------
 
@@ -261,9 +282,5 @@ class GlobalManager:
             self.svc.metrics.broadcast_duration.observe(time.perf_counter() - t0)
 
     async def close(self) -> None:
-        self._running = False
-        self._hits_wake.set()
-        self._upd_wake.set()
-        for t in self._tasks:
-            t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self._hits_q.close()
+        await self._upd_q.close()

@@ -387,3 +387,9 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     # collective sync thread additionally runs the device tier within the
     # pod (runtime/ici_engine.py).
     svc.global_mgr = GlobalManager(svc, conf.behaviors, mode=global_mode)
+    # MULTI_REGION replication (no reference analog — region_picker.go
+    # ships unimplemented): idle until the region picker actually holds
+    # foreign regions, so single-region deployments pay nothing.
+    from gubernator_tpu.parallel.region_sync import RegionManager
+
+    svc.region_mgr = RegionManager(svc, conf.behaviors)

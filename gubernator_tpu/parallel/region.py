@@ -1,10 +1,11 @@
 """Multi-region peer picking (reference region_picker.go:19-103).
 
 Peers whose data_center differs from the local node's are routed into
-per-region rings; MULTI_REGION replication across those rings is a
+per-region rings. MULTI_REGION replication across those rings — a
 declared-but-unimplemented behavior in the reference (its multi-region
-test is an empty TODO, functional_test.go:1578-1586) and is likewise a
-forward seam here.
+test is an empty TODO, functional_test.go:1578-1586) — IS implemented
+here: see parallel/region_sync.py (rendezvous-hashed home region,
+async DCN hit-delta + authoritative broadcast legs).
 """
 
 from __future__ import annotations

@@ -1,0 +1,330 @@
+"""MULTI_REGION behavior: DCN-tier async replication across regions.
+
+The reference declares the MULTI_REGION behavior bit and ships the
+RegionPicker plumbing, but the replication itself is unimplemented (its
+multi-region test is an empty TODO — reference region_picker.go:19-103,
+functional_test.go:1578-1586, gubernator.proto:124-127). This module
+implements it, composing with the existing two-tier GLOBAL design:
+
+- **Home region** per key: rendezvous hashing (highest-random-weight via
+  fnv1a over "region|key") across the region set — stable under region
+  add/remove, no coordination needed.
+- **In-region serving is unchanged**: a MULTI_REGION request is answered
+  by the key's in-region owner at in-region latency (local ring routing,
+  forwarding, batching all as today). Cross-region traffic never sits on
+  the serving path.
+- **Hit-delta leg** (the reference globalManager's runAsyncHits shape,
+  global.go:91-187, lifted to region granularity): a non-home-region
+  owner aggregates MULTI_REGION hits per key and pushes them on the
+  global cadence to the key's owner peer IN THE HOME REGION over DCN
+  gRPC (GetPeerRateLimits with DRAIN_OVER_LIMIT forced, like relayed
+  GLOBAL hits, gubernator.go:510-512).
+- **Broadcast leg** (runBroadcasts shape, global.go:193-283): the
+  home-region owner re-reads each updated key with hits=0 and pushes the
+  authoritative state to the key's owner peer in EVERY OTHER region
+  (UpdatePeerGlobals); receivers inject it over their local counter.
+  Non-home regions therefore serve provisional local counts between
+  syncs and converge to the authoritative value each cadence — the same
+  consistency contract GLOBAL replicas have, one level up.
+
+Delta-then-overwrite is double-count-free: a region's local hits are
+provisional until the home region's broadcast (which already includes
+the pushed deltas) overwrites them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from gubernator_tpu.api.types import (
+    Behavior,
+    RateLimitReq,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+from gubernator_tpu.parallel.global_sync import BatchQueue
+from gubernator_tpu.parallel.hash_ring import fnv1a_64
+from gubernator_tpu.service.config import BehaviorConfig
+
+log = logging.getLogger("gubernator_tpu.multiregion")
+
+
+_M64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: fnv1a alone has weak avalanche, which skews
+    rendezvous scores for similar region names."""
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & _M64
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & _M64
+    return x ^ (x >> 31)
+
+
+def home_region(regions: List[str], key: str) -> Optional[str]:
+    """Rendezvous (HRW) hash: the region with the highest mixed fnv1a
+    score owns the key. Deterministic on every node given the same region
+    set; adding/removing a region only remaps keys homed there."""
+    best, best_score = None, -1
+    for r in regions:
+        score = _mix64(fnv1a_64(f"{r}|{key}"))
+        if score > best_score or (score == best_score and (best is None or r < best)):
+            best, best_score = r, score
+    return best
+
+
+class RegionManager:
+    """Async cross-region reconciliation loops (one per daemon).
+
+    Mirrors GlobalManager's queue/flush structure (global.go:43-291) but
+    routes across the RegionPicker's per-region rings instead of the
+    local ring."""
+
+    def __init__(self, svc, behaviors: BehaviorConfig):
+        self.svc = svc
+        self.b = behaviors
+
+        def hits_error(take, e):
+            log.exception("MULTI_REGION hit-delta flush failed")
+            self.svc.metrics.region_send_errors.inc()
+            self._requeue(take)
+
+        def upd_error(take, e):
+            log.exception("MULTI_REGION broadcast flush failed")
+            self.svc.metrics.region_broadcast_errors.inc()
+
+        self._hits_q = BatchQueue(
+            behaviors.global_sync_wait_s, behaviors.global_batch_limit,
+            self._send_hits, hits_error,
+        )
+        self._upd_q = BatchQueue(
+            behaviors.global_sync_wait_s, behaviors.global_batch_limit,
+            self._broadcast, upd_error,
+        )
+
+    @property
+    def hits(self) -> Dict[str, RateLimitReq]:
+        return self._hits_q.items
+
+    @property
+    def updates(self) -> Dict[str, RateLimitReq]:
+        return self._upd_q.items
+
+    def _requeue(self, take: Dict[str, RateLimitReq]) -> None:
+        """Failed deltas are re-aggregated, not dropped: unlike GLOBAL
+        (where the owner's own cache still holds the hits), a lost
+        cross-region delta permanently undercounts the home region AND
+        gets erased from this region by the next authoritative broadcast.
+        At most one aggregated entry per key, so the queue stays bounded
+        by key cardinality during a home-region outage."""
+        for r in take.values():
+            self.queue_hit(r)
+
+    # -- region topology -----------------------------------------------------
+
+    def _local_region(self) -> str:
+        return self.svc.local_info.data_center or ""
+
+    def _all_regions(self) -> List[str]:
+        regions = {self._local_region()}
+        picker = self.svc.picker
+        rp = getattr(picker, "region_picker", None)
+        if rp is not None:
+            regions.update(rp.pickers().keys())
+        return sorted(regions)
+
+    def home_of(self, key: str) -> str:
+        return home_region(self._all_regions(), key) or self._local_region()
+
+    def is_home(self, key: str) -> bool:
+        return self.home_of(key) == self._local_region()
+
+    # -- queueing (called by the serving path on the IN-REGION owner) --------
+
+    def observe(self, req: RateLimitReq) -> None:
+        """Called after the in-region owner applied a MULTI_REGION item:
+        home-region owners queue an authoritative broadcast; other
+        regions queue a hit-delta toward the home region."""
+        if len(self._all_regions()) < 2:
+            return  # single-region deployment: nothing to reconcile
+        if self.is_home(req.hash_key()):
+            self.queue_update(req)
+        else:
+            self.queue_hit(req)
+
+    @staticmethod
+    def _is_noop(r: RateLimitReq) -> bool:
+        # hits=0 reads queue nothing — EXCEPT a RESET_REMAINING, which
+        # mutates state and must reach the home region or the next
+        # authoritative broadcast would silently undo it.
+        return r.hits == 0 and not has_behavior(
+            r.behavior, Behavior.RESET_REMAINING
+        )
+
+    def queue_hit(self, r: RateLimitReq) -> None:
+        if self._is_noop(r):
+            return
+        key = r.hash_key()
+        existing = self._hits_q.items.get(key)
+        if existing is not None:
+            if has_behavior(r.behavior, Behavior.RESET_REMAINING):
+                existing.behavior |= Behavior.RESET_REMAINING
+            existing.hits += r.hits
+        else:
+            self._hits_q.items[key] = dataclasses.replace(
+                r, metadata=dict(r.metadata)
+            )
+        self._hits_q.notify()
+
+    def queue_update(self, r: RateLimitReq) -> None:
+        if self._is_noop(r):
+            return
+        self._upd_q.items[r.hash_key()] = dataclasses.replace(
+            r, metadata=dict(r.metadata)
+        )
+        self._upd_q.notify()
+
+    # -- hit-delta leg (global.go:144-187 shape, DCN targets) ----------------
+
+    def _region_peer(self, region: str, key: str):
+        rp = getattr(self.svc.picker, "region_picker", None)
+        if rp is None:
+            return None
+        return rp.get_by_region(region, key)
+
+    async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        t0 = time.perf_counter()
+        try:
+            by_peer: Dict[str, Tuple[object, List[RateLimitReq]]] = {}
+            for key, r in hits.items():
+                home = self.home_of(key)
+                if home == self._local_region():
+                    # Region set changed since queueing: we're home now.
+                    self.queue_update(r)
+                    continue
+                try:
+                    peer = self._region_peer(home, key)
+                except Exception:
+                    peer = None
+                if peer is None:
+                    # Home region unreachable (membership churn):
+                    # requeue — see _requeue for why dropping is unsafe.
+                    self.svc.metrics.region_send_errors.inc()
+                    self.queue_hit(r)
+                    continue
+                # Relayed cross-region deltas drain at the home region
+                # (the GLOBAL relay rule, gubernator.go:510-512); the
+                # receiver must not re-forward them in-region.
+                r2 = dataclasses.replace(r, metadata=dict(r.metadata))
+                r2.behavior |= Behavior.DRAIN_OVER_LIMIT
+                addr = peer.info.grpc_address
+                if addr in by_peer:
+                    by_peer[addr][1].append(r2)
+                else:
+                    by_peer[addr] = (peer, [r2])
+
+            sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
+
+            async def send(peer, reqs):
+                async with sem:
+                    try:
+                        await peer.get_peer_rate_limits(
+                            reqs, timeout=self.b.global_timeout_s
+                        )
+                    except Exception as e:
+                        log.warning(
+                            "MULTI_REGION hit-delta to %s failed: %s",
+                            peer.info.grpc_address, e,
+                        )
+                        self.svc.metrics.region_send_errors.inc()
+                        # DRAIN was forced for the relay; strip it before
+                        # re-aggregating so retries carry the original
+                        # behavior bits.
+                        for r in reqs:
+                            r.behavior &= ~Behavior.DRAIN_OVER_LIMIT
+                            self.queue_hit(r)
+
+            await asyncio.gather(*(send(p, rs) for p, rs in by_peer.values()))
+        finally:
+            self.svc.metrics.region_send_duration.observe(
+                time.perf_counter() - t0
+            )
+
+    # -- broadcast leg (global.go:234-283 shape, one peer per region) --------
+
+    async def _broadcast(self, updates: Dict[str, RateLimitReq]) -> None:
+        other_regions = [
+            r for r in self._all_regions() if r != self._local_region()
+        ]
+        if not other_regions:
+            return
+        t0 = time.perf_counter()
+        try:
+            futs = [
+                asyncio.wrap_future(
+                    self.svc.engine.check_async(
+                        dataclasses.replace(
+                            upd, hits=0, metadata=dict(upd.metadata)
+                        )
+                    )
+                )
+                for upd in updates.values()
+            ]
+            statuses = await asyncio.gather(*futs)
+            globals_ = [
+                UpdatePeerGlobal(
+                    key=key,
+                    status=status,
+                    algorithm=upd.algorithm,
+                    duration=upd.duration,
+                    created_at=upd.created_at or 0,
+                )
+                for (key, upd), status in zip(updates.items(), statuses)
+            ]
+
+            # Group by (region, target peer): the key's in-region owner
+            # receives the authoritative state for its region.
+            by_peer: Dict[Tuple[str, str], Tuple[object, List[UpdatePeerGlobal]]] = {}
+            for g in globals_:
+                for region in other_regions:
+                    try:
+                        peer = self._region_peer(region, g.key)
+                    except Exception:
+                        peer = None
+                    if peer is None:
+                        self.svc.metrics.region_broadcast_errors.inc()
+                        continue
+                    k = (region, peer.info.grpc_address)
+                    if k in by_peer:
+                        by_peer[k][1].append(g)
+                    else:
+                        by_peer[k] = (peer, [g])
+
+            sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
+
+            async def push(peer, gs):
+                async with sem:
+                    try:
+                        await peer.update_peer_globals(
+                            gs, timeout=self.b.global_timeout_s
+                        )
+                    except Exception as e:
+                        log.warning(
+                            "MULTI_REGION broadcast to %s failed: %s",
+                            peer.info.grpc_address, e,
+                        )
+                        self.svc.metrics.region_broadcast_errors.inc()
+
+            await asyncio.gather(*(push(p, gs) for p, gs in by_peer.values()))
+            self.svc.metrics.region_broadcast_counter.inc()
+        finally:
+            self.svc.metrics.region_broadcast_duration.observe(
+                time.perf_counter() - t0
+            )
+
+    async def close(self) -> None:
+        await self._hits_q.close()
+        await self._upd_q.close()

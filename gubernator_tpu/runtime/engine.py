@@ -382,6 +382,12 @@ class DeviceEngine(EngineBase):
         for B in shapes:
             if not self._running:
                 return
+            if self.store is not None:
+                # Store-path flushes pin the batch width to batch_size
+                # (check_columns skips bucket narrowing), so narrower
+                # decide shapes would be dead weight: seconds of compile
+                # plus a throwaway table per shape, used by nothing.
+                return
             try:
                 # Same device placement as the live table, or the compile
                 # lands in a different jit cache entry and the "warm"

@@ -283,6 +283,8 @@ class Daemon:
             self._pool.close()
         if self.svc is not None and self.svc.global_mgr is not None:
             await self.svc.global_mgr.close()
+        if self.svc is not None and getattr(self.svc, "region_mgr", None) is not None:
+            await self.svc.region_mgr.close()
         if self.svc is not None and self.svc.forwarder is not None:
             await self.svc.forwarder.close()
         if self._channel is not None:

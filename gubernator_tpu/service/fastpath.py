@@ -44,7 +44,13 @@ def _committed_error():
 
     return TableCommittedError
 
-_SLOW_BEHAVIOR = int(Behavior.GLOBAL) | int(Behavior.DURATION_IS_GREGORIAN)
+_SLOW_BEHAVIOR = (
+    int(Behavior.GLOBAL)
+    | int(Behavior.DURATION_IS_GREGORIAN)
+    # MULTI_REGION items need the object path's region_mgr.observe hook
+    # (cross-region delta/broadcast queueing).
+    | int(Behavior.MULTI_REGION)
+)
 
 _RING_VARIANT = {
     hash_ring.fnv1_64: "fnv1",

@@ -56,6 +56,7 @@ class V1Service:
         self.picker = None  # PeerPicker; None => every key is local
         self.forwarder = None  # PeerForwarder for non-owner items
         self.global_mgr = None  # GlobalManager for GLOBAL behavior
+        self.region_mgr = None  # RegionManager for MULTI_REGION behavior
         self._peers_lock = asyncio.Lock()
         # pre-resolved metric children (labels() lookups are hot-loop cost)
         m = self.metrics
@@ -123,6 +124,13 @@ class V1Service:
                     # Owner-side GLOBAL update broadcast queue
                     # (reference gubernator.go:603-606)
                     self.global_mgr.queue_update(req)
+                if self.region_mgr is not None and (
+                    req.behavior & int(Behavior.MULTI_REGION)
+                ):
+                    # In-region owner observed a MULTI_REGION item:
+                    # queue the cross-region leg (delta toward the home
+                    # region, or authoritative broadcast from it).
+                    self.region_mgr.observe(req)
             elif req.behavior & GLOBAL:
                 self._m_global.inc()
                 global_items.append((i, req, peer.info))
@@ -231,6 +239,13 @@ class V1Service:
                 req.created_at = self.now_fn()
             if self.global_mgr is not None and has_behavior(req.behavior, Behavior.GLOBAL):
                 self.global_mgr.queue_update(req)
+            if self.region_mgr is not None and has_behavior(
+                req.behavior, Behavior.MULTI_REGION
+            ):
+                # Both in-region forwards and cross-region deltas land
+                # here; the same rule covers both — the applying node is
+                # the in-region owner, so it queues the cross-region leg.
+                self.region_mgr.observe(req)
         try:
             return await asyncio.wrap_future(self.engine.check_bulk(list(reqs)))
         except Exception as e:
