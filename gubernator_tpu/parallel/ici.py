@@ -15,16 +15,23 @@ the "peers" are mesh devices:
   is rebroadcast to every replica via a second masked psum (the
   UpdatePeerGlobals leg).
 
-Geometry: ICI tables use ways=1 (slot = group = hash mod N) so a key
-occupies the SAME slot on every device and the merge is pure per-slot
-arithmetic — no cross-device key matching. The trade-off is direct-mapped
-collision behavior (colliding keys evict each other); provision ≥4x
-headroom. Cross-device safety holds anyway: every merge is key-checked,
-so a slot whose replicas hold different keys never mixes their counters.
+Geometry: replica tables are W-way set-associative (same policy as the
+local table, ops/decide.py _choose_slot), so a key may sit in DIFFERENT
+ways on different devices — each device's LRU/eviction history differs.
+The sync merge therefore key-matches deltas ACROSS the ways of a group:
+for each slot of the owner's layout, every replica contributes the
+pending of whichever of its own ways holds that key. ways=1 (slot ==
+group on every device, merge is pure per-slot arithmetic) remains
+available and is the degenerate case of the same code path. W-way
+placement removes the direct-mapped collision cliff: colliding keys
+spread over W ways instead of evicting each other between syncs.
+Cross-device safety holds at any W: every merge is key-checked, so a
+slot whose replicas hold different keys never mixes their counters.
 
-Consistency contract preserved (validated in tests/test_mesh.py): hits
-on a replica appear on every other replica after one sync; owner hits
-need no delta leg; over-limit relays drain.
+Consistency contract preserved (validated in tests/test_mesh.py and the
+differential fuzz tests/test_ici_fuzz.py): hits on a replica appear on
+every other replica after one sync; owner hits need no delta leg;
+over-limit relays drain.
 """
 
 from __future__ import annotations
@@ -49,18 +56,23 @@ class IciState(NamedTuple):
     """Per-device replica tables + pending hit deltas.
 
     Every SlotTable leaf is stacked (D, N) and sharded on the device
-    axis; `pending` is (D, N) int64 hit deltas awaiting the next sync.
+    axis; `pending` is (D, N) int64 hit deltas awaiting the next sync,
+    recorded at the slot where the key resides on THAT device.
     """
 
     table: SlotTable
     pending: jnp.ndarray
 
 
-def create_ici_state(mesh: Mesh, num_slots: int) -> IciState:
+def create_ici_state(mesh: Mesh, num_slots: int, ways: int = 1) -> IciState:
     n_dev = mesh.devices.size
-    assert num_slots % n_dev == 0, "num_slots must divide by mesh size"
+    assert num_slots % ways == 0, "num_slots must divide by ways"
+    num_groups = num_slots // ways
+    assert num_groups % n_dev == 0, (
+        "num_slots/ways (group count) must divide by mesh size"
+    )
     sharding = NamedSharding(mesh, P(AXIS))
-    table = SlotTable.create(num_slots, ways=1)
+    table = SlotTable.create(num_groups, ways)
     stacked = jax.tree.map(
         lambda x: jax.device_put(
             jnp.broadcast_to(x[None], (n_dev,) + x.shape), sharding
@@ -81,12 +93,14 @@ def _unsqueeze(tree):
     return jax.tree.map(lambda x: x[None], tree)
 
 
-def make_replica_decide(mesh: Mesh, num_slots: int):
+def make_replica_decide(mesh: Mesh, num_slots: int, ways: int = 1):
     """decide(state, batch, home, now): lane i is answered by device
     home[i]'s replica (the node the request arrived at); non-owned GLOBAL
-    hits are accumulated into that device's pending deltas."""
+    hits are accumulated into that device's pending deltas at the slot
+    decide() placed the key in (way choice is per-device)."""
     n_dev = mesh.devices.size
-    slots_per = num_slots // n_dev
+    num_groups = num_slots // ways
+    groups_per = num_groups // n_dev
 
     def local(state: IciState, batch: RequestBatch, home, now):
         dev = jax.lax.axis_index(AXIS).astype(I64)
@@ -95,28 +109,26 @@ def make_replica_decide(mesh: Mesh, num_slots: int):
 
         mine = batch.active & (home == dev)
         local_batch = batch._replace(active=mine)
-        slot = batch.group.astype(I64)  # ways=1: slot == group
 
-        # If this request replaces a DIFFERENT key at its slot
-        # (direct-mapped eviction), the old key's un-synced pending hits
-        # must not be credited to the new key — drop them.
-        prev_other = (
-            mine
-            & tbl.used[slot]
-            & ((tbl.key_hi[slot] != batch.key_hi) | (tbl.key_lo[slot] != batch.key_lo))
+        tbl, out = _decide_impl(tbl, local_batch, now, ways=ways)
+
+        # If this request replaced a DIFFERENT key at its landing slot
+        # (W-way eviction), the old key's un-synced pending hits must not
+        # be credited to the new key — drop them. A freed slot (token
+        # RESET_REMAINING) likewise clears its pending: the reset erased
+        # the entry the delta belonged to.
+        drop = mine & (
+            (out.evicted_hi != 0) | (out.evicted_lo != 0) | out.freed
         )
-
-        tbl, out = _decide_impl(tbl, local_batch, now, ways=1)
-
-        evict_idx = jnp.where(prev_other, slot, num_slots)
+        evict_idx = jnp.where(drop, out.slot, num_slots)
         pending = pending.at[evict_idx].set(0, mode="drop")
 
         # Accumulate deltas for lanes I answered but do not own
         # (reference globalManager.QueueHit, global.go:74-78).
-        owned = (slot // slots_per) == dev
+        owned = (batch.group.astype(I64) // groups_per) == dev
         is_global = (batch.behavior & int(Behavior.GLOBAL)) != 0
         pend_mask = mine & ~owned & is_global & (batch.hits != 0)
-        idx = jnp.where(pend_mask, slot, num_slots)
+        idx = jnp.where(pend_mask, out.slot, num_slots)
         pending = pending.at[idx].add(batch.hits, mode="drop")
 
         out = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
@@ -138,23 +150,32 @@ def make_replica_decide(mesh: Mesh, num_slots: int):
     return decide_fn
 
 
-def make_inject_replicas(mesh: Mesh, num_slots: int):
+def make_inject_replicas(mesh: Mesh, num_slots: int, ways: int = 1):
     """Apply authoritative state rows to EVERY device's replica — the
     landing side of a cross-pod UpdatePeerGlobals push (the intra-pod
     sync uses make_sync_step's rebroadcast instead)."""
-    from gubernator_tpu.ops.inject import InjectBatch, inject
+    from gubernator_tpu.ops.inject import InjectBatch  # noqa: F401
 
-    def local(state: IciState, items: InjectBatch, now):
+    def local(state: IciState, items, now):
         from gubernator_tpu.ops.inject import _inject_impl
 
         tbl = _squeeze(state.table)
         pending = state.pending[0]
-        tbl, _ehi, _elo = _inject_impl(tbl, items, now, ways=1)
+        tbl, _ehi, _elo = _inject_impl(tbl, items, now, ways=ways)
         # The authoritative push supersedes this pod's un-synced local
-        # deltas for these slots (the host tier already carried them to
+        # deltas for these keys (the host tier already carried them to
         # the owner); leaving them would re-apply the same hits at the
-        # next sync tick and double-count.
-        idx = jnp.where(items.active, items.group.astype(I64), num_slots)
+        # next sync tick and double-count. The injected key now occupies
+        # exactly one way of its group — clear that slot's pending (this
+        # also drops a displaced occupant's orphaned delta).
+        grp_base = items.group.astype(I64) * ways
+        way_ix = grp_base[:, None] + jnp.arange(ways, dtype=I64)[None, :]
+        landed = (
+            items.active[:, None]
+            & (tbl.key_hi[way_ix] == items.key_hi[:, None])
+            & (tbl.key_lo[way_ix] == items.key_lo[:, None])
+        )
+        idx = jnp.where(landed, way_ix, num_slots).reshape(-1)
         pending = pending.at[idx].set(0, mode="drop")
         return IciState(table=_unsqueeze(tbl), pending=pending[None])
 
@@ -163,18 +184,25 @@ def make_inject_replicas(mesh: Mesh, num_slots: int):
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def inject_fn(state: IciState, items: InjectBatch, now):
+    def inject_fn(state: IciState, items, now):
         return sharded(state, items, jnp.asarray(now, I64))
 
     return inject_fn
 
 
-def make_sync_step(mesh: Mesh, num_slots: int):
+def make_sync_step(mesh: Mesh, num_slots: int, ways: int = 1):
     """One collective sync tick: deltas -> owners -> authoritative apply ->
     replica rebroadcast. Replaces both gRPC legs of the reference's
-    globalManager with ~20 psums over ICI."""
+    globalManager with ~20 psums over ICI.
+
+    With W>1 the merge key-matches across the ways of each group (a key
+    sits in different ways on different devices); adoption stays
+    per-slot-position and is deduplicated within the group afterwards so
+    the rebroadcast layout never holds the same key twice."""
     n_dev = mesh.devices.size
-    slots_per = num_slots // n_dev
+    num_groups = num_slots // ways
+    groups_per = num_groups // n_dev
+    G, W = num_groups, ways
 
     def local(state: IciState, now):
         dev = jax.lax.axis_index(AXIS).astype(I64)
@@ -183,47 +211,118 @@ def make_sync_step(mesh: Mesh, num_slots: int):
         psum = lambda x: jax.lax.psum(x, AXIS)  # noqa: E731
 
         slot_ids = jnp.arange(num_slots, dtype=I64)
-        own = (slot_ids // slots_per) == dev
+        own = ((slot_ids // W) // groups_per) == dev
         live = t.used & (t.expire_at >= now)
 
-        # Phase A: owner identity per slot (replicated after psum).
+        # Phase A: owner identity per slot (replicated after psum). The
+        # owner's layout is authoritative: rebroadcast reproduces it on
+        # every replica.
         owner_live = psum((own & live).astype(I64)) > 0
         owner_key_hi = psum(jnp.where(own & live, t.key_hi, 0))
         owner_key_lo = psum(jnp.where(own & live, t.key_lo, 0))
 
-        # Phase B: deltas that match the owner's key (key-checked so a
-        # colliding replica entry never pollutes another key's counter).
-        key_match = live & (t.key_hi == owner_key_hi) & (t.key_lo == owner_key_lo)
-        inc_match = psum(jnp.where(key_match, pending, 0))
+        resh = lambda x: x.reshape(G, W)  # noqa: E731
+        lv, pnd = resh(live), resh(pending)
+        lk_hi, lk_lo = resh(t.key_hi), resh(t.key_lo)
 
-        # Adoption: owner has no live entry but a replica does and has
-        # pending hits (the relayed request would have created the entry
-        # at the owner in the reference). Lowest device index wins.
+        def crossway_inc(dst_hi, dst_lo, dst_ok):
+            """Per destination slot (g, w): psum over devices of the
+            pending sitting at whichever way of group g holds dst's key
+            on that device (key-checked, so colliding entries never
+            pollute another key's counter)."""
+            eq = (
+                lv[:, :, None]
+                & dst_ok[:, None, :]
+                & (lk_hi[:, :, None] == dst_hi[:, None, :])
+                & (lk_lo[:, :, None] == dst_lo[:, None, :])
+            )
+            inc = jnp.sum(jnp.where(eq, pnd[:, :, None], 0), axis=1)
+            return psum(inc.reshape(num_slots))
+
+        ow_hi, ow_lo, ow_lv = (
+            resh(owner_key_hi), resh(owner_key_lo), resh(owner_live),
+        )
+        inc_match = crossway_inc(ow_hi, ow_lo, ow_lv)
+
+        # Adoption: a replica holds a live entry with pending hits whose
+        # key is absent from the owner's layout (the relayed request
+        # would have created the entry at the owner in the reference).
+        # Candidates are selected per slot position (lowest device index
+        # wins), deduplicated, then packed into the owner group's EMPTY
+        # ways in rank order — a candidate is not tied to its own way
+        # position, so an owner group with free space always absorbs
+        # overflow keys regardless of where replicas placed them.
         cand = live & (pending != 0)
         sel = jax.lax.pmin(jnp.where(cand, dev, n_dev), AXIS)
         is_sel = cand & (dev == sel)
         adopted_key_hi = psum(jnp.where(is_sel, t.key_hi, 0))
         adopted_key_lo = psum(jnp.where(is_sel, t.key_lo, 0))
-        match2 = live & (t.key_hi == adopted_key_hi) & (t.key_lo == adopted_key_lo)
-        inc_adopt = psum(jnp.where(match2, pending, 0))
+        adopt_ok = sel < n_dev
+        ad_hi, ad_lo, ad_ok = (
+            resh(adopted_key_hi), resh(adopted_key_lo), resh(adopt_ok),
+        )
+        inc_adopt = crossway_inc(ad_hi, ad_lo, ad_ok)
         pending_sel = psum(jnp.where(is_sel, pending, 0))
 
         def adopt(field):
-            return psum(jnp.where(is_sel, field.astype(I64), 0)).astype(field.dtype)
+            return psum(jnp.where(is_sel, field.astype(I64), 0))
 
-        adopt_ok = sel < n_dev
+        # A candidate is dropped when its key already lives somewhere in
+        # the owner's layout for the group (its deltas were credited
+        # there by inc_match), and deduplicated against lower-way
+        # candidates holding the same key (two devices may hold the same
+        # pending key at different way positions). Both masks are vacuous
+        # at W=1.
+        dup_own = (
+            ad_ok[:, :, None]
+            & ow_lv[:, None, :]
+            & (ad_hi[:, :, None] == ow_hi[:, None, :])
+            & (ad_lo[:, :, None] == ow_lo[:, None, :])
+        ).any(axis=2)
+        ua1 = ad_ok & ~dup_own
+        same = (ad_hi[:, :, None] == ad_hi[:, None, :]) & (
+            ad_lo[:, :, None] == ad_lo[:, None, :]
+        )
+        earlier = jnp.tril(jnp.ones((W, W), dtype=bool), -1)  # [w, w']: w' < w
+        dup_prev = (same & ua1[:, None, :] & earlier[None]).any(axis=2)
+        ua_src = ua1 & ~dup_prev  # surviving candidates, at source ways
+
+        # Pack candidates into empty owner ways: rank r candidate lands
+        # in the rank r empty way. src_onehot[g, w_dst, w_src].
+        empty = ~ow_lv
+        c_rank = jnp.cumsum(ua_src.astype(I64), axis=1) - 1
+        e_rank = jnp.cumsum(empty.astype(I64), axis=1) - 1
+        src_onehot = (
+            empty[:, :, None]
+            & ua_src[:, None, :]
+            & (e_rank[:, :, None] == c_rank[:, None, :])
+        )
+        use_adopt = src_onehot.any(axis=2).reshape(num_slots)
+
+        def permute(per_slot):
+            """Move a per-slot quantity from candidate source ways to
+            their destination (adopted) ways."""
+            q = per_slot.reshape(G, W).astype(I64)
+            return jnp.sum(
+                jnp.where(src_onehot, q[:, None, :], 0), axis=2
+            ).reshape(num_slots)
 
         # Merge my owned region: authoritative base + incoming deltas.
         use_mine = owner_live
-        use_adopt = ~owner_live & adopt_ok
 
-        def merged(field_mine, field_adopted):
+        def merged(field_mine, adopted_i64):
             return jnp.where(
-                use_mine, field_mine, jnp.where(use_adopt, field_adopted, 0)
+                use_mine,
+                field_mine,
+                jnp.where(use_adopt, permute(adopted_i64), 0).astype(
+                    field_mine.dtype
+                ),
             )
 
         inc = jnp.where(
-            use_mine, inc_match, jnp.where(use_adopt, inc_adopt - pending_sel, 0)
+            use_mine,
+            inc_match,
+            jnp.where(use_adopt, permute(inc_adopt) - permute(pending_sel), 0),
         )
 
         base = {f: merged(getattr(t, f), adopt(getattr(t, f))) for f in t._fields}
@@ -243,23 +342,75 @@ def make_sync_step(mesh: Mesh, num_slots: int):
             out = psum(jnp.where(own & base_used, val.astype(I64), 0))
             return out.astype(val.dtype)
 
-        new_table = SlotTable(
-            key_hi=bcast(base["key_hi"]),
-            key_lo=bcast(base["key_lo"]),
-            used=psum(jnp.where(own & base_used, 1, 0)) > 0,
-            algo=bcast(base["algo"]),
-            status=bcast(base["status"]),
-            limit=bcast(base["limit"]),
-            duration=bcast(base["duration"]),
-            remaining=bcast(jnp.where(base_used, new_rem, 0)),
-            stamp=bcast(base["stamp"]),
-            expire_at=bcast(base["expire_at"]),
-            invalid_at=bcast(base["invalid_at"]),
-            burst=bcast(base["burst"]),
-            lru=bcast(base["lru"]),
+        merged_used = psum(jnp.where(own & base_used, 1, 0)) > 0
+        mk_hi = bcast(base["key_hi"])
+        mk_lo = bcast(base["key_lo"])
+
+        # Replica-local retention: a live local entry whose key did not
+        # make the merged layout (its group is full at the owner) is
+        # RELOCATED into one of the group's merged-free ways instead of
+        # being erased — the key degrades to per-replica counting under
+        # capacity pressure rather than losing all state, and its pending
+        # survives so the delta reconciles the moment the owner group
+        # frees a way. (The reference's owner cache is unbounded, so
+        # relayed hits never face this; a fixed-capacity table needs an
+        # overflow story.) Relocation (same rank-packing as adoption, but
+        # per device) means a survivor is only dropped when the group has
+        # no free way left on THIS device — not merely because an adopted
+        # key landed on its position. A local copy of a key the merged
+        # layout DOES hold somewhere in the group is dropped — keeping it
+        # would duplicate the key on this device.
+        mfree = ~merged_used.reshape(G, W)
+        in_merged = (
+            (lk_hi[:, :, None] == mk_hi.reshape(G, W)[:, None, :])
+            & (lk_lo[:, :, None] == mk_lo.reshape(G, W)[:, None, :])
+            & ~mfree[:, None, :]
+        ).any(axis=2)
+        surv = lv & ~in_merged
+        s_rank = jnp.cumsum(surv.astype(I64), axis=1) - 1
+        f_rank = jnp.cumsum(mfree.astype(I64), axis=1) - 1
+        move_onehot = (  # [g, w_dst, w_src]
+            mfree[:, :, None]
+            & surv[:, None, :]
+            & (f_rank[:, :, None] == s_rank[:, None, :])
         )
+        kept = move_onehot.any(axis=2).reshape(num_slots)
+
+        def relocate(per_slot):
+            q = per_slot.reshape(G, W).astype(I64)
+            return jnp.sum(
+                jnp.where(move_onehot, q[:, None, :], 0), axis=2
+            ).reshape(num_slots)
+
+        def take(merged_val, local_val):
+            moved = relocate(local_val).astype(local_val.dtype)
+            return jnp.where(
+                merged_used,
+                merged_val,
+                jnp.where(kept, moved, jnp.zeros_like(local_val)),
+            )
+
+        new_table = SlotTable(
+            key_hi=take(mk_hi, t.key_hi),
+            key_lo=take(mk_lo, t.key_lo),
+            used=merged_used | kept,
+            algo=take(bcast(base["algo"]), t.algo),
+            status=take(bcast(base["status"]), t.status),
+            limit=take(bcast(base["limit"]), t.limit),
+            duration=take(bcast(base["duration"]), t.duration),
+            remaining=take(bcast(jnp.where(base_used, new_rem, 0)), t.remaining),
+            stamp=take(bcast(base["stamp"]), t.stamp),
+            expire_at=take(bcast(base["expire_at"]), t.expire_at),
+            invalid_at=take(bcast(base["invalid_at"]), t.invalid_at),
+            burst=take(bcast(base["burst"]), t.burst),
+            lru=take(bcast(base["lru"]), t.lru),
+        )
+        # Pending rides along with relocated survivors (same key,
+        # un-applied deltas). Everything else was either applied via inc
+        # or belongs to a key the merged layout now covers.
+        new_pending = jnp.where(kept, relocate(pending), 0)
         return IciState(
-            table=_unsqueeze(new_table), pending=jnp.zeros_like(pending)[None]
+            table=_unsqueeze(new_table), pending=new_pending[None]
         )
 
     sharded = jax.shard_map(

@@ -148,9 +148,11 @@ class RegionManager:
         """Called after the in-region owner applied a MULTI_REGION item:
         home-region owners queue an authoritative broadcast; other
         regions queue a hit-delta toward the home region."""
-        if len(self._all_regions()) < 2:
+        regions = self._all_regions()
+        if len(regions) < 2:
             return  # single-region deployment: nothing to reconcile
-        if self.is_home(req.hash_key()):
+        local = self._local_region()
+        if (home_region(regions, req.hash_key()) or local) == local:
             self.queue_update(req)
         else:
             self.queue_hit(req)
@@ -199,9 +201,11 @@ class RegionManager:
         t0 = time.perf_counter()
         try:
             by_peer: Dict[str, Tuple[object, List[RateLimitReq]]] = {}
+            regions = self._all_regions()
+            local = self._local_region()
             for key, r in hits.items():
-                home = self.home_of(key)
-                if home == self._local_region():
+                home = home_region(regions, key) or local
+                if home == local:
                     # Region set changed since queueing: we're home now.
                     self.queue_update(r)
                     continue
@@ -263,11 +267,20 @@ class RegionManager:
             return
         t0 = time.perf_counter()
         try:
+            # Pure status read of the CURRENT authoritative state: hits=0
+            # and no mutating behavior bits. A queued RESET_REMAINING was
+            # already applied when the request was served; re-applying it
+            # here would wipe any hits counted since (the reset's effect
+            # still propagates — the re-read sees the post-reset value).
             futs = [
                 asyncio.wrap_future(
                     self.svc.engine.check_async(
                         dataclasses.replace(
-                            upd, hits=0, metadata=dict(upd.metadata)
+                            upd,
+                            hits=0,
+                            behavior=upd.behavior
+                            & ~int(Behavior.RESET_REMAINING),
+                            metadata=dict(upd.metadata),
                         )
                     )
                 )

@@ -21,7 +21,7 @@ either; a daemon configured with global_mode="ici" serves a whole pod as
 one process with no intra-pod RPCs.
 
 Wave rules differ per path: sharded lanes split on slot-group conflicts
-(scatter disjointness per device); replica lanes split on (home, slot)
+(scatter disjointness per device); replica lanes split on (home, group)
 conflicts (same key on the same replica must serialize, but the same key
 on different replicas is exactly multi-node GLOBAL behavior and may
 share a wave).
@@ -59,7 +59,8 @@ class IciEngineConfig:
     devices: Optional[list] = None  # default: all jax.devices()
     num_groups: int = 1 << 12  # sharded-table groups (divisible by n_dev)
     ways: int = 8
-    num_slots: int = 1 << 14  # replica-table slots (ways=1 geometry)
+    num_slots: int = 1 << 14  # replica-table slots (num_slots/replica_ways groups)
+    replica_ways: int = 4  # replica-table associativity (parallel/ici.py)
     batch_size: int = 1024
     batch_limit: int = 1000
     batch_wait_s: float = 500e-6
@@ -77,8 +78,12 @@ class IciEngine(EngineBase):
     def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):
         cfg = config
         devices = cfg.devices or jax.devices()
-        if cfg.num_groups % len(devices) or cfg.num_slots % len(devices):
-            raise ValueError("num_groups/num_slots must divide by device count")
+        if cfg.num_groups % len(devices):
+            raise ValueError("num_groups must divide by device count")
+        if cfg.num_slots % (cfg.replica_ways * len(devices)):
+            raise ValueError(
+                "num_slots must divide by replica_ways * device count"
+            )
         if cfg.max_waves < 1:
             raise ValueError("max_waves must be >= 1")
         self.cfg = cfg
@@ -92,10 +97,17 @@ class IciEngine(EngineBase):
         self._decide = pmesh.make_sharded_decide(self.mesh, cfg.num_groups, cfg.ways)
 
         # GLOBAL replica path
-        self.ici_state = ici.create_ici_state(self.mesh, cfg.num_slots)
-        self._replica = ici.make_replica_decide(self.mesh, cfg.num_slots)
-        self._sync = ici.make_sync_step(self.mesh, cfg.num_slots)
-        self._inject_replicas = ici.make_inject_replicas(self.mesh, cfg.num_slots)
+        self.num_rgroups = cfg.num_slots // cfg.replica_ways
+        self.ici_state = ici.create_ici_state(
+            self.mesh, cfg.num_slots, cfg.replica_ways
+        )
+        self._replica = ici.make_replica_decide(
+            self.mesh, cfg.num_slots, cfg.replica_ways
+        )
+        self._sync = ici.make_sync_step(self.mesh, cfg.num_slots, cfg.replica_ways)
+        self._inject_replicas = ici.make_inject_replicas(
+            self.mesh, cfg.num_slots, cfg.replica_ways
+        )
 
         self._lock = threading.Lock()
         self._home_rr = 0
@@ -130,7 +142,7 @@ class IciEngine(EngineBase):
         cfg = self.cfg
         asm = _WaveAssembler(InjectBatch.zeros, cfg.batch_size)
         hi_a, lo_a, slot_a = key_hash128_batch(
-            [g.key for g in globals_], cfg.num_slots
+            [g.key for g in globals_], self.num_rgroups
         )
         for i, g in enumerate(globals_):
             slot = int(slot_a[i])
@@ -235,7 +247,7 @@ class IciEngine(EngineBase):
                     sharded_asm.commit(w, grp)
                     placements.append(("s", w, lane))
                 else:
-                    slot = group_of(lo, cfg.num_slots)
+                    slot = group_of(lo, self.num_rgroups)
                     home = self._home_rr % self.n_dev
                     placed = replica_asm.place((home, slot), cfg.max_waves)
                     if placed is None:
@@ -244,7 +256,7 @@ class IciEngine(EngineBase):
                         continue
                     self._home_rr += 1  # only consumed on placement
                     wb, w, lane = placed
-                    encode_one(wb, lane, req, now, cfg.num_slots, key=(hi, lo))
+                    encode_one(wb, lane, req, now, self.num_rgroups, key=(hi, lo))
                     while len(replica_homes) < len(replica_asm.waves):
                         replica_homes.append(np.zeros(B, dtype=np.int64))
                     replica_homes[w][lane] = home

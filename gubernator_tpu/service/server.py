@@ -120,17 +120,6 @@ class V1Service:
             if peer.info.is_owner:
                 self._m_local.inc()
                 local_items.append((i, req))
-                if self.global_mgr is not None and (req.behavior & GLOBAL):
-                    # Owner-side GLOBAL update broadcast queue
-                    # (reference gubernator.go:603-606)
-                    self.global_mgr.queue_update(req)
-                if self.region_mgr is not None and (
-                    req.behavior & int(Behavior.MULTI_REGION)
-                ):
-                    # In-region owner observed a MULTI_REGION item:
-                    # queue the cross-region leg (delta toward the home
-                    # region, or authoritative broadcast from it).
-                    self.region_mgr.observe(req)
             elif req.behavior & GLOBAL:
                 self._m_global.inc()
                 global_items.append((i, req, peer.info))
@@ -178,8 +167,22 @@ class V1Service:
         if local_fut is not None:
             try:
                 results = await asyncio.wrap_future(local_fut)
-                for (i, _), resp in zip(local_items, results):
+                for (i, req), resp in zip(local_items, results):
                     responses[i] = resp
+                    if resp.error:
+                        continue
+                    # Replication legs queue only AFTER a successful local
+                    # apply (reference gubernator.go:603-606 order) — a
+                    # failed apply must not push hits it never counted.
+                    if self.global_mgr is not None and (req.behavior & GLOBAL):
+                        self.global_mgr.queue_update(req)
+                    if self.region_mgr is not None and (
+                        req.behavior & int(Behavior.MULTI_REGION)
+                    ):
+                        # In-region owner applied a MULTI_REGION item:
+                        # queue the cross-region leg (delta toward the
+                        # home region, or authoritative broadcast from it).
+                        self.region_mgr.observe(req)
             except Exception as e:
                 for i, _ in local_items:
                     responses[i] = RateLimitResp(error=str(e))
@@ -237,6 +240,15 @@ class V1Service:
                 req.behavior |= Behavior.DRAIN_OVER_LIMIT
             if req.created_at is None or req.created_at == 0:
                 req.created_at = self.now_fn()
+        try:
+            results = await asyncio.wrap_future(self.engine.check_bulk(list(reqs)))
+        except Exception as e:
+            return [RateLimitResp(error=str(e)) for _ in reqs]
+        for req, resp in zip(reqs, results):
+            if resp.error:
+                continue
+            # Replication legs queue only AFTER a successful apply — a
+            # failed apply must not push hits it never counted.
             if self.global_mgr is not None and has_behavior(req.behavior, Behavior.GLOBAL):
                 self.global_mgr.queue_update(req)
             if self.region_mgr is not None and has_behavior(
@@ -246,10 +258,7 @@ class V1Service:
                 # here; the same rule covers both — the applying node is
                 # the in-region owner, so it queues the cross-region leg.
                 self.region_mgr.observe(req)
-        try:
-            return await asyncio.wrap_future(self.engine.check_bulk(list(reqs)))
-        except Exception as e:
-            return [RateLimitResp(error=str(e)) for _ in reqs]
+        return results
 
     # ---- PeersV1.UpdatePeerGlobals (reference gubernator.go:425-459) -------
 

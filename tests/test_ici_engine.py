@@ -168,9 +168,12 @@ def test_replica_capacity_pressure_no_cross_key_credit():
         for k, r in zip(keys, reads):
             assert limit - hits_per_key <= r.remaining <= limit, (k, r.remaining)
         # At 4x occupancy at most num_slots keys can be live at once, so
-        # full retention is impossible; some keys must survive, and the
-        # thrash rate is the observable cost of the direct-mapped tier.
-        assert 0 < retained < n_keys
+        # full retention is impossible; the W-way tier (cross-position
+        # adoption + replica-local retention, parallel/ici.py) must fill
+        # >=90% of the physical capacity (ways=1 direct-mapped managed
+        # ~73%: 94/128).
+        assert retained >= 0.9 * num_slots, (retained, num_slots)
+        assert retained < n_keys
         print(
             f"replica capacity pressure: {retained}/{n_keys} keys fully "
             f"retained at 4x occupancy ({num_slots} slots)"

@@ -1,9 +1,17 @@
 """Differential fuzz of the ICI GLOBAL collective against an independent
 Python model of its spec (replica decide + pending deltas + sync merge:
-owner apply, key-checked delta summing, adoption, rebroadcast, eviction
-pending-drop). Small tables force slot collisions; random time advances
-force expiry paths."""
+owner apply, cross-way key-checked delta summing, rank-packed adoption
+into empty owner ways, replica-local retention of overflow entries,
+rebroadcast, eviction pending-drop). Small tables force way-group
+collisions; random time advances force expiry paths.
 
+Runs at ways=1 (the degenerate per-slot geometry) AND ways=4 (the
+production replica geometry, where a key sits in different ways on
+different devices and the merge must key-match across ways).
+"""
+
+import copy
+import dataclasses
 import random
 
 import numpy as np
@@ -20,125 +28,210 @@ import jax
 
 NOW = 1_753_700_000_000
 NDEV = 4
-SLOTS_PER = 8
-NUM_SLOTS = NDEV * SLOTS_PER
 
 
 class IciModel:
     """Spec model: one OracleEngine per device (replica semantics) plus a
-    slot-occupancy map per device (ways=1 direct-mapped eviction) and
-    per-device pending deltas. Sync implements the documented merge."""
+    per-device slot-occupancy map (W-way set-associative placement with
+    decide's insertion priority: matched-expired > empty > expired >
+    LRU, lowest way on ties) and per-device pending deltas recorded at
+    the key's slot on that device. Sync implements the documented merge."""
 
-    def __init__(self):
-        self.oracles = [OracleEngine() for _ in range(NDEV)]
+    def __init__(self, num_slots: int, ways: int, ndev: int = NDEV):
+        self.num_slots = num_slots
+        self.ways = ways
+        self.ndev = ndev
+        self.num_groups = num_slots // ways
+        self.groups_per = self.num_groups // ndev
+        self.oracles = [OracleEngine() for _ in range(ndev)]
         # device -> slot -> hash_key occupying it
-        self.slot_key = [dict() for _ in range(NDEV)]
-        self.pending = [dict() for _ in range(NDEV)]  # slot -> hits
+        self.slot_key = [dict() for _ in range(ndev)]
+        self.pending = [dict() for _ in range(ndev)]  # slot -> hits
+        self.lru = [dict() for _ in range(ndev)]  # slot -> last-touch ms
 
-    @staticmethod
-    def slot_of(hash_key: str) -> int:
-        return group_of(key_hash128(hash_key)[1], NUM_SLOTS)
+    # -- shared helpers ------------------------------------------------------
+
+    def _live(self, dev: int, slot: int, now: int):
+        """(key, item) when the slot holds a live (unexpired) entry."""
+        k = self.slot_key[dev].get(slot)
+        if k is None:
+            return None
+        item = self.oracles[dev].cache.get(k)
+        if item is None or item.expire_at < now:
+            return None
+        return k, item
+
+    def _choose_slot(self, dev: int, key: str, now: int) -> int:
+        """decide's way choice (ops/decide.py _choose_slot)."""
+        g = group_of(key_hash128(key)[1], self.num_groups)
+        slots = [g * self.ways + w for w in range(self.ways)]
+        # live match wins
+        for s in slots:
+            k = self.slot_key[dev].get(s)
+            if k != key:
+                continue
+            item = self.oracles[dev].cache.get(k)
+            if item is not None and item.expire_at >= now:
+                return s
+        # insertion priority: matched-expired > empty > expired > LRU
+        best = None
+        for w, s in enumerate(slots):
+            k = self.slot_key[dev].get(s)
+            item = self.oracles[dev].cache.get(k) if k is not None else None
+            used = k is not None and item is not None
+            expired = used and item.expire_at < now
+            if used and k == key and expired:
+                cat, tie = 0, w
+            elif not used:
+                cat, tie = 1, w
+            elif expired:
+                cat, tie = 2, w
+            else:
+                cat, tie = 3, self.lru[dev].get(s, 0)
+            score = (cat, tie, w)
+            if best is None or score < best[0]:
+                best = (score, s)
+        return best[1]
+
+    # -- replica decide ------------------------------------------------------
 
     def decide(self, req: RateLimitReq, home: int, now: int):
-        import dataclasses
-
         key = req.hash_key()
-        slot = self.slot_of(key)
+        slot = self._choose_slot(home, key, now)
         ora = self.oracles[home]
         prev = self.slot_key[home].get(slot)
         if prev is not None and prev != key:
-            # direct-mapped eviction: drop the old entry and its un-synced
+            # W-way eviction: drop the old entry and its un-synced
             # pending deltas
             ora.cache.pop(prev, None)
             self.pending[home].pop(slot, None)
         self.slot_key[home][slot] = key
+        self.lru[home][slot] = now
         resp = ora.decide(dataclasses.replace(req, metadata={}), now)
-        owned = slot // SLOTS_PER == home
+        g = slot // self.ways
+        owned = g // self.groups_per == home
         if not owned and req.hits != 0:
             self.pending[home][slot] = self.pending[home].get(slot, 0) + req.hits
         return resp
 
+    # -- sync ----------------------------------------------------------------
+
+    def _crossway_inc(self, g: int, key: str, now: int) -> int:
+        inc = 0
+        for d in range(self.ndev):
+            for w in range(self.ways):
+                s = g * self.ways + w
+                lv = self._live(d, s, now)
+                if lv is not None and lv[0] == key:
+                    inc += self.pending[d].get(s, 0)
+        return inc
+
     def sync(self, now: int):
         from gubernator_tpu.models.bucket import FIXED_SHIFT
 
-        new_entries = {}  # slot -> (key, CacheEntry-like copy) or None
-        for slot in range(NUM_SLOTS):
-            owner_dev = slot // SLOTS_PER
-            def live(dev):
-                k = self.slot_key[dev].get(slot)
-                if k is None:
-                    return None
-                item = self.oracles[dev].cache.get(k)
-                if item is None or item.expire_at < now:
-                    return None
-                return k, item
+        W = self.ways
 
-            owner = live(owner_dev)
-            if owner is not None:
-                okey, oitem = owner
-                inc = sum(
-                    self.pending[d].get(slot, 0)
-                    for d in range(NDEV)
-                    if live(d) is not None and live(d)[0] == okey
-                )
-                base_key, base_item = okey, oitem
-            else:
-                # adoption: lowest device with live entry AND pending != 0
-                sel = None
-                for d in range(NDEV):
-                    lv = live(d)
-                    if lv is not None and self.pending[d].get(slot, 0) != 0:
-                        sel = d
-                        break
-                if sel is None:
-                    new_entries[slot] = None
-                    continue
-                akey, aitem = live(sel)
-                inc_total = sum(
-                    self.pending[d].get(slot, 0)
-                    for d in range(NDEV)
-                    if live(d) is not None and live(d)[0] == akey
-                )
-                inc = inc_total - self.pending[sel].get(slot, 0)
-                base_key, base_item = akey, aitem
-
-            import copy
-
-            item = copy.deepcopy(base_item)
+        def apply_inc(item, inc):
+            item = copy.deepcopy(item)
             if inc != 0:
                 st = item.value
                 if item.algorithm == Algorithm.LEAKY_BUCKET:
                     st.remaining_s = max(st.remaining_s - (inc << FIXED_SHIFT), 0)
                 else:
                     st.remaining = max(st.remaining - inc, 0)
-            new_entries[slot] = (base_key, item)
+            return item
 
-        # rebroadcast: every device's slot takes the merged entry
-        import copy
+        # merged[g]: way -> (key, item, lru) — the authoritative layout
+        merged = {}
+        for g in range(self.num_groups):
+            owner_dev = g // self.groups_per
+            slots = [g * W + w for w in range(W)]
+            owner_live = {
+                w: self._live(owner_dev, s, now) for w, s in enumerate(slots)
+            }
+            owner_keys = {lv[0] for lv in owner_live.values() if lv is not None}
 
-        for d in range(NDEV):
-            self.pending[d].clear()
-            for slot in range(NUM_SLOTS):
-                old_key = self.slot_key[d].pop(slot, None)
-                if old_key is not None:
-                    self.oracles[d].cache.pop(old_key, None)
-                ent = new_entries[slot]
-                if ent is not None:
-                    k, item = ent
-                    self.slot_key[d][slot] = k
-                    self.oracles[d].cache[k] = copy.deepcopy(item)
+            # candidates per slot position: lowest device with a live
+            # entry holding pending
+            cands = []  # (src_way, sel_dev, key, item)
+            for w, s in enumerate(slots):
+                for d in range(self.ndev):
+                    lv = self._live(d, s, now)
+                    if lv is not None and self.pending[d].get(s, 0) != 0:
+                        cands.append((w, d, lv[0], lv[1]))
+                        break
+            # dup_own: deltas for owner-layout keys flow via inc_match
+            cands = [c for c in cands if c[2] not in owner_keys]
+            # dedup among candidates (lowest way wins)
+            seen, uniq = set(), []
+            for c in cands:
+                if c[2] not in seen:
+                    seen.add(c[2])
+                    uniq.append(c)
+            empties = [w for w in range(W) if owner_live[w] is None]
+
+            mg = {}
+            for w in range(W):
+                lv = owner_live[w]
+                if lv is None:
+                    continue
+                okey, oitem = lv
+                inc = self._crossway_inc(g, okey, now)
+                mg[w] = (okey, apply_inc(oitem, inc),
+                         self.lru[owner_dev].get(slots[w], 0))
+            for dst, (src_w, sel_d, akey, aitem) in zip(empties, uniq):
+                src_slot = g * W + src_w
+                inc = self._crossway_inc(g, akey, now) - self.pending[sel_d].get(
+                    src_slot, 0
+                )
+                mg[dst] = (akey, apply_inc(aitem, inc),
+                           self.lru[sel_d].get(src_slot, 0))
+            merged[g] = mg
+
+        # rebroadcast + replica-local retention: merged layout lands
+        # identically on every device; local overflow survivors relocate
+        # into merged-free ways in rank order (pending and lru ride
+        # along); survivors beyond the group's free capacity drop.
+        for d in range(self.ndev):
+            new_sk, new_pend, new_lru, new_cache = {}, {}, {}, {}
+            for g in range(self.num_groups):
+                mg = merged[g]
+                merged_keys = {e[0] for e in mg.values()}
+                for w, (k, item, lru) in mg.items():
+                    s = g * W + w
+                    new_sk[s] = k
+                    new_cache[k] = copy.deepcopy(item)
+                    new_lru[s] = lru
+                free = [w for w in range(W) if w not in mg]
+                surv = []
+                for w in range(W):
+                    s = g * W + w
+                    lv = self._live(d, s, now)
+                    if lv is not None and lv[0] not in merged_keys:
+                        surv.append((s, lv))
+                for dst_w, (src_s, (k, item)) in zip(free, surv):
+                    s = g * W + dst_w
+                    new_sk[s] = k
+                    new_cache[k] = item  # device's own item, unchanged
+                    new_lru[s] = self.lru[d].get(src_s, 0)
+                    if src_s in self.pending[d]:
+                        new_pend[s] = self.pending[d][src_s]
+            self.slot_key[d] = new_sk
+            self.pending[d] = new_pend
+            self.lru[d] = new_lru
+            self.oracles[d].cache = new_cache
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3, 4])
-def test_ici_sync_matches_model(seed):
+def _run_fuzz(seed: int, num_slots: int, ways: int):
     mesh = pmesh.make_mesh(jax.devices()[:NDEV])
-    state = ici.create_ici_state(mesh, NUM_SLOTS)
-    replica_fn = ici.make_replica_decide(mesh, NUM_SLOTS)
-    sync_fn = ici.make_sync_step(mesh, NUM_SLOTS)
-    model = IciModel()
+    num_groups = num_slots // ways
+    state = ici.create_ici_state(mesh, num_slots, ways)
+    replica_fn = ici.make_replica_decide(mesh, num_slots, ways)
+    sync_fn = ici.make_sync_step(mesh, num_slots, ways)
+    model = IciModel(num_slots, ways)
 
     rng = random.Random(seed)
-    keys = [f"fz:{i}" for i in range(20)]  # 20 keys on 32 slots: collisions
+    keys = [f"fz:{i}" for i in range(20)]  # 20 keys: group collisions
     now = NOW
 
     for step in range(250):
@@ -157,9 +250,7 @@ def test_ici_sync_matches_model(seed):
                 limit=rng.choice([3, 10, 100]),
                 hits=rng.choice([-2, 0, 1, 1, 2, 5, 50]),
             )
-            import dataclasses
-
-            b = encode_batch([dataclasses.replace(req)], now, NUM_SLOTS, 2)
+            b = encode_batch([dataclasses.replace(req)], now, num_groups, 2)
             hm = np.full((2,), home, dtype=np.int64)
             state, out = replica_fn(state, b, hm, now)
             want = model.decide(req, home, now)
@@ -177,7 +268,6 @@ def test_ici_sync_matches_model(seed):
     # final sync then full read-back comparison on every device
     state = sync_fn(state, now)
     model.sync(now)
-    import dataclasses
 
     for key in keys:
         for d in range(NDEV):
@@ -185,7 +275,7 @@ def test_ici_sync_matches_model(seed):
                 name="z", unique_key=key, behavior=Behavior.GLOBAL,
                 duration=60_000, limit=100, hits=0,
             )
-            b = encode_batch([dataclasses.replace(req)], now, NUM_SLOTS, 2)
+            b = encode_batch([dataclasses.replace(req)], now, num_groups, 2)
             hm = np.full((2,), d, dtype=np.int64)
             state, out = replica_fn(state, b, hm, now)
             want = model.decide(dataclasses.replace(req), d, now)
@@ -193,3 +283,13 @@ def test_ici_sync_matches_model(seed):
             assert got == (int(want.status), int(want.remaining)), (
                 f"seed {seed} final key {key} dev {d}"
             )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_ici_sync_matches_model(seed):
+    _run_fuzz(seed, num_slots=NDEV * 8, ways=1)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_ici_sync_matches_model_4way(seed):
+    _run_fuzz(seed, num_slots=NDEV * 8, ways=4)

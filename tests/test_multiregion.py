@@ -110,8 +110,6 @@ def test_multiregion_convergence(loop_thread):
             got = await _poll(b, uk, 85)
             assert got == 85, f"broadcast leg never converged: dc-b sees {got}"
 
-            #
-
             # Steady state: every daemon in every region agrees.
             await asyncio.sleep(0.3)
             values = set()
@@ -121,15 +119,13 @@ def test_multiregion_convergence(loop_thread):
                 values.add(await _read(cl, uk))
             assert values == {85}, f"regions disagree: {values}"
 
-            # The home region's broadcast counter moved.
+            # The home region's broadcast leg actually fired.
             mgr_counts = sum(
-                d.svc.metrics.region_broadcast_counter._value.get()
-                if hasattr(d.svc.metrics.region_broadcast_counter, "_value")
-                else 0
+                sum(d.svc.metrics.region_broadcast_counter._values.values())
                 for d in c.daemons
                 if d.conf.data_center == "dc-a"
             )
-            assert mgr_counts >= 0  # presence check; exact counts below
+            assert mgr_counts >= 1, "home region never broadcast"
         finally:
             for cl in clients:
                 await cl.close()
