@@ -41,6 +41,7 @@ def bench_engine() -> dict:
             num_groups=1 << 15, batch_size=2048, batch_limit=2048,
             batch_wait_s=200e-6, max_flush_items=1 << 14,
             keep_key_strings=False,
+            fast_buckets=True,  # the daemon's production config
         )
     )
     rng = np.random.default_rng(3)
@@ -67,7 +68,15 @@ def bench_engine() -> dict:
 
     # Single-request NO_BATCHING latency (the p99 < 2ms north star is a
     # per-request service latency; NO_BATCHING skips the batch window).
+    # Wait for the width buckets to finish compiling first — production
+    # daemons warm them at startup, and a mid-measurement background
+    # compile pollutes the tail with compile-thread contention.
     from gubernator_tpu.api.types import Behavior
+
+    for _ in range(600):
+        if {128, 256, 512, 1024}.issubset(set(eng._warm_shapes)):
+            break
+        time.sleep(0.25)
 
     lat = []
     for i in range(300):
@@ -159,6 +168,83 @@ def bench_server() -> dict:
         "unit": "decisions/s",
         "vs_baseline": round(tput / 4000.0, 1),
     }
+
+
+def _try_runner_relay(args, timeout_s: float = 2400.0) -> bool:
+    """Relay the bench through a live tools/tpu_runner.py claim holder.
+
+    The TPU tunnel allows ONE device claim. When a persistent runner
+    (tools/tpu_runner.py) already holds it, a fresh claim from the
+    guarded child would fail after ~25min and report value=0 — exactly
+    the round-2 failure mode, self-inflicted. Instead, submit the bench
+    as a runner job and relay its RESULT line. Returns False (fall back
+    to the guarded child) when no healthy runner is detected."""
+    import os
+
+    jobs = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")
+    status = os.path.join(jobs, "status")
+    try:
+        with open(status) as f:
+            st = f.read().strip()
+    except OSError:
+        return False
+    if not st.startswith("READY"):
+        return False
+    name = f"bench_{args.mode}_{args.layout}_{os.getpid()}"
+    body = (
+        "import sys, json\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        # The runner process is long-lived and caches modules across
+        # jobs; purge ours so the bench measures the CURRENT code (jax
+        # stays cached — it holds the device claim).
+        "for _m in [k for k in list(sys.modules)\n"
+        "           if k == 'bench' or k.startswith('gubernator_tpu')]:\n"
+        "    del sys.modules[_m]\n"
+        "import bench\n"
+        f"args = type('A', (), {{'mode': {args.mode!r}, 'layout': {args.layout!r}}})\n"
+        "if args.mode == 'engine':\n"
+        "    r = bench.bench_engine()\n"
+        "elif args.mode == 'server':\n"
+        "    r = bench.bench_server()\n"
+        "elif args.mode == 'global':\n"
+        "    r = bench.bench_global()\n"
+        "else:\n"
+        "    r = bench.bench_kernel(args.mode, args.layout)\n"
+        "print('RESULT ' + json.dumps(r))\n"
+    )
+    with open(os.path.join(jobs, name + ".py"), "w") as f:
+        f.write(body)
+    with open(os.path.join(jobs, name + ".go"), "w") as f:
+        pass
+    done = os.path.join(jobs, name + ".done")
+    out = os.path.join(jobs, name + ".out")
+    deadline = time.monotonic() + float(
+        os.environ.get("GUBER_BENCH_RUNNER_TIMEOUT", timeout_s)
+    )
+    while time.monotonic() < deadline:
+        if os.path.exists(done):
+            try:
+                with open(out) as f:
+                    for line in f:
+                        if line.startswith("RESULT "):
+                            print(line[len("RESULT "):].strip(), flush=True)
+                            return True
+            except OSError:
+                pass
+            return False  # job ran but produced no RESULT: fall back
+        time.sleep(2.0)
+    print(
+        json.dumps(
+            {
+                "metric": f"runner relay timed out ({name}); runner busy or dead",
+                "value": 0,
+                "unit": "decisions/s",
+                "vs_baseline": 0,
+            }
+        ),
+        flush=True,
+    )
+    return True  # a second claim attempt would wedge behind the runner's
 
 
 def _run_guarded(timeout_s: float = 480.0) -> None:
@@ -343,6 +429,8 @@ def main() -> None:
 
     child_out = os.environ.get("GUBER_BENCH_CHILD")
     if not child_out:
+        if _try_runner_relay(args):
+            return
         _run_guarded()
         return
 

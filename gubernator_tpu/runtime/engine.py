@@ -159,6 +159,10 @@ class _WaveAssembler:
         self._groups[w].add(grp)
         self._fill[w] += 1
 
+    def fill(self, w: int) -> int:
+        """Occupied lanes in wave w (the wave's device-width floor)."""
+        return self._fill[w]
+
 
 class EngineBase:
     """Shared request intake for device engines: the queue, the bulk
@@ -557,6 +561,23 @@ class DeviceEngine(EngineBase):
             if rows:
                 encode_rows(asm.waves[w], wave_lanes[w], rows, now)
         waves = asm.waves
+
+        # Bucket each wave's device width to its occupancy (the kernel's
+        # cost is per-LANE: a NO_BATCHING single-request flush must not
+        # pay a batch_size-wide kernel). Lane indices are arrival ranks,
+        # so every occupied lane survives the narrowing; only ALREADY-
+        # WARM shapes are used — same policy as the columnar path. With
+        # a store, flushes stay batch_size-wide (warm_store_path pins
+        # that width for probe/inject/gather).
+        if self.store is None:
+            warm = self._warm_shapes  # immutable snapshot
+            for w in range(len(waves)):
+                fill, Bn = asm.fill(w), B
+                for s in warm:
+                    if s >= fill and s < Bn:
+                        Bn = s
+                if Bn < B:
+                    waves[w] = jax.tree.map(lambda a: a[:Bn], waves[w])
 
         # Execute waves sequentially against the (donated) table. With a
         # Store attached, each wave runs the reference's exact per-request
