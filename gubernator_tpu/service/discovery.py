@@ -187,20 +187,33 @@ class DnsPool:
 
 
 class GossipPool:
-    """Zero-dependency gossip membership (the memberlist-style backend,
-    reference memberlist.go:38-299, reimagined on stdlib asyncio UDP).
+    """Zero-dependency SWIM gossip membership (the memberlist-style
+    backend, reference memberlist.go:38-299, reimagined on stdlib
+    asyncio UDP).
 
     Each node carries its own PeerInfo in its gossip state and
     periodically sends its full membership view (JSON datagram) to a few
     random peers plus the configured seed nodes; receivers merge views
-    and refresh liveness. Peers unseen for `expire_intervals` gossip
-    rounds are dropped. Every membership change pushes the full PeerInfo
-    list through on_update -> SetPeers, like every other pool.
+    and refresh liveness. On top of that anti-entropy layer, the SWIM
+    failure-detector runs (reference memberlist.go:160-233 event
+    semantics):
 
-    This is a simplified SWIM cousin (push-only, no indirect probes or
-    suspicion states) — adequate for LAN clusters; swap in a hardened
-    implementation behind the same OnUpdate contract for hostile
-    networks.
+    - Every interval, ONE member (round-robin) is pinged; a missing ack
+      triggers an indirect round — `indirect_probes` random members are
+      asked to ping the target on our behalf (acks return directly).
+    - A member failing both rounds is marked SUSPECT and the suspicion
+      gossips with the view. A suspect refutes by bumping its own
+      incarnation number and gossiping alive; suspicion at an older
+      incarnation is discarded.
+    - A member suspect for `suspicion_intervals` rounds is declared dead:
+      removed from the membership (SetPeers fires) and tombstoned so
+      stale third-party views cannot resurrect it at an old incarnation.
+      A datagram from the address itself always proves life and clears
+      the tombstone (fast rejoin after restart).
+
+    Detection is therefore O(probe interval), not O(freshness window);
+    the `expire_intervals` freshness sweep remains as a backstop for
+    peers that were never probed (e.g. learned moments ago).
     """
 
     def __init__(
@@ -213,6 +226,9 @@ class GossipPool:
         expire_intervals: int = 5,
         fanout: int = 3,
         advertise: str = "",  # reachable gossip identity; derived if empty
+        suspicion_intervals: int = 3,
+        indirect_probes: int = 3,
+        tombstone_intervals: int = 10,
     ):
         import json as _json
         import random as _random
@@ -227,8 +243,19 @@ class GossipPool:
         self.interval_s = interval_s
         self.expire_s = interval_s * expire_intervals
         self.fanout = fanout
-        # gossip_addr -> {"info": PeerInfo, "seen": monotonic}
+        self.suspicion_s = interval_s * suspicion_intervals
+        self.indirect_probes = indirect_probes
+        self.tombstone_s = interval_s * tombstone_intervals
+        # gossip_addr -> {"info": PeerInfo, "seen": monotonic,
+        #                 "state": "alive"|"suspect", "inc": int,
+        #                 "since": monotonic (state transition time)}
         self._peers = {}
+        self._inc = 0  # own incarnation (bumped to refute suspicion)
+        self._tombs = {}  # addr -> {"inc": int, "until": monotonic}
+        self._seq = 0
+        self._acked = set()
+        self._probe = None  # (addr, seq, "direct"|"indirect")
+        self._probe_ring = []
         self._last_pushed = None
         self._transport = None
         self._task = None
@@ -263,7 +290,10 @@ class GossipPool:
         if not self.advertise:
             self.advertise = resolve_host_ip(self.bind)
         self.seeds = [s for s in self.seeds if s != self.advertise]
-        self._peers[self.advertise] = {"info": self.info, "seen": _time.monotonic()}
+        self._peers[self.advertise] = {
+            "info": self.info, "seen": _time.monotonic(),
+            "state": "alive", "inc": self._inc, "since": _time.monotonic(),
+        }
         self._push()
         self._task = asyncio.ensure_future(self._loop())
 
@@ -280,10 +310,43 @@ class GossipPool:
                 # so receivers get accurate indirect liveness (prevents
                 # membership flapping in clusters larger than the fanout)
                 "age": round(now - st["seen"], 3),
+                "state": st["state"],
+                "inc": st["inc"],
             }
             for addr, st in self._peers.items()
         }
+        # dead members gossip as tombstones until they age out, so the
+        # death propagates faster than everyone independently probing.
+        # The death's age travels with it: receivers seed their tombstone
+        # with the REMAINING ttl, so re-gossip can never extend a
+        # tombstone past its original death + tombstone_s and the
+        # cluster-wide set provably drains (no mutual resurrection).
+        for addr, tomb in self._tombs.items():
+            if addr not in peers:
+                peers[addr] = {
+                    "state": "dead", "inc": tomb["inc"],
+                    "age": round(now - tomb["died"], 3),
+                }
         return self._json.dumps({"from": self.advertise, "peers": peers}).encode()
+
+    def _sendto(self, payload: bytes, addr: str) -> None:
+        try:
+            host, port = addr.rsplit(":", 1)
+            self._transport.sendto(payload, (host, int(port)))
+        except Exception:
+            pass
+
+    def _gossip_out(self) -> None:
+        """Send the current view to fanout random members + seeds."""
+        targets = set(self.seeds)
+        others = [a for a in self._peers if a != self.advertise]
+        if others:
+            targets.update(
+                self._random.sample(others, min(self.fanout, len(others)))
+            )
+        payload = self._encode()
+        for t in targets:
+            self._sendto(payload, t)
 
     def _receive(self, data: bytes) -> None:
         import time as _time
@@ -294,13 +357,67 @@ class GossipPool:
                 return
             now = _time.monotonic()
             sender = msg.get("from")
+
+            t = msg.get("t")
+            if t is not None:
+                self._receive_probe(t, msg, now)
+                return
+
             changed = False
             peers = msg.get("peers")
             if not isinstance(peers, dict):
                 return
+            if isinstance(sender, str) and sender in self._tombs:
+                # a datagram FROM the address itself is proof of life:
+                # clear the tombstone so the rejoin merges below
+                del self._tombs[sender]
             for addr, p in peers.items():
-                if addr == self.advertise or not isinstance(p, dict):
+                if not isinstance(p, dict):
                     continue
+                state = str(p.get("state", "alive"))
+                if state not in ("alive", "suspect", "dead"):
+                    # unknown states (version skew, hostile input) must
+                    # not park a peer outside the detector's state machine
+                    continue
+                pinc = int(p.get("inc", 0) or 0)
+                if addr == self.advertise:
+                    # refutation (memberlist.go:214-233): someone believes
+                    # we are suspect/dead — outlive that incarnation and
+                    # gossip alive immediately
+                    if state in ("suspect", "dead") and pinc >= self._inc:
+                        self._inc = pinc + 1
+                        me = self._peers.get(self.advertise)
+                        if me is not None:
+                            me["inc"] = self._inc
+                        self._gossip_out()
+                    continue
+                if state == "dead":
+                    tomb = self._tombs.get(addr)
+                    if addr == sender or (
+                        tomb is not None and tomb["inc"] >= pinc
+                    ):
+                        continue
+                    died = now - float(p.get("age", 0) or 0)
+                    until = died + self.tombstone_s
+                    if until <= now:
+                        continue  # the death already aged out everywhere
+                    st = self._peers.get(addr)
+                    if st is not None and pinc >= st["inc"]:
+                        del self._peers[addr]
+                        self._tombs[addr] = {
+                            "inc": pinc, "until": until, "died": died
+                        }
+                        changed = True
+                    elif st is None:
+                        self._tombs[addr] = {
+                            "inc": pinc, "until": until, "died": died
+                        }
+                    continue
+                tomb = self._tombs.get(addr)
+                if tomb is not None:
+                    if addr != sender and pinc <= tomb["inc"]:
+                        continue  # stale resurrection at an old incarnation
+                    del self._tombs[addr]
                 age = float(p.get("age", 0) or 0)
                 # indirect liveness: the sender saw this peer `age` ago;
                 # one transit interval of slack
@@ -314,10 +431,27 @@ class GossipPool:
                 )
                 st = self._peers.get(addr)
                 if st is None:
-                    self._peers[addr] = {"info": info, "seen": seen}
+                    self._peers[addr] = {
+                        "info": info, "seen": seen,
+                        "state": state if state == "suspect" else "alive",
+                        "inc": pinc, "since": now,
+                    }
                     changed = True
                 else:
                     st["seen"] = max(st["seen"], seen)
+                    if pinc > st["inc"]:
+                        # higher incarnation overrides state outright
+                        st["inc"] = pinc
+                        if st["state"] != state:
+                            st["state"] = state
+                            st["since"] = now
+                    elif (
+                        pinc == st["inc"]
+                        and state == "suspect"
+                        and st["state"] == "alive"
+                    ):
+                        st["state"] = "suspect"
+                        st["since"] = now
                     if st["info"] != info:
                         # peer restarted with new service addresses
                         st["info"] = info
@@ -327,13 +461,113 @@ class GossipPool:
         except Exception:
             return  # malformed/hostile datagrams must never escape
 
+    def _receive_probe(self, t: str, msg: dict, now: float) -> None:
+        """SWIM probe traffic: ping / ping-req / ack."""
+        sender = msg.get("from")
+        if not isinstance(sender, str) or not sender:
+            return
+        # any probe datagram FROM an address proves that address is alive:
+        # clear its tombstone (fast rejoin) and refresh liveness
+        self._tombs.pop(sender, None)
+        st = self._peers.get(sender)
+        if st is not None:
+            st["seen"] = now
+        if t == "ping":
+            # reply to the probe origin (direct probes set reply_to=from;
+            # an indirect probe carries the ORIGIN so the ack proves
+            # liveness where it matters)
+            reply_to = str(msg.get("reply_to") or sender)
+            ack = self._json.dumps(
+                {"t": "ack", "from": self.advertise,
+                 "seq": msg.get("seq"), "inc": self._inc}
+            ).encode()
+            self._sendto(ack, reply_to)
+        elif t == "ping-req":
+            target = msg.get("target")
+            if isinstance(target, str) and target:
+                ping = self._json.dumps(
+                    {"t": "ping", "from": self.advertise,
+                     "seq": msg.get("seq"), "reply_to": sender}
+                ).encode()
+                self._sendto(ping, target)
+        elif t == "ack":
+            if st is not None and st["state"] == "suspect":
+                # direct proof of life refutes local suspicion
+                st["state"] = "alive"
+                st["since"] = now
+            if self._probe is not None and self._probe[0] == sender:
+                self._acked.add(int(msg.get("seq", -1) or -1))
+
     async def _loop(self) -> None:
+        import math as _math
         import time as _time
 
         while self._running:
             await asyncio.sleep(self.interval_s)
             now = _time.monotonic()
-            # expire silent peers
+            changed = False
+
+            # --- SWIM failure detector ---------------------------------
+            # resolve last round's probe
+            if self._probe is not None:
+                addr, seq, stage = self._probe
+                st = self._peers.get(addr)
+                if seq in self._acked or st is None:
+                    self._probe = None
+                elif stage == "direct":
+                    # no direct ack: ask indirect_probes members to ping
+                    # the target on our behalf (memberlist.go:160-187)
+                    proxies = [
+                        a for a in self._peers
+                        if a not in (self.advertise, addr)
+                    ]
+                    req = self._json.dumps(
+                        {"t": "ping-req", "from": self.advertise,
+                         "seq": seq, "target": addr}
+                    ).encode()
+                    for p in self._random.sample(
+                        proxies, min(self.indirect_probes, len(proxies))
+                    ):
+                        self._sendto(req, p)
+                    self._probe = (addr, seq, "indirect")
+                else:
+                    # direct AND indirect rounds failed: suspect
+                    if st["state"] == "alive":
+                        st["state"] = "suspect"
+                        st["since"] = now
+                    self._probe = None
+            # suspicion timeout -> dead (+ tombstone against stale views).
+            # The timeout scales with log(cluster size) — refutation has
+            # to travel via fanout gossip, which takes more rounds in a
+            # larger cluster (memberlist's suspicionMult * log(n) rule).
+            n_members = len(self._peers)
+            suspicion_s = self.suspicion_s * max(
+                1.0, _math.log10(max(n_members, 1)) + 1.0
+            )
+            for a, st in list(self._peers.items()):
+                if a == self.advertise:
+                    continue
+                if (
+                    st["state"] == "suspect"
+                    and now - st["since"] > suspicion_s
+                ):
+                    del self._peers[a]
+                    self._tombs[a] = {
+                        "inc": st["inc"], "until": now + self.tombstone_s,
+                        "died": now,
+                    }
+                    changed = True
+                elif st["state"] == "suspect":
+                    # a live suspect must get every chance to prove
+                    # itself before the timeout: dedicated re-probe each
+                    # round (the round-robin ring would take ~n rounds to
+                    # come back to it) — prevents flapping under one lost
+                    # probe round
+                    ping = self._json.dumps(
+                        {"t": "ping", "from": self.advertise, "seq": 0}
+                    ).encode()
+                    self._sendto(ping, a)
+            # freshness backstop + tombstone gc
             expired = [
                 a
                 for a, st in self._peers.items()
@@ -341,22 +575,32 @@ class GossipPool:
             ]
             for a in expired:
                 del self._peers[a]
-            if expired:
+                changed = True
+            for a in [a for a, tb in self._tombs.items() if now > tb["until"]]:
+                del self._tombs[a]
+            if changed:
                 self._push()
-            # gossip to a few random peers + seeds
-            targets = set(self.seeds)
-            others = [a for a in self._peers if a != self.advertise]
-            if others:
-                targets.update(
-                    self._random.sample(others, min(self.fanout, len(others)))
-                )
-            payload = self._encode()
-            for t in targets:
-                try:
-                    host, port = t.rsplit(":", 1)
-                    self._transport.sendto(payload, (host, int(port)))
-                except Exception:
-                    pass
+            # launch a new probe (round-robin over the membership)
+            if self._probe is None:
+                self._acked.clear()
+                self._probe_ring = [
+                    a for a in self._probe_ring if a in self._peers
+                ]
+                if not self._probe_ring:
+                    ring = [a for a in self._peers if a != self.advertise]
+                    self._random.shuffle(ring)
+                    self._probe_ring = ring
+                if self._probe_ring:
+                    addr = self._probe_ring.pop()
+                    self._seq += 1
+                    ping = self._json.dumps(
+                        {"t": "ping", "from": self.advertise, "seq": self._seq}
+                    ).encode()
+                    self._sendto(ping, addr)
+                    self._probe = (addr, self._seq, "direct")
+
+            # --- anti-entropy view gossip ------------------------------
+            self._gossip_out()
 
     def _push(self) -> None:
         members = sorted(

@@ -119,3 +119,134 @@ def test_gossip_discovered_daemon_cluster(loop_thread):
     finally:
         loop_thread.run(d0.close())
         loop_thread.run(d1.close())
+
+
+def test_swim_partition_detection_beats_freshness(loop_thread):
+    """A crashed peer is evicted in O(probe interval) by the SWIM
+    detector (ping -> ping-req -> suspect -> dead), long before the
+    freshness backstop (set absurdly high here) would fire."""
+
+    async def run():
+        pools = []
+        p0 = GossipPool(
+            "127.0.0.1:0", PeerInfo(grpc_address="g0:81"), lambda ps: None,
+            interval_s=0.1, expire_intervals=600, suspicion_intervals=3,
+        )
+        await p0._started
+        pools.append(p0)
+        for i in (1, 2):
+            p = GossipPool(
+                "127.0.0.1:0", PeerInfo(grpc_address=f"g{i}:81"),
+                lambda ps: None, seeds=[p0.advertise],
+                interval_s=0.1, expire_intervals=600, suspicion_intervals=3,
+            )
+            await p._started
+            pools.append(p)
+
+        want = {"g0:81", "g1:81", "g2:81"}
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all({p.grpc_address for p in pl.members()} == want for pl in pools):
+                break
+            await asyncio.sleep(0.05)
+        assert all({p.grpc_address for p in pl.members()} == want for pl in pools)
+
+        # crash node 2 (transport gone, no goodbye)
+        t_dead = time.monotonic()
+        pools[2].close()
+        survivors = {"g0:81", "g1:81"}
+        while time.monotonic() < t_dead + 10:
+            if all(
+                {p.grpc_address for p in pl.members()} == survivors
+                for pl in pools[:2]
+            ):
+                break
+            await asyncio.sleep(0.05)
+        detect_s = time.monotonic() - t_dead
+        for pl in pools[:2]:
+            assert {p.grpc_address for p in pl.members()} == survivors
+        # freshness backstop is 600*0.1 = 60s; SWIM must do it in a few
+        # probe rounds (direct + indirect + suspicion = ~5-6 intervals,
+        # generous CI slack)
+        assert detect_s < 5.0, f"SWIM detection took {detect_s:.1f}s"
+
+        # resurrection protection: a stale third-party view claiming the
+        # dead node alive at its old incarnation is discarded
+        stale = pools[0]._json.dumps({
+            "from": "203.0.113.9:9",
+            "peers": {
+                pools[2].advertise: {
+                    "grpc": "g2:81", "http": "", "dc": "",
+                    "age": 0, "state": "alive", "inc": 0,
+                }
+            },
+        }).encode()
+        pools[0]._receive(stale)
+        assert {p.grpc_address for p in pools[0].members()} == survivors
+
+        for pl in pools[:2]:
+            pl.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=30)
+
+
+def test_swim_suspicion_refuted_by_live_peer(loop_thread):
+    """A falsely-suspected live node bumps its incarnation and stays a
+    member (memberlist.go:214-233 refutation semantics)."""
+
+    async def run():
+        pools = []
+        p0 = GossipPool(
+            "127.0.0.1:0", PeerInfo(grpc_address="g0:81"), lambda ps: None,
+            interval_s=0.1, suspicion_intervals=4,
+        )
+        await p0._started
+        pools.append(p0)
+        for i in (1, 2):
+            p = GossipPool(
+                "127.0.0.1:0", PeerInfo(grpc_address=f"g{i}:81"),
+                lambda ps: None, seeds=[p0.advertise],
+                interval_s=0.1, suspicion_intervals=4,
+            )
+            await p._started
+            pools.append(p)
+
+        want = {"g0:81", "g1:81", "g2:81"}
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all({p.grpc_address for p in pl.members()} == want for pl in pools):
+                break
+            await asyncio.sleep(0.05)
+        assert all({p.grpc_address for p in pl.members()} == want for pl in pools)
+
+        # forge suspicion about the (live) node 2 into node 0 and 1
+        target = pools[2].advertise
+        inc0 = pools[2]._inc
+        forged = pools[0]._json.dumps({
+            "from": "203.0.113.9:9",
+            "peers": {
+                target: {
+                    "grpc": "g2:81", "http": "", "dc": "",
+                    "age": 0, "state": "suspect", "inc": inc0,
+                }
+            },
+        }).encode()
+        pools[0]._receive(forged)
+        pools[1]._receive(forged)
+        assert pools[0]._peers[target]["state"] == "suspect"
+
+        # node 2 must refute (bump incarnation) and remain a member well
+        # past the suspicion window
+        await asyncio.sleep(0.1 * 4 * 3)
+        for pl in pools:
+            assert {p.grpc_address for p in pl.members()} == want, (
+                "falsely-suspected node was evicted"
+            )
+        assert pools[2]._inc > inc0, "suspect never refuted"
+
+        for pl in pools:
+            pl.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=30)
