@@ -190,6 +190,17 @@ def _try_runner_relay(args, timeout_s: float = 2400.0) -> bool:
         return False
     if not st.startswith("READY"):
         return False
+    # READY can be stale: a runner wedged mid-job (dead tunnel RPC) never
+    # picks up new work. Live runners heartbeat their status file mtime
+    # every 30s (tools/tpu_runner.py) — including during long jobs, so a
+    # legitimately busy runner is not mistaken for a wedged one. A stale
+    # mtime (>3min) means the runner died or predates the heartbeat:
+    # fall back to the guarded child.
+    try:
+        if time.time() - os.path.getmtime(status) > 180:
+            return False
+    except OSError:
+        return False
     name = f"bench_{args.mode}_{args.layout}_{os.getpid()}"
     body = (
         "import sys, json\n"

@@ -1,0 +1,81 @@
+"""Edge-tier entry point: `python -m gubernator_tpu.cmd.edge`.
+
+A lightweight, jax-free process that terminates client gRPC and relays
+every call over framed RPC to a device daemon (service/edge.py). Run N
+of these per device daemon to scale the serving tier horizontally — the
+wire API is identical to the daemon's own gRPC listener, so clients and
+load balancers cannot tell edge from daemon.
+
+Env:
+    GUBER_GRPC_ADDRESS        listen address (default 127.0.0.1:81)
+    GUBER_EDGE_UPSTREAM       device daemon's GUBER_EDGE_LISTEN_ADDRESS
+                              (unix:///path or host:port; required)
+    GUBER_EDGE_CONNECTIONS    upstream connections (default 2)
+    GUBER_LOG_LEVEL
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="gubernator-tpu edge")
+    parser.add_argument("--debug", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else getattr(
+            logging, os.environ.get("GUBER_LOG_LEVEL", "info").upper(),
+            logging.INFO,
+        ),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    upstream = os.environ.get("GUBER_EDGE_UPSTREAM", "")
+    if not upstream:
+        raise SystemExit(
+            "GUBER_EDGE_UPSTREAM must point at a device daemon's "
+            "GUBER_EDGE_LISTEN_ADDRESS"
+        )
+    listen = os.environ.get("GUBER_GRPC_ADDRESS", "127.0.0.1:81")
+    n_conns = int(os.environ.get("GUBER_EDGE_CONNECTIONS", "2"))
+
+    async def run() -> None:
+        import grpc
+
+        from gubernator_tpu.service.edge import (
+            EdgeClient,
+            EdgeV1Servicer,
+            edge_v1_handler,
+        )
+
+        client = EdgeClient(upstream, connections=n_conns)
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers(
+            (edge_v1_handler(EdgeV1Servicer(client)),)
+        )
+        port = server.add_insecure_port(listen)
+        await server.start()
+        logging.info(
+            "gubernator-tpu edge listening on %s -> upstream %s",
+            listen.rsplit(":", 1)[0] + f":{port}", upstream,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logging.info("edge shutting down")
+        await server.stop(grace=0.5)
+        await client.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -234,6 +234,15 @@ class EngineBase:
         self._running = False
         self._queue.put(_STOP)
         self._thread.join(timeout=5)
+        # The bucket warmer compiles inside XLA C++ frames; if it is
+        # still alive when the interpreter finalizes, its GIL touch
+        # turns into pthread_exit's forced unwind through C++ catch(...)
+        # blocks — glibc aborts with "FATAL: exception not rethrown".
+        # _running=False stops it between shapes; join past the current
+        # compile.
+        warm = getattr(self, "_warm_thread", None)
+        if warm is not None and warm.is_alive():
+            warm.join(timeout=60)
 
     # -- pump ----------------------------------------------------------------
 
@@ -357,11 +366,13 @@ class DeviceEngine(EngineBase):
         # thread; readers iterate whatever snapshot they observe (mutating
         # a shared set mid-iteration can raise in the reader).
         self._warm_shapes = (config.batch_size,)
+        self._warm_thread = None
         if config.fast_buckets:
-            threading.Thread(
+            self._warm_thread = threading.Thread(
                 target=self._warm_buckets, name="gubernator-warm-buckets",
                 daemon=True,
-            ).start()
+            )
+            self._warm_thread.start()
 
     def _warm_buckets(self) -> None:
         """Compile decide at each power-of-two width below batch_size

@@ -120,6 +120,14 @@ class DaemonConfig:
     # daemon.go:305-333). Only meaningful with TLS+mTLS configured.
     status_http_listen_address: str = ""
 
+    # Edge-tier listener (GUBER_EDGE_LISTEN_ADDRESS): framed-RPC address
+    # (unix:///path or host:port) where gubernator-tpu-edge processes
+    # relay client calls (service/edge.py). Empty = disabled. No
+    # reference analog — the edge tier is the TPU-native scale-out of
+    # the serving path (the chip-owning process is singular; gRPC
+    # termination scales horizontally).
+    edge_listen_address: str = ""
+
     # Span verbosity: ERROR | INFO | DEBUG (reference GUBER_TRACING_LEVEL,
     # config.go:717-752 — INFO drops noisy per-peer/healthcheck spans).
     trace_level: str = "INFO"

@@ -171,6 +171,16 @@ class Daemon:
             sactual = ssite._server.sockets[0].getsockname()
             self.status_address = f"{shost}:{sactual[1]}"
 
+        # Edge-tier listener: gubernator-tpu-edge processes relay client
+        # calls here over framed RPC (service/edge.py) — same serving
+        # core as the gRPC listener, minus the gRPC server cost.
+        self.edge_listener = None
+        if conf.edge_listen_address:
+            from gubernator_tpu.service.edge import EdgeListener
+
+            self.edge_listener = EdgeListener(self.svc, conf.edge_listen_address)
+            await self.edge_listener.start()
+
         self.svc.local_info = PeerInfo(
             grpc_address=advertise,
             http_address=self.http_address,
@@ -281,6 +291,8 @@ class Daemon:
             save_engine(self.engine, self.conf.loader)
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
+        if getattr(self, "edge_listener", None) is not None:
+            await self.edge_listener.close()
         if self.svc is not None and self.svc.global_mgr is not None:
             await self.svc.global_mgr.close()
         if self.svc is not None and getattr(self.svc, "region_mgr", None) is not None:

@@ -1,0 +1,342 @@
+"""Disaggregated serving edge: framed RPC between edge processes and
+the device daemon.
+
+TPU-native scale-out of the serving tier (SURVEY.md §2.3 sharding row;
+docs/benchmarks.md round-2/3 edge analysis): the chip — and the one
+process owning its HBM slot table — is the scarce resource, while gRPC
+/ HTTP2 / TLS termination and the native wire parse are horizontally
+scalable host work. N `gubernator-tpu-edge` processes terminate client
+gRPC and relay each call over a length-prefixed stream (unix socket or
+TCP, usually loopback) to the device daemon, which serves it through
+the SAME core as its own gRPC listener
+(grpc_service.serve_get_rate_limits_bytes: columnar fast path,
+mixed-ownership splitting, object-path fallback) minus the gRPC server
+cost. The reference scales by adding whole nodes to the peer mesh
+(reference README.md:129-139); this splits a node into a device tier
+and an edge tier instead — the edge speaks the identical V1 wire API,
+so reference clients cannot tell the difference.
+
+Frame format (little-endian):
+    request:  u32 frame_len | u8 method | u64 call_id | payload
+    response: u32 frame_len | u8 status | u64 call_id | payload
+methods: 1 = V1/GetRateLimits (payload = GetRateLimitsReq bytes)
+         2 = V1/HealthCheck   (payload ignored)
+status:  0 = ok    (payload = response message bytes)
+         1 = error (payload = u8 code_len | grpc-code-name | utf-8 message)
+Responses are matched by call_id and may arrive out of order (the
+listener serves frames concurrently; a slow mixed-ownership call does
+not head-of-line-block a columnar one on the same connection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import struct
+from typing import Optional, Tuple
+
+log = logging.getLogger("gubernator_tpu.edge")
+
+METHOD_GET_RATE_LIMITS = 1
+METHOD_HEALTH_CHECK = 2
+
+_HDR = struct.Struct("<IBQ")  # frame_len (of method..payload) | method | call_id
+MAX_FRAME = 8 << 20  # a 1000-item batch is ~100KB; 8MB is generous
+
+
+class EdgeError(Exception):
+    """Transported whole-call failure (grpc code name + message)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _pack(method_or_status: int, call_id: int, payload: bytes) -> bytes:
+    return _HDR.pack(9 + len(payload), method_or_status, call_id) + payload
+
+
+async def _read_frame(reader) -> Optional[Tuple[int, int, bytes]]:
+    """Returns (method_or_status, call_id, payload) or None on EOF."""
+    try:
+        hdr = await reader.readexactly(4)
+        (flen,) = struct.unpack("<I", hdr)
+        if flen < 9 or flen > MAX_FRAME:
+            raise ValueError(f"bad frame length {flen}")
+        body = await reader.readexactly(flen)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None  # peer died mid-frame: same as EOF
+    tag, call_id = struct.unpack("<BQ", body[:9])
+    return tag, call_id, body[9:]
+
+
+def _split_address(address: str) -> Tuple[bool, str, int]:
+    """(is_unix, path_or_host, port). unix:///path, /path, or host:port."""
+    if address.startswith("unix://"):
+        return True, address[len("unix://"):], 0
+    if address.startswith("/"):
+        return True, address, 0
+    host, port = address.rsplit(":", 1)
+    return False, host.strip("[]"), int(port)
+
+
+# ---- device-daemon side ----------------------------------------------------
+
+
+class EdgeListener:
+    """Accepts edge-process connections inside the device daemon and
+    serves frames through the daemon's V1 core."""
+
+    def __init__(self, svc, address: str):
+        self.svc = svc
+        self.address = address
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+
+    async def start(self) -> None:
+        is_unix, host, port = _split_address(self.address)
+        if is_unix:
+            # asyncio never removes the socket file; a stale one from a
+            # previous daemon (clean exit or crash) would EADDRINUSE
+            import contextlib
+            import os
+
+            with contextlib.suppress(OSError):
+                os.unlink(host)
+            self._server = await asyncio.start_unix_server(self._conn, path=host)
+        else:
+            self._server = await asyncio.start_server(self._conn, host, port)
+        log.info("edge listener on %s", self.address)
+
+    @property
+    def bound_address(self) -> str:
+        if self.address.startswith(("unix://", "/")):
+            return self.address
+        sock = self._server.sockets[0]
+        host, port = sock.getsockname()[:2]
+        return f"{host}:{port}"
+
+    async def _conn(self, reader, writer) -> None:
+        wlock = asyncio.Lock()  # frame writes must not interleave
+        tasks = set()
+        self._writers.add(writer)
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                t = asyncio.ensure_future(self._serve(frame, writer, wlock))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+        except (ValueError, ConnectionResetError) as e:
+            log.warning("edge connection dropped: %s", e)
+        finally:
+            for t in tasks:
+                t.cancel()
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _serve(self, frame, writer, wlock) -> None:
+        from gubernator_tpu.service import pb
+        from gubernator_tpu.service.grpc_service import (
+            serve_get_rate_limits_bytes,
+        )
+        from gubernator_tpu.service.server import ApiError
+
+        from gubernator_tpu.service.grpc_service import _instrumented
+
+        method, call_id, payload = frame
+        try:
+            # Same instrumentation labels as the gRPC listener: in an
+            # all-edge deployment the daemon's request count/duration
+            # metrics must still see the traffic.
+            if method == METHOD_GET_RATE_LIMITS:
+                async with _instrumented(
+                    self.svc.metrics, "/pb.gubernator.V1/GetRateLimits"
+                ):
+                    out = await serve_get_rate_limits_bytes(self.svc, payload)
+            elif method == METHOD_HEALTH_CHECK:
+                async with _instrumented(
+                    self.svc.metrics, "/pb.gubernator.V1/HealthCheck"
+                ):
+                    out = pb.health_to_pb(
+                        await self.svc.health_check()
+                    ).SerializeToString()
+            else:
+                raise ApiError(f"unknown edge method {method}", grpc_code="INTERNAL")
+            resp = _pack(0, call_id, out)
+        except ApiError as e:
+            code = e.grpc_code.encode()
+            resp = _pack(
+                1, call_id, bytes([len(code)]) + code + str(e).encode()
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            msg = f"edge serve failure: {e}".encode()
+            resp = _pack(1, call_id, bytes([8]) + b"INTERNAL" + msg)
+        try:
+            async with wlock:
+                writer.write(resp)
+                await writer.drain()
+        except (ConnectionResetError, RuntimeError):
+            pass  # edge went away; its client sees the broken channel
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # server.close() only stops ACCEPTING; close live connections so
+        # edges see EOF now (and so 3.12's wait_closed — which waits for
+        # connection handlers — can finish)
+        for w in list(self._writers):
+            w.close()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+
+# ---- edge-process side -----------------------------------------------------
+
+
+class EdgeClient:
+    """Multiplexed client: N connections to the device daemon, calls
+    matched to responses by call_id. Reconnects lazily on failure."""
+
+    def __init__(self, address: str, connections: int = 2):
+        self.address = address
+        self._n = max(1, connections)
+        self._conns: list = [None] * self._n
+        self._locks = [asyncio.Lock() for _ in range(self._n)]
+        self._rr = itertools.count()
+        self._ids = itertools.count(1)
+        self._pending: dict = {}
+
+    async def _connect(self, i: int):
+        is_unix, host, port = _split_address(self.address)
+        if is_unix:
+            reader, writer = await asyncio.open_unix_connection(host)
+        else:
+            reader, writer = await asyncio.open_connection(host, port)
+        conn = {"reader": reader, "writer": writer, "wlock": asyncio.Lock()}
+        conn["pump"] = asyncio.ensure_future(self._pump(conn))
+        self._conns[i] = conn
+        return conn
+
+    async def _pump(self, conn) -> None:
+        try:
+            while True:
+                frame = await _read_frame(conn["reader"])
+                if frame is None:
+                    break
+                status, call_id, payload = frame
+                fut = self._pending.pop(call_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result((status, payload))
+        except Exception as e:
+            log.warning("edge upstream read failed: %s", e)
+        finally:
+            conn["dead"] = True
+            # fail whatever was in flight on this connection
+            for call_id in list(conn.get("calls", ())):
+                fut = self._pending.pop(call_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(
+                        EdgeError("UNAVAILABLE", "device daemon connection lost")
+                    )
+
+    async def call(self, method: int, payload: bytes, timeout: float = 30.0) -> bytes:
+        i = next(self._rr) % self._n
+        async with self._locks[i]:
+            conn = self._conns[i]
+            if conn is None or conn.get("dead"):
+                try:
+                    conn = await self._connect(i)
+                except OSError as e:
+                    raise EdgeError("UNAVAILABLE", f"device daemon unreachable: {e}")
+        call_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[call_id] = fut
+        conn.setdefault("calls", set()).add(call_id)
+        try:
+            # Re-check AFTER registration: a pump that died in the gap
+            # has already snapshotted conn["calls"] without this id, so
+            # nobody would ever fail the future.
+            if conn.get("dead"):
+                raise EdgeError("UNAVAILABLE", "device daemon connection lost")
+            async with conn["wlock"]:
+                conn["writer"].write(_pack(method, call_id, payload))
+                await conn["writer"].drain()
+            status, resp = await asyncio.wait_for(fut, timeout)
+        except EdgeError:
+            raise
+        except (OSError, ConnectionResetError) as e:
+            conn["dead"] = True
+            raise EdgeError("UNAVAILABLE", f"device daemon connection lost: {e}")
+        except asyncio.TimeoutError:
+            raise EdgeError("DEADLINE_EXCEEDED", "device daemon call timed out")
+        finally:
+            # no-op on the happy path (the pump pops before resolving);
+            # guarantees no leak on timeout/cancellation/errors
+            self._pending.pop(call_id, None)
+            conn.get("calls", set()).discard(call_id)
+        if status == 0:
+            return resp
+        code_len = resp[0]
+        code = resp[1 : 1 + code_len].decode("ascii", errors="replace")
+        raise EdgeError(code, resp[1 + code_len :].decode("utf-8", errors="replace"))
+
+    async def close(self) -> None:
+        for conn in self._conns:
+            if conn is not None:
+                conn["pump"].cancel()
+                conn["writer"].close()
+        self._conns = [None] * self._n
+
+
+class EdgeV1Servicer:
+    """grpc.aio servicer for the edge process: relays raw bytes."""
+
+    def __init__(self, client: EdgeClient):
+        self.client = client
+
+    async def GetRateLimits(self, request_bytes, context):
+        import grpc
+
+        try:
+            return await self.client.call(METHOD_GET_RATE_LIMITS, request_bytes)
+        except EdgeError as e:
+            await context.abort(
+                getattr(grpc.StatusCode, e.code, grpc.StatusCode.INTERNAL), str(e)
+            )
+
+    async def HealthCheck(self, request_bytes, context):
+        import grpc
+
+        try:
+            return await self.client.call(METHOD_HEALTH_CHECK, b"")
+        except EdgeError as e:
+            await context.abort(
+                getattr(grpc.StatusCode, e.code, grpc.StatusCode.INTERNAL), str(e)
+            )
+
+
+def edge_v1_handler(servicer) -> "grpc.GenericRpcHandler":  # noqa: F821
+    """V1 service handler with identity (de)serializers on BOTH methods
+    — the edge never parses messages, it relays bytes."""
+    import grpc
+
+    return grpc.method_handlers_generic_handler(
+        "pb.gubernator.V1",
+        {
+            "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetRateLimits,
+                request_deserializer=None,
+                response_serializer=None,
+            ),
+            "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                servicer.HealthCheck,
+                request_deserializer=None,
+                response_serializer=None,
+            ),
+        },
+    )

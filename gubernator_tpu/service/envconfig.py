@@ -106,6 +106,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "127.0.0.1:81"),
         http_listen_address=_env("GUBER_HTTP_ADDRESS", "127.0.0.1:80"),
         status_http_listen_address=_env("GUBER_STATUS_HTTP_ADDRESS", ""),
+        edge_listen_address=_env("GUBER_EDGE_LISTEN_ADDRESS", ""),
         advertise_address=_env("GUBER_ADVERTISE_ADDRESS", ""),
         data_center=_env("GUBER_DATA_CENTER", ""),
         cache_size=_env_int("GUBER_CACHE_SIZE", 50_000),

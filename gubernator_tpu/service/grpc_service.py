@@ -38,6 +38,47 @@ async def _abort(context, e: ApiError):
     await context.abort(_GRPC_CODES.get(e.grpc_code, grpc.StatusCode.INTERNAL), str(e))
 
 
+async def serve_get_rate_limits_bytes(svc: V1Service, request_bytes) -> bytes:
+    """The V1/GetRateLimits serving core over raw wire bytes, shared by
+    the gRPC servicer and the edge-tier listener (service/edge.py) so
+    both transports have identical semantics. Raises ApiError for
+    whole-call failures (the caller maps it to its transport's status)."""
+    from gubernator_tpu.service import fastpath
+
+    if fastpath.enabled(svc):
+        # Executor keeps the event loop responsive while the
+        # kernel runs (the C parse and the jitted decide release
+        # the GIL, so calls genuinely overlap).
+        res = await asyncio.get_running_loop().run_in_executor(
+            None, fastpath.try_serve, svc, request_bytes, False
+        )
+        if isinstance(res, bytes):
+            return res
+        if res is not None:  # mixed ownership: forward the rest
+            _, n, local_pos, local_out, nl_reqs = res
+            # Local hits are already committed — a forwarding
+            # failure must degrade the REMOTE items to per-item
+            # errors, never fail the RPC (a client retry would
+            # double-charge every local key).
+            from gubernator_tpu.api.types import RateLimitResp
+
+            try:
+                nl_resps = await svc.get_rate_limits(nl_reqs)
+            except Exception as e:
+                nl_resps = [RateLimitResp(error=str(e)) for _ in nl_reqs]
+            return fastpath.merge_mixed(n, local_pos, local_out, nl_resps)
+    try:
+        request = pb.pb.GetRateLimitsReq.FromString(request_bytes)
+    except Exception:
+        raise ApiError("malformed request", grpc_code="INVALID_ARGUMENT")
+    reqs = [pb.req_from_pb(r) for r in request.requests]
+    out = await svc.get_rate_limits(reqs)
+    resp = pb.pb.GetRateLimitsResp()
+    for r in out:
+        resp.responses.append(pb.resp_to_pb(r))
+    return resp.SerializeToString()
+
+
 class V1Servicer:
     """GetRateLimits runs in BYTES mode (identity deserializer): the
     columnar fast path serves eligible calls without building a single
@@ -46,53 +87,13 @@ class V1Servicer:
 
     def __init__(self, svc: V1Service):
         self.svc = svc
-        from gubernator_tpu.service import fastpath
-
-        self._fast = fastpath
 
     async def GetRateLimits(self, request_bytes, context):
         async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/GetRateLimits"):
-            if self._fast.enabled(self.svc):
-                # Executor keeps the event loop responsive while the
-                # kernel runs (the C parse and the jitted decide release
-                # the GIL, so calls genuinely overlap).
-                res = await asyncio.get_running_loop().run_in_executor(
-                    None, self._fast.try_serve, self.svc, request_bytes, False
-                )
-                if isinstance(res, bytes):
-                    return res
-                if res is not None:  # mixed ownership: forward the rest
-                    _, n, local_pos, local_out, nl_reqs = res
-                    # Local hits are already committed — a forwarding
-                    # failure must degrade the REMOTE items to per-item
-                    # errors, never fail the RPC (a client retry would
-                    # double-charge every local key).
-                    from gubernator_tpu.api.types import RateLimitResp
-
-                    try:
-                        nl_resps = await self.svc.get_rate_limits(nl_reqs)
-                    except Exception as e:
-                        nl_resps = [
-                            RateLimitResp(error=str(e)) for _ in nl_reqs
-                        ]
-                    return self._fast.merge_mixed(
-                        n, local_pos, local_out, nl_resps
-                    )
             try:
-                request = pb.pb.GetRateLimitsReq.FromString(request_bytes)
-            except Exception:
-                await context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT, "malformed request"
-                )
-            reqs = [pb.req_from_pb(r) for r in request.requests]
-            try:
-                out = await self.svc.get_rate_limits(reqs)
+                return await serve_get_rate_limits_bytes(self.svc, request_bytes)
             except ApiError as e:
                 await _abort(context, e)
-            resp = pb.pb.GetRateLimitsResp()
-            for r in out:
-                resp.responses.append(pb.resp_to_pb(r))
-            return resp.SerializeToString()
 
     async def HealthCheck(self, request, context):
         async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/HealthCheck"):

@@ -1,0 +1,318 @@
+"""Edge tier (service/edge.py): framed RPC between edge processes and
+the device daemon — equivalence with direct gRPC, error mapping,
+concurrency, upstream loss, and a real gubernator-tpu-edge process."""
+
+import asyncio
+import os
+import struct
+
+import grpc
+import pytest
+
+from gubernator_tpu.api.types import Behavior
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+from gubernator_tpu.service.edge import (
+    METHOD_GET_RATE_LIMITS,
+    METHOD_HEALTH_CHECK,
+    EdgeClient,
+    EdgeError,
+    EdgeV1Servicer,
+    edge_v1_handler,
+)
+from gubernator_tpu.service.rpc import V1Stub
+
+
+def _req(key: str, hits: int = 1, limit: int = 10, behavior: int = 0):
+    msg = pb.pb.GetRateLimitsReq()
+    r = msg.requests.add()
+    r.name = "edge"
+    r.unique_key = key
+    r.hits = hits
+    r.limit = limit
+    r.duration = 60_000
+    r.behavior = behavior
+    return msg
+
+
+def _req_bytes(key: str, hits: int = 1, limit: int = 10, behavior: int = 0) -> bytes:
+    return _req(key, hits, limit, behavior).SerializeToString()
+
+
+def _resps(resp):
+    if isinstance(resp, (bytes, bytearray)):
+        resp = pb.pb.GetRateLimitsResp.FromString(resp)
+    return list(resp.responses)
+
+
+@pytest.fixture
+def edge_cluster(loop_thread, tmp_path):
+    """Device daemon with an edge listener + an in-process edge gRPC
+    server relaying to it."""
+    sock = f"unix://{tmp_path}/edge.sock"
+    state = {}
+
+    async def start():
+        d = await Daemon.spawn(
+            DaemonConfig(
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                edge_listen_address=sock,
+            )
+        )
+        client = EdgeClient(sock, connections=2)
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers(
+            (edge_v1_handler(EdgeV1Servicer(client)),)
+        )
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        state.update(
+            daemon=d, client=client, server=server,
+            edge_addr=f"127.0.0.1:{port}",
+        )
+        return state
+
+    async def stop():
+        await state["server"].stop(grace=0.2)
+        await state["client"].close()
+        await state["daemon"].close()
+
+    loop_thread.run(start(), timeout=60)
+    yield state
+    loop_thread.run(stop(), timeout=30)
+
+
+def test_edge_serves_and_matches_direct(edge_cluster, loop_thread):
+    """The same traffic through the edge and through the daemon's own
+    gRPC port hits ONE shared counter and matches shapes."""
+
+    async def run():
+        st = edge_cluster
+        edge_ch = grpc.aio.insecure_channel(st["edge_addr"])
+        direct_ch = grpc.aio.insecure_channel(st["daemon"].grpc_address)
+        edge, direct = V1Stub(edge_ch), V1Stub(direct_ch)
+
+        r1 = _resps(await edge.get_rate_limits(_req("k1", hits=3)))
+        assert r1[0].error == "" and r1[0].remaining == 7
+        # direct call continues the same counter: one table, two fronts
+        r2 = _resps(await direct.get_rate_limits(_req("k1", hits=2)))
+        assert r2[0].remaining == 5
+        r3 = _resps(await edge.get_rate_limits(_req("k1", hits=0)))
+        assert r3[0].remaining == 5
+
+        # health through the edge
+        h = await edge.health_check(pb.pb.HealthCheckReq())
+        assert h.status == "healthy"
+
+        # NO_BATCHING + a big-ish batch through the edge
+        msg = pb.pb.GetRateLimitsReq()
+        for i in range(500):
+            r = msg.requests.add()
+            r.name = "edge"
+            r.unique_key = f"bulk{i}"
+            r.hits = 1
+            r.limit = 100
+            r.duration = 60_000
+        out = _resps(await edge.get_rate_limits(msg))
+        assert len(out) == 500
+        assert all(o.error == "" and o.remaining == 99 for o in out)
+
+        await edge_ch.close()
+        await direct_ch.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=60)
+
+
+def test_edge_error_mapping(edge_cluster, loop_thread):
+    """Whole-call failures map to the same gRPC codes as the direct
+    listener (OUT_OF_RANGE for oversize, INVALID_ARGUMENT for
+    malformed)."""
+
+    async def run():
+        st = edge_cluster
+        ch = grpc.aio.insecure_channel(st["edge_addr"])
+        stub = V1Stub(ch)
+
+        msg = pb.pb.GetRateLimitsReq()
+        for i in range(1001):
+            r = msg.requests.add()
+            r.name = "n"
+            r.unique_key = f"k{i}"
+            r.hits = 1
+            r.limit = 10
+            r.duration = 60_000
+        try:
+            await stub.get_rate_limits(msg)
+            raise AssertionError("oversize batch must fail")
+        except grpc.aio.AioRpcError as e:
+            assert e.code() == grpc.StatusCode.OUT_OF_RANGE
+
+        raw = ch.unary_unary("/pb.gubernator.V1/GetRateLimits")
+        try:
+            await raw(b"\xff\xff\xff\xff")
+            raise AssertionError("malformed must fail")
+        except grpc.aio.AioRpcError as e:
+            assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        # per-item validation errors stay per-item (not call failures)
+        out = _resps(await stub.get_rate_limits(_req("")))
+        assert "cannot be empty" in out[0].error
+
+        await ch.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=60)
+
+
+def test_edge_concurrent_calls_multiplex(edge_cluster, loop_thread):
+    """Many concurrent calls over the shared connections come back
+    matched to their call ids (distinct keys -> distinct counters)."""
+
+    async def run():
+        st = edge_cluster
+        ch = grpc.aio.insecure_channel(st["edge_addr"])
+        stub = V1Stub(ch)
+
+        async def one(i):
+            out = _resps(
+                await stub.get_rate_limits(
+                    _req(f"mux{i}", hits=i % 7, limit=100)
+                )
+            )
+            assert out[0].error == ""
+            assert out[0].remaining == 100 - (i % 7), (i, out[0].remaining)
+
+        await asyncio.gather(*(one(i) for i in range(80)))
+        await ch.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=60)
+
+
+def test_edge_upstream_loss_maps_unavailable(loop_thread, tmp_path):
+    """Killing the device daemon turns edge calls into UNAVAILABLE, and
+    a restarted daemon on the same socket heals the edge without an
+    edge restart (lazy reconnect)."""
+    sock = f"unix://{tmp_path}/edge2.sock"
+
+    async def run():
+        conf = DaemonConfig(
+            grpc_listen_address="127.0.0.1:0",
+            http_listen_address="127.0.0.1:0",
+            edge_listen_address=sock,
+        )
+        d = await Daemon.spawn(conf)
+        client = EdgeClient(sock, connections=1)
+        out = await client.call(METHOD_GET_RATE_LIMITS, _req_bytes("up1"))
+        assert _resps(out)[0].remaining == 9
+        h = await client.call(METHOD_HEALTH_CHECK, b"")
+        assert pb.pb.HealthCheckResp.FromString(h).status == "healthy"
+
+        await d.close()
+        os.unlink(f"{tmp_path}/edge2.sock")
+        try:
+            await client.call(METHOD_GET_RATE_LIMITS, _req_bytes("up2"))
+            raise AssertionError("must fail with daemon down")
+        except EdgeError as e:
+            assert e.code in ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+        d2 = await Daemon.spawn(conf)
+        out = await client.call(METHOD_GET_RATE_LIMITS, _req_bytes("up3"))
+        assert _resps(out)[0].remaining == 9
+        await client.close()
+        await d2.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=90)
+
+
+def test_edge_rejects_garbage_frames(edge_cluster, loop_thread):
+    """A hostile/broken connection (bad frame length) is dropped without
+    taking the listener down for other connections."""
+
+    async def run():
+        st = edge_cluster
+        path = st["daemon"].conf.edge_listen_address[len("unix://"):]
+        reader, writer = await asyncio.open_unix_connection(path)
+        writer.write(struct.pack("<I", 0xFFFFFFFF))  # absurd frame length
+        await writer.drain()
+        assert await reader.read(64) == b""  # listener closed us
+        writer.close()
+
+        # other connections still served
+        client = EdgeClient(st["daemon"].conf.edge_listen_address)
+        out = await client.call(METHOD_GET_RATE_LIMITS, _req_bytes("after-garbage"))
+        assert _resps(out)[0].error == ""
+        await client.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=60)
+
+
+def test_edge_process_end_to_end(edge_cluster, loop_thread):
+    """A real gubernator-tpu-edge PROCESS (jax-free) in front of the
+    daemon serves the full wire API."""
+    import subprocess
+    import sys
+    import time as _time
+
+    st = edge_cluster
+
+    env = dict(os.environ)
+    env.update(
+        GUBER_EDGE_UPSTREAM=st["daemon"].conf.edge_listen_address,
+        GUBER_GRPC_ADDRESS="127.0.0.1:0",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.edge"],
+        env=env, cwd="/root/repo",
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # scrape the bound port from the startup log line
+        port = None
+        deadline = _time.time() + 20
+        while _time.time() < deadline and port is None:
+            line = proc.stdout.readline()
+            if "edge listening on" in line:
+                port = int(line.split("listening on ")[1].split(" ")[0].rsplit(":", 1)[1])
+        assert port, "edge process never reported its port"
+
+        async def run():
+            ch = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            stub = V1Stub(ch)
+            out = _resps(await stub.get_rate_limits(_req("proc", hits=4)))
+            assert out[0].error == "" and out[0].remaining == 6
+            h = await stub.health_check(pb.pb.HealthCheckReq())
+            assert h.status == "healthy"
+            await ch.close()
+            return True
+
+        assert loop_thread.run(run(), timeout=30)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_edge_global_and_mixed_still_work(edge_cluster, loop_thread):
+    """Behaviors that fall back to the object path inside the daemon
+    (GLOBAL) serve correctly through the edge — the edge is
+    policy-free."""
+
+    async def run():
+        st = edge_cluster
+        ch = grpc.aio.insecure_channel(st["edge_addr"])
+        stub = V1Stub(ch)
+        out = _resps(
+            await stub.get_rate_limits(
+                _req("glob", hits=2, behavior=int(Behavior.GLOBAL))
+            )
+        )
+        assert out[0].error == "" and out[0].remaining == 8
+        await ch.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=60)

@@ -47,6 +47,22 @@ def main() -> int:
     put_status(f"READY {plat} n={len(devs)} claim={time.time() - t0:.1f}s")
     print(f"claimed {plat} x{len(devs)} in {time.time() - t0:.1f}s", flush=True)
 
+    # Heartbeat: touch the status file every 30s from a side thread —
+    # ALSO while a job executes. Consumers (bench.py's runner relay)
+    # treat a stale mtime as "runner wedged on a dead tunnel RPC" and
+    # fall back, so the heartbeat must only stop if this process dies.
+    import threading
+
+    def beat() -> None:
+        while True:
+            time.sleep(30)
+            try:
+                os.utime(status, None)
+            except OSError:
+                return
+
+    threading.Thread(target=beat, daemon=True).start()
+
     env: dict = {"__name__": "__tpu_job__"}
     while True:
         if os.path.exists(os.path.join(JOBS, "STOP")):
