@@ -7,7 +7,8 @@ wire API is identical to the daemon's own gRPC listener, so clients and
 load balancers cannot tell edge from daemon.
 
 Env:
-    GUBER_GRPC_ADDRESS        listen address (default 127.0.0.1:81)
+    GUBER_GRPC_ADDRESS        gRPC listen address (default 127.0.0.1:81)
+    GUBER_HTTP_ADDRESS        HTTP/JSON listen address ("" = disabled)
     GUBER_EDGE_UPSTREAM       device daemon's GUBER_EDGE_LISTEN_ADDRESS
                               (unix:///path or host:port; required)
     GUBER_EDGE_CONNECTIONS    upstream connections (default 2)
@@ -43,6 +44,7 @@ def main() -> None:
             "GUBER_EDGE_LISTEN_ADDRESS"
         )
     listen = os.environ.get("GUBER_GRPC_ADDRESS", "127.0.0.1:81")
+    http_listen = os.environ.get("GUBER_HTTP_ADDRESS", "")
     n_conns = int(os.environ.get("GUBER_EDGE_CONNECTIONS", "2"))
 
     async def run() -> None:
@@ -51,6 +53,7 @@ def main() -> None:
         from gubernator_tpu.service.edge import (
             EdgeClient,
             EdgeV1Servicer,
+            build_edge_app,
             edge_v1_handler,
         )
 
@@ -61,6 +64,17 @@ def main() -> None:
         )
         port = server.add_insecure_port(listen)
         await server.start()
+        http_runner = None
+        if http_listen:
+            from aiohttp import web
+
+            http_runner = web.AppRunner(build_edge_app(client))
+            await http_runner.setup()
+            hhost, hport = http_listen.rsplit(":", 1)
+            site = web.TCPSite(http_runner, hhost, int(hport))
+            await site.start()
+            hactual = site._server.sockets[0].getsockname()
+            logging.info("edge http listening on %s:%s", hhost, hactual[1])
         logging.info(
             "gubernator-tpu edge listening on %s -> upstream %s",
             listen.rsplit(":", 1)[0] + f":{port}", upstream,
@@ -72,6 +86,8 @@ def main() -> None:
         await stop.wait()
         logging.info("edge shutting down")
         await server.stop(grace=0.5)
+        if http_runner is not None:
+            await http_runner.cleanup()
         await client.close()
 
     asyncio.run(run())

@@ -320,6 +320,91 @@ class EdgeV1Servicer:
             )
 
 
+_EDGE_HTTP_CODES = {
+    "INVALID_ARGUMENT": 400,
+    "OUT_OF_RANGE": 400,
+    "UNAVAILABLE": 503,
+    "DEADLINE_EXCEEDED": 504,
+}
+_EDGE_JSON_CODES = {  # gRPC status numbers for the JSON error body
+    "INVALID_ARGUMENT": 3,
+    "DEADLINE_EXCEEDED": 4,
+    "OUT_OF_RANGE": 11,
+    "INTERNAL": 13,
+    "UNAVAILABLE": 14,
+}
+
+
+def build_edge_app(client: EdgeClient):
+    """aiohttp app mirroring the daemon's HTTP/JSON gateway
+    (service/gateway.py) over the framed upstream — the edge presents
+    the daemon's full client-facing surface (gRPC + JSON + /healthz)."""
+    from aiohttp import web
+
+    from gubernator_tpu.service import pb
+    from gubernator_tpu.service.gateway import read_json_requests
+
+    app = web.Application()
+
+    def _edge_err(e: EdgeError) -> web.Response:
+        return web.json_response(
+            {"code": _EDGE_JSON_CODES.get(e.code, 13), "message": str(e)},
+            status=_EDGE_HTTP_CODES.get(e.code, 500),
+        )
+
+    async def get_rate_limits(request: web.Request) -> web.Response:
+        reqs, err = await read_json_requests(request)
+        if err is not None:
+            return err
+        msg = pb.pb.GetRateLimitsReq()
+        for r in reqs:
+            msg.requests.append(pb.req_to_pb(r))
+        try:
+            raw = await client.call(
+                METHOD_GET_RATE_LIMITS, msg.SerializeToString()
+            )
+        except EdgeError as e:
+            return _edge_err(e)
+        out = pb.pb.GetRateLimitsResp.FromString(raw)
+        return web.json_response(
+            {
+                "responses": [
+                    pb.resp_to_json(pb.resp_from_pb(m)) for m in out.responses
+                ]
+            }
+        )
+
+    async def _health():
+        raw = await client.call(METHOD_HEALTH_CHECK, b"")
+        return pb.pb.HealthCheckResp.FromString(raw)
+
+    async def health_check(request: web.Request) -> web.Response:
+        try:
+            h = await _health()
+        except EdgeError as e:
+            return _edge_err(e)
+        # same body shape as the daemon gateway (pb.health_to_json):
+        # message omitted when empty
+        body = {"status": h.status, "peer_count": h.peer_count}
+        if h.message:
+            body["message"] = h.message
+        return web.json_response(body)
+
+    async def healthz(request: web.Request) -> web.Response:
+        try:
+            h = await _health()
+        except EdgeError:
+            return web.Response(text="unreachable", status=503)
+        return web.Response(
+            text=h.status, status=200 if h.status == "healthy" else 503
+        )
+
+    app.router.add_post("/v1/GetRateLimits", get_rate_limits)
+    app.router.add_get("/v1/HealthCheck", health_check)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
 def edge_v1_handler(servicer) -> "grpc.GenericRpcHandler":  # noqa: F821
     """V1 service handler with identity (de)serializers on BOTH methods
     — the edge never parses messages, it relays bytes."""

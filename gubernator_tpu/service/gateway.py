@@ -16,35 +16,46 @@ from gubernator_tpu.service import pb
 from gubernator_tpu.service.server import ApiError, V1Service
 
 
+async def read_json_requests(request: web.Request):
+    """Parse + validate a /v1/GetRateLimits JSON body.
+
+    Returns (reqs, None) or (None, error_response). Shared by the
+    daemon gateway and the edge gateway (service/edge.py) so the two
+    HTTP fronts cannot diverge on the wire contract."""
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return None, web.json_response(
+            {"code": 3, "message": f"invalid JSON: {e}"}, status=400
+        )
+    if not isinstance(body, dict):
+        return None, web.json_response(
+            {"code": 3, "message": "request body must be a JSON object"},
+            status=400,
+        )
+    items = body.get("requests") or []
+    if not isinstance(items, list) or not all(
+        isinstance(d, dict) for d in items
+    ):
+        return None, web.json_response(
+            {"code": 3, "message": "'requests' must be a list of objects"},
+            status=400,
+        )
+    try:
+        return [pb.req_from_json(d) for d in items], None
+    except (TypeError, ValueError) as e:
+        return None, web.json_response(
+            {"code": 3, "message": f"invalid request: {e}"}, status=400
+        )
+
+
 def build_app(svc: V1Service) -> web.Application:
     app = web.Application()
 
     async def get_rate_limits(request: web.Request) -> web.Response:
-        try:
-            body = await request.json()
-        except json.JSONDecodeError as e:
-            return web.json_response(
-                {"code": 3, "message": f"invalid JSON: {e}"}, status=400
-            )
-        if not isinstance(body, dict):
-            return web.json_response(
-                {"code": 3, "message": "request body must be a JSON object"},
-                status=400,
-            )
-        items = body.get("requests") or []
-        if not isinstance(items, list) or not all(
-            isinstance(d, dict) for d in items
-        ):
-            return web.json_response(
-                {"code": 3, "message": "'requests' must be a list of objects"},
-                status=400,
-            )
-        try:
-            reqs = [pb.req_from_json(d) for d in items]
-        except (TypeError, ValueError) as e:
-            return web.json_response(
-                {"code": 3, "message": f"invalid request: {e}"}, status=400
-            )
+        reqs, err = await read_json_requests(request)
+        if err is not None:
+            return err
         try:
             out = await svc.get_rate_limits(reqs)
         except ApiError as e:

@@ -266,9 +266,10 @@ def test_edge_process_end_to_end(edge_cluster, loop_thread):
         GUBER_EDGE_UPSTREAM=st["daemon"].conf.edge_listen_address,
         GUBER_GRPC_ADDRESS="127.0.0.1:0",
     )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.Popen(
         [sys.executable, "-m", "gubernator_tpu.cmd.edge"],
-        env=env, cwd="/root/repo",
+        env=env, cwd=repo_root,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     try:
@@ -313,6 +314,62 @@ def test_edge_global_and_mixed_still_work(edge_cluster, loop_thread):
         )
         assert out[0].error == "" and out[0].remaining == 8
         await ch.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=60)
+
+
+def test_edge_http_gateway(edge_cluster, loop_thread):
+    """The edge's HTTP/JSON surface matches the daemon gateway's wire
+    shape (snake_case JSON, string int64s) and maps upstream loss to
+    503."""
+    import json as _json
+
+    import aiohttp
+    from aiohttp import web
+
+    from gubernator_tpu.service.edge import EdgeClient, build_edge_app
+
+    async def run():
+        st = edge_cluster
+        client = EdgeClient(st["daemon"].conf.edge_listen_address)
+        runner = web.AppRunner(build_edge_app(client))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/GetRateLimits",
+                json={"requests": [{"name": "h", "unique_key": "hk",
+                                    "duration": 60000, "limit": 10, "hits": 4}]},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["responses"][0]["remaining"] == "6"  # int64-as-string
+
+            r = await s.get(f"{base}/v1/HealthCheck")
+            assert (await r.json())["status"] == "healthy"
+            r = await s.get(f"{base}/healthz")
+            assert r.status == 200 and (await r.text()) == "healthy"
+
+            r = await s.post(f"{base}/v1/GetRateLimits", data=b"{nope")
+            assert r.status == 400 and (await r.json())["code"] == 3
+
+            # upstream loss -> 503 on /healthz, JSON error on the API
+            await st["daemon"].close()
+            r = await s.get(f"{base}/healthz")
+            assert r.status == 503
+            r = await s.post(
+                f"{base}/v1/GetRateLimits",
+                json={"requests": [{"name": "h", "unique_key": "hk2",
+                                    "duration": 60000, "limit": 10, "hits": 1}]},
+            )
+            assert r.status in (503, 504)
+        await runner.cleanup()
+        await client.close()
         return True
 
     assert loop_thread.run(run(), timeout=60)
