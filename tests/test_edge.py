@@ -373,3 +373,51 @@ def test_edge_http_gateway(edge_cluster, loop_thread):
         return True
 
     assert loop_thread.run(run(), timeout=60)
+
+
+def test_edge_over_ici_engine(loop_thread, tmp_path):
+    """Edge tier composes with an ici-mode daemon (IciEngine serving a
+    full virtual mesh): GLOBAL traffic through the edge lands on the
+    replica tier and reads back consistently."""
+    from gubernator_tpu.runtime.ici_engine import IciEngineConfig
+    from gubernator_tpu.service.config import BehaviorConfig
+
+    sock = f"unix://{tmp_path}/edge_ici.sock"
+
+    async def run():
+        d = await Daemon.spawn(
+            DaemonConfig(
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                edge_listen_address=sock,
+                global_mode="ici",
+                behaviors=BehaviorConfig(global_sync_wait_s=0.05),
+                ici=IciEngineConfig(
+                    num_groups=1 << 9, num_slots=1 << 11, batch_size=64,
+                    batch_wait_s=0.002, sync_wait_s=0.03,
+                ),
+            )
+        )
+        client = EdgeClient(sock)
+        out = _resps(
+            await client.call(
+                METHOD_GET_RATE_LIMITS,
+                _req_bytes("icik", hits=6, limit=100,
+                           behavior=int(Behavior.GLOBAL)),
+            )
+        )
+        assert out[0].error == "" and out[0].remaining == 94
+        await asyncio.sleep(0.2)  # one sync tick
+        out = _resps(
+            await client.call(
+                METHOD_GET_RATE_LIMITS,
+                _req_bytes("icik", hits=0, limit=100,
+                           behavior=int(Behavior.GLOBAL)),
+            )
+        )
+        assert out[0].remaining == 94
+        await client.close()
+        await d.close()
+        return True
+
+    assert loop_thread.run(run(), timeout=300)
