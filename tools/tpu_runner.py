@@ -37,6 +37,12 @@ def main() -> int:
     put_status("CLAIMING")
     t0 = time.time()
     try:
+        # sitecustomize pins jax_platforms to the tunnel at interpreter
+        # start; honor an explicit JAX_PLATFORMS (tests force cpu)
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from gubernator_tpu.utils.platform import honor_env_platforms
+
+        honor_env_platforms()
         import jax
 
         devs = jax.devices()
