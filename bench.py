@@ -170,15 +170,17 @@ def bench_server() -> dict:
     }
 
 
-def _try_runner_relay(args, timeout_s: float = 2400.0) -> bool:
+def _try_runner_relay(args, timeout_s: float = 2400.0):
     """Relay the bench through a live tools/tpu_runner.py claim holder.
 
     The TPU tunnel allows ONE device claim. When a persistent runner
     (tools/tpu_runner.py) already holds it, a fresh claim from the
     guarded child would fail after ~25min and report value=0 — exactly
     the round-2 failure mode, self-inflicted. Instead, submit the bench
-    as a runner job and relay its RESULT line. Returns False (fall back
-    to the guarded child) when no healthy runner is detected."""
+    as a runner job and relay its RESULT line. Returns "done" when a
+    result was printed, "no-claim" when the runner holds the claim but
+    did not deliver (a fresh claim would wedge behind it — skip the
+    guarded child), or False when no healthy runner is detected."""
     import os
 
     jobs = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")
@@ -192,7 +194,7 @@ def _try_runner_relay(args, timeout_s: float = 2400.0) -> bool:
         return False
     # READY can be stale: a runner wedged mid-job (dead tunnel RPC) never
     # picks up new work. Live runners heartbeat their status file mtime
-    # every 30s (tools/tpu_runner.py) — including during long jobs, so a
+    # every 15s (tools/tpu_runner.py) — including during long jobs, so a
     # legitimately busy runner is not mistaken for a wedged one. A stale
     # mtime (>3min) means the runner died or predates the heartbeat:
     # fall back to the guarded child.
@@ -219,6 +221,8 @@ def _try_runner_relay(args, timeout_s: float = 2400.0) -> bool:
         "    r = bench.bench_server()\n"
         "elif args.mode == 'global':\n"
         "    r = bench.bench_global()\n"
+        "elif args.mode == 'latency':\n"
+        "    r = bench.bench_latency(args.layout)\n"
         "else:\n"
         "    r = bench.bench_kernel(args.mode, args.layout)\n"
         "print('RESULT ' + json.dumps(r))\n"
@@ -239,26 +243,18 @@ def _try_runner_relay(args, timeout_s: float = 2400.0) -> bool:
                     for line in f:
                         if line.startswith("RESULT "):
                             print(line[len("RESULT "):].strip(), flush=True)
-                            return True
+                            return "done"
             except OSError:
                 pass
-            return False  # job ran but produced no RESULT: fall back
+            # Job ran but produced no RESULT. The runner still holds the
+            # claim, so a guarded-child claim attempt would wedge.
+            return "no-claim"
         time.sleep(2.0)
-    print(
-        json.dumps(
-            {
-                "metric": f"runner relay timed out ({name}); runner busy or dead",
-                "value": 0,
-                "unit": "decisions/s",
-                "vs_baseline": 0,
-            }
-        ),
-        flush=True,
-    )
-    return True  # a second claim attempt would wedge behind the runner's
+    # Relay timed out: runner busy/wedged but claim-holding either way.
+    return "no-claim"
 
 
-def _run_guarded(timeout_s: float = 480.0) -> None:
+def _run_guarded(timeout_s: float = 480.0):
     """Run the bench in a CHILD process and never kill it.
 
     The TPU tunnel allows one device claim, and a process killed while
@@ -313,14 +309,14 @@ def _run_guarded(timeout_s: float = 480.0) -> None:
 
     while time.monotonic() < deadline:
         if try_relay():
-            return
+            return "done"
         child_rc = child.poll()
         if child_rc is not None and not os.path.exists(out_path):
             break  # child died without a result
         time.sleep(1.0)
     # Final re-check: a result (or exit) can land during the last sleep.
     if try_relay():
-        return
+        return "done"
     child_rc = child.poll()
     if child_rc is not None:
         tail = ""
@@ -329,23 +325,58 @@ def _run_guarded(timeout_s: float = 480.0) -> None:
                 tail = f.read()[-400:].replace("\n", " | ")
         except OSError:
             pass
-        metric = (
+        return (
             f"bench child exited rc={child_rc} without a result "
             f"(NOT a claim timeout); stderr tail: {tail}"
         )
-    else:
-        metric = (
-            f"device init/bench did not complete within {timeout_s:.0f}s "
-            f"(TPU claim unavailable); claim attempt left to finish cleanly "
-            f"in the background — late result will land at {out_path}"
+    return (
+        f"device init/bench did not complete within {timeout_s:.0f}s "
+        f"(TPU claim unavailable); claim attempt left to finish cleanly "
+        f"in the background — late result will land at {out_path}"
+    )
+
+
+def _emit_ledger_fallback(args, why: str) -> None:
+    """Last resort when no live TPU measurement is possible this run:
+    emit the most recent ARCHIVED TPU result for the requested mode, with
+    explicit provenance + age (VERDICT r3 item 1c). A measurement made
+    earlier through the one-claim tunnel is strictly better evidence
+    than a value-0 failure record — three rounds of `value: 0` proved
+    that losing completed measurements is the artifact pipeline's worst
+    failure mode. Falls back to the failure record only when the ledger
+    has nothing for this mode."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from gubernator_tpu.utils import ledger
+
+    ledger.scan_job_outputs()  # pick up RESULTs a runner hasn't archived
+    rec = ledger.latest(args.mode, args.layout)
+    if rec is None:
+        print(
+            json.dumps(
+                {"metric": why, "value": 0, "unit": "decisions/s",
+                 "vs_baseline": 0}
+            ),
+            flush=True,
         )
+        return
+    age_h = max(0.0, (time.time() - float(rec["ts"])) / 3600.0)
     print(
         json.dumps(
             {
-                "metric": metric,
-                "value": 0,
-                "unit": "decisions/s",
-                "vs_baseline": 0,
+                "metric": (
+                    f"{rec['metric']} [ARCHIVED tpu measurement from "
+                    f"{rec['iso']} ({age_h:.1f}h old), job={rec['job']}; "
+                    f"live run unavailable: {why}]"
+                ),
+                "value": rec["value"],
+                "unit": rec["unit"],
+                "vs_baseline": rec["vs_baseline"],
+                "provenance": "ledger",
+                "measured_at": rec["iso"],
+                "age_hours": round(age_h, 2),
             }
         ),
         flush=True,
@@ -414,6 +445,86 @@ def bench_global() -> dict:
     }
 
 
+def bench_latency(layout: str = "fused") -> dict:
+    """Device-side decide step time WITHOUT tunnel dispatch RTT
+    (VERDICT r3 item 4).
+
+    Through the axon tunnel a single dispatch round trip is ~45ms, which
+    swamps device time and makes naive per-call timing useless. Method:
+    for each wave width B, run decide_scan at two scan lengths S1 < S2
+    and take (t(S2) - t(S1)) / (S2 - S1) — the constant per-dispatch
+    overhead (RTT, host queueing) cancels, leaving mean device time per
+    decide step. Repeated with min-of-5 so transient tunnel jitter
+    doesn't inflate the bound. This is the device half of the <2ms p99
+    budget (reference production claim, README.md:134-139); the host
+    half (assembly ~300µs) is measured by bench_engine on the serving
+    host."""
+    import jax
+
+    from gubernator_tpu.ops.kernels import get_kernels
+
+    K = get_kernels(layout)
+    platform = jax.devices()[0].platform
+
+    NOW = 1_753_700_000_000
+    NUM_GROUPS = 1 << 18
+    N_KEYS = 1_000_000
+    WAYS = 8
+    S1, S2 = 16, 80
+    rng = np.random.default_rng(11)
+
+    table = K.create(NUM_GROUPS, WAYS)
+    widths = (128, 1024, 4096)
+    step_us: dict[int, float] = {}
+    for B in widths:
+        batches = [_make_zipf_batch(rng, B, N_KEYS, NUM_GROUPS, NOW) for _ in range(8)]
+
+        def stack(n):
+            reps = [batches[i % len(batches)] for i in range(n)]
+            return jax.tree.map(lambda *xs: np.stack(xs), *reps)
+
+        st1, st2 = stack(S1), stack(S2)
+        nows1 = np.arange(NOW, NOW + S1, dtype=np.int64)
+        nows2 = np.arange(NOW, NOW + S2, dtype=np.int64)
+        # warm both compiles (persistent cache makes reruns cheap)
+        t0 = time.perf_counter()
+        table, out = K.decide_scan(table, st1, nows1, WAYS, False)
+        jax.block_until_ready(out.status)
+        table, out = K.decide_scan(table, st2, nows2, WAYS, False)
+        jax.block_until_ready(out.status)
+        print(f"[bench] B={B} compiled/warm in {time.perf_counter() - t0:.1f}s",
+              flush=True)
+        t_s1, t_s2 = [], []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            table, out = K.decide_scan(table, st1, nows1, WAYS, False)
+            jax.block_until_ready(out.status)
+            t_s1.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            table, out = K.decide_scan(table, st2, nows2, WAYS, False)
+            jax.block_until_ready(out.status)
+            t_s2.append(time.perf_counter() - t0)
+        us = (min(t_s2) - min(t_s1)) / (S2 - S1) * 1e6
+        step_us[B] = us
+        print(f"[bench] device decide step B={B}: {us:.1f}us "
+              f"({us / B * 1000:.1f}ns/decision)", flush=True)
+
+    detail = ", ".join(f"B={b}: {u:.0f}us" for b, u in step_us.items())
+    v = step_us[4096]
+    return {
+        "metric": (
+            f"device decide step time ({platform}, {layout} layout, "
+            f"scan-delta method, RTT-cancelled): {detail}; vs <2ms p99 "
+            f"budget at B=4096"
+        ),
+        "value": round(v, 1),
+        "unit": "us/step",
+        # how many times under the reference's 2ms p99 budget the device
+        # step fits (higher is better)
+        "vs_baseline": round(2000.0 / max(v, 1e-9), 1),
+    }
+
+
 def main() -> None:
     import os
 
@@ -424,13 +535,14 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--mode", default="kernel",
-        choices=("kernel", "engine", "server", "global", "kernel10m"),
+        choices=("kernel", "engine", "server", "global", "kernel10m", "latency"),
         help="kernel: device decide throughput @1M keys (headline); "
         "engine: end-to-end host+device serving path; "
         "server: full gRPC round trip; "
         "global: GLOBAL behavior on a 4-node cluster (BASELINE config 4); "
         "kernel10m: BASELINE config 5 — 10M-key Zipfian mixed behaviors "
-        "on a 16M-slot table",
+        "on a 16M-slot table; "
+        "latency: device decide step time, tunnel-RTT-cancelled",
     )
     parser.add_argument(
         "--layout", default="fused", choices=("wide", "packed", "fused"),
@@ -440,9 +552,20 @@ def main() -> None:
 
     child_out = os.environ.get("GUBER_BENCH_CHILD")
     if not child_out:
-        if _try_runner_relay(args):
+        relayed = _try_runner_relay(args)
+        if relayed == "done":
             return
-        _run_guarded()
+        if relayed == "no-claim":
+            # A claim-holding runner exists but didn't deliver; a fresh
+            # claim would wedge behind it — go straight to the archive.
+            _emit_ledger_fallback(
+                args, "runner holds the device claim but did not deliver"
+            )
+            return
+        why = _run_guarded()
+        if why == "done":
+            return
+        _emit_ledger_fallback(args, why)
         return
 
     # ---- child: claim, bench, write ONE JSON line, exit cleanly ----
@@ -451,7 +574,18 @@ def main() -> None:
         with open(tmp, "w") as f:
             f.write(json.dumps(result) + "\n")
         os.replace(tmp, child_out)
+        try:  # archive every live measurement (VERDICT r3 item 1b)
+            from gubernator_tpu.utils import ledger
 
+            ledger.append(
+                result, job="bench_child", mode=args.mode, layout=args.layout
+            )
+        except Exception:
+            pass
+
+    from gubernator_tpu.utils.compilecache import enable_compile_cache
+
+    enable_compile_cache()
     import jax
 
     dev = jax.devices()[0]  # the claim — the part that can wedge
@@ -465,7 +599,60 @@ def main() -> None:
     if args.mode == "global":
         emit(bench_global())
         return
+    if args.mode == "latency":
+        emit(bench_latency(args.layout))
+        return
     emit(bench_kernel(args.mode, args.layout))
+
+
+def _make_zipf_batch(rng, B: int, n_keys: int, num_groups: int, now: int,
+                     mode: str = "kernel"):
+    """One pre-encoded request batch: Zipf(1.1) keys, 128-bit identities
+    via splitmix-style mixing, group-deduplicated per batch (the
+    assembler invariant: one request per group per batch)."""
+    from gubernator_tpu.ops.layout import RequestBatch
+
+    def mix(x, c):
+        x = (x * np.uint64(c)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        x ^= x >> np.uint64(29)
+        x = (x * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        x ^= x >> np.uint64(32)
+        return x
+
+    b = RequestBatch.zeros(B)
+    keys = rng.zipf(1.1, size=B * 2) % n_keys  # oversample for dedup
+    h_lo = mix(keys.astype(np.uint64), 0x9E3779B97F4A7C15)
+    grp = (h_lo % np.uint64(num_groups)).astype(np.int64)
+    _, first = np.unique(grp, return_index=True)
+    first = np.sort(first)[:B]
+    keys = keys[first]
+    h_lo = h_lo[first]
+    grp = grp[first]
+    n = len(keys)
+    b.key_lo[:n] = h_lo.astype(np.int64, casting="unsafe") | 1
+    b.key_hi[:n] = mix(keys.astype(np.uint64), 0xD6E8FEB86659FD93).astype(
+        np.int64, casting="unsafe"
+    )
+    b.group[:n] = grp[:n].astype(np.int32)
+    b.algo[:n] = (keys[:n] % 4 == 0).astype(np.int8)  # 25% leaky
+    if mode == "kernel10m":
+        # config (5) behavior mix: RESET_REMAINING + DRAIN_OVER_LIMIT
+        from gubernator_tpu.api.types import Behavior
+
+        b.behavior[:n] = np.where(
+            keys[:n] % 16 == 1, np.int32(int(Behavior.RESET_REMAINING)), 0
+        ) | np.where(
+            keys[:n] % 8 == 2, np.int32(int(Behavior.DRAIN_OVER_LIMIT)), 0
+        )
+    b.hits[:n] = 1
+    b.limit[:n] = 10_000
+    b.duration[:n] = 60_000
+    b.rate_num[:n] = 60_000
+    b.eff_duration[:n] = 60_000
+    b.burst[:n] = 10_000
+    b.created_at[:n] = now
+    b.active[:n] = True
+    return b
 
 
 def bench_kernel(mode: str = "kernel", layout: str = "fused") -> dict:
@@ -476,7 +663,6 @@ def bench_kernel(mode: str = "kernel", layout: str = "fused") -> dict:
     import jax
 
     from gubernator_tpu.ops.kernels import get_kernels
-    from gubernator_tpu.ops.layout import RequestBatch
 
     K = get_kernels(layout)
 
@@ -501,50 +687,8 @@ def bench_kernel(mode: str = "kernel", layout: str = "fused") -> dict:
 
     rng = np.random.default_rng(7)
 
-    # Zipf(1.1) over 1M keys; 128-bit identities via splitmix-style mixing.
-    def mix(x, c):
-        x = (x * np.uint64(c)) & np.uint64(0xFFFFFFFFFFFFFFFF)
-        x ^= x >> np.uint64(29)
-        x = (x * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
-        x ^= x >> np.uint64(32)
-        return x
-
-    def make_batch() -> RequestBatch:
-        b = RequestBatch.zeros(B)
-        keys = rng.zipf(1.1, size=B * 2) % N_KEYS  # oversample for dedup
-        h_lo = mix(keys.astype(np.uint64), 0x9E3779B97F4A7C15)
-        grp = (h_lo % np.uint64(NUM_GROUPS)).astype(np.int64)
-        # assembler invariant: one request per group per batch
-        _, first = np.unique(grp, return_index=True)
-        first = np.sort(first)[:B]
-        keys = keys[first]
-        h_lo = h_lo[first]
-        grp = grp[first]
-        n = len(keys)
-        b.key_lo[:n] = h_lo.astype(np.int64, casting="unsafe") | 1
-        b.key_hi[:n] = mix(keys.astype(np.uint64), 0xD6E8FEB86659FD93).astype(
-            np.int64, casting="unsafe"
-        )
-        b.group[:n] = grp[:n].astype(np.int32)
-        b.algo[:n] = (keys[:n] % 4 == 0).astype(np.int8)  # 25% leaky
-        if mode == "kernel10m":
-            # config (5) behavior mix: RESET_REMAINING + DRAIN_OVER_LIMIT
-            from gubernator_tpu.api.types import Behavior
-
-            b.behavior[:n] = np.where(
-                keys[:n] % 16 == 1, np.int32(int(Behavior.RESET_REMAINING)), 0
-            ) | np.where(
-                keys[:n] % 8 == 2, np.int32(int(Behavior.DRAIN_OVER_LIMIT)), 0
-            )
-        b.hits[:n] = 1
-        b.limit[:n] = 10_000
-        b.duration[:n] = 60_000
-        b.rate_num[:n] = 60_000
-        b.eff_duration[:n] = 60_000
-        b.burst[:n] = 10_000
-        b.created_at[:n] = NOW
-        b.active[:n] = True
-        return b
+    def make_batch():
+        return _make_zipf_batch(rng, B, N_KEYS, NUM_GROUPS, NOW, mode)
 
     table = K.create(NUM_GROUPS, WAYS)
 

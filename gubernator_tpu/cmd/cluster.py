@@ -17,9 +17,11 @@ def main() -> None:
     p.add_argument("--cache-size", type=int, default=8192)
     args = p.parse_args()
 
+    from gubernator_tpu.utils.compilecache import enable_compile_cache
     from gubernator_tpu.utils.platform import honor_env_platforms
 
     honor_env_platforms()
+    enable_compile_cache()
 
     from gubernator_tpu.cluster import Cluster
 

@@ -45,6 +45,14 @@ def main() -> None:
         )
     listen = os.environ.get("GUBER_GRPC_ADDRESS", "127.0.0.1:81")
     http_listen = os.environ.get("GUBER_HTTP_ADDRESS", "")
+    if http_listen:
+        hhost, _, hport_s = http_listen.rpartition(":")
+        if not hhost or not hport_s.isdigit() or int(hport_s) == 0:
+            raise SystemExit(
+                "GUBER_HTTP_ADDRESS must be host:port with an explicit "
+                f"port (edges are load-balancer targets), got {http_listen!r}"
+            )
+        hport = int(hport_s)
     n_conns = int(os.environ.get("GUBER_EDGE_CONNECTIONS", "2"))
 
     async def run() -> None:
@@ -70,11 +78,9 @@ def main() -> None:
 
             http_runner = web.AppRunner(build_edge_app(client))
             await http_runner.setup()
-            hhost, hport = http_listen.rsplit(":", 1)
-            site = web.TCPSite(http_runner, hhost, int(hport))
+            site = web.TCPSite(http_runner, hhost, hport)
             await site.start()
-            hactual = site._server.sockets[0].getsockname()
-            logging.info("edge http listening on %s:%s", hhost, hactual[1])
+            logging.info("edge http listening on %s:%s", hhost, hport)
         logging.info(
             "gubernator-tpu edge listening on %s -> upstream %s",
             listen.rsplit(":", 1)[0] + f":{port}", upstream,

@@ -191,6 +191,14 @@ class GossipPool:
     backend, reference memberlist.go:38-299, reimagined on stdlib
     asyncio UDP).
 
+    TRUST MODEL: datagrams are unauthenticated JSON — deploy only on
+    trusted LANs / private VPCs (the reference's memberlist default is
+    the same unless its encryption key is set). On a hostile network an
+    attacker can forge `from` fields to refresh a dead peer's liveness
+    or clear its tombstone, and forged suspect/dead gossip can evict a
+    live peer until it refutes. Use the etcd/k8s/DNS backends where the
+    network is not trusted.
+
     Each node carries its own PeerInfo in its gossip state and
     periodically sends its full membership view (JSON datagram) to a few
     random peers plus the configured seed nodes; receivers merge views
@@ -496,7 +504,11 @@ class GossipPool:
                 st["state"] = "alive"
                 st["since"] = now
             if self._probe is not None and self._probe[0] == sender:
-                self._acked.add(int(msg.get("seq", -1) or -1))
+                # explicit None check: seq 0 is a legitimate value (the
+                # suspect re-probe uses it), `or`-style coercion is not
+                seq = msg.get("seq")
+                if isinstance(seq, int):
+                    self._acked.add(seq)
 
     async def _loop(self) -> None:
         import math as _math

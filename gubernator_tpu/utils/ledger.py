@@ -1,0 +1,175 @@
+"""Persistent benchmark-result ledger (VERDICT r3 item 1b/1c).
+
+Every TPU measurement is precious: the device is reached through a
+one-claim tunnel that can die mid-round, so a RESULT produced at 14:00
+must still be visible to a driver bench run at 19:00 — and to the NEXT
+round. Round 3 lost its 34.1M decisions/s headline to exactly this: the
+number existed only in a job's stdout capture while the official bench
+artifact recorded 0.
+
+The ledger is an append-only JSONL file kept in two places:
+  - /tmp/tpu_jobs/results.jsonl   (runtime; same dir as the job queue)
+  - <repo>/bench_results/results.jsonl  (committed, survives the machine)
+
+Records: {ts, iso, job, mode, layout, platform, metric, value, unit,
+vs_baseline}. `mode`/`layout` mirror bench.py's CLI so a fallback lookup
+can match the requested benchmark exactly.
+
+The reference's analog is its benchmark workflow artifact: a run that
+doesn't produce a comparable artifact doesn't exist
+(reference .github/workflows/on-pull-request.yml:87-99).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any
+
+JOBS_DIR = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")
+RUNTIME_LEDGER = os.path.join(JOBS_DIR, "results.jsonl")
+REPO_LEDGER = os.environ.get("GUBER_REPO_LEDGER") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "bench_results",
+    "results.jsonl",
+)
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def infer_platform(metric: str) -> str:
+    m = re.search(r"[(,]\s*(tpu|cpu|gpu|axon)\b", metric)
+    return m.group(1) if m else "unknown"
+
+
+def append(
+    result: dict[str, Any],
+    *,
+    job: str = "",
+    mode: str = "",
+    layout: str = "",
+    platform: str = "",
+    ts: float | None = None,
+) -> dict[str, Any]:
+    """Append one bench result (a bench.py JSON dict) to both ledgers.
+    Best-effort: a read-only repo checkout must not break a measurement."""
+    ts = time.time() if ts is None else ts
+    rec = {
+        "ts": round(ts, 3),
+        "iso": _iso(ts),
+        "job": job,
+        "mode": mode,
+        "layout": layout,
+        "platform": platform or infer_platform(str(result.get("metric", ""))),
+        **{k: result.get(k) for k in ("metric", "value", "unit", "vs_baseline")},
+    }
+    line = json.dumps(rec) + "\n"
+    for path in (RUNTIME_LEDGER, REPO_LEDGER):
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a") as f:
+                f.write(line)
+        except OSError:
+            pass
+    return rec
+
+
+def load() -> list[dict[str, Any]]:
+    """All records from both ledgers, deduplicated, oldest first."""
+    seen: dict[tuple, dict[str, Any]] = {}
+    for path in (RUNTIME_LEDGER, REPO_LEDGER):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    key = (rec.get("ts"), rec.get("job"), rec.get("value"))
+                    seen.setdefault(key, rec)
+        except OSError:
+            continue
+    return sorted(seen.values(), key=lambda r: r.get("ts") or 0)
+
+
+def latest(
+    mode: str, layout: str = "", platform: str = "tpu"
+) -> dict[str, Any] | None:
+    """Newest record matching the requested bench mode (+layout when the
+    mode is layout-sensitive) with value > 0 on the given platform."""
+    best = None
+    for rec in load():
+        if rec.get("platform") != platform or not rec.get("value"):
+            continue
+        if rec.get("mode") != mode:
+            continue
+        if layout and rec.get("layout") and rec.get("layout") != layout:
+            continue
+        best = rec  # list is oldest-first
+    return best
+
+
+_MODE_FROM_JOB = re.compile(
+    r"(kernel10m|kernel|engine|server|global|latency|edge)"
+)
+_LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide)")
+
+
+def infer_mode_layout(job: str, metric: str = "") -> tuple[str, str]:
+    """Best-effort (mode, layout) from a job name, falling back to the
+    metric string — the ONE inference used by both live archiving
+    (tools/tpu_runner.py) and output re-scans, so the same RESULT always
+    lands with the same keys."""
+    m = _MODE_FROM_JOB.search(job) or _MODE_FROM_JOB.search(metric)
+    lay = _LAYOUT_FROM_JOB.search(job) or _LAYOUT_FROM_JOB.search(metric)
+    return (m.group(1) if m else "", lay.group(1) if lay else "")
+
+
+def scan_job_outputs(jobs_dir: str | None = None) -> int:
+    """Seed/refresh the ledger from RESULT lines in <jobs_dir>/*.out.
+
+    Used both at runner start (recover results from a previous run's
+    outputs) and as a safety net before a fallback lookup. Dedupe is by
+    (job, value, metric) against existing records. Returns #added."""
+    jobs_dir = jobs_dir or JOBS_DIR
+    have = {
+        (r.get("job"), r.get("value"), r.get("metric")) for r in load()
+    }
+    added = 0
+    try:
+        names = sorted(os.listdir(jobs_dir))
+    except OSError:
+        return 0
+    for fn in names:
+        if not fn.endswith(".out"):
+            continue
+        path = os.path.join(jobs_dir, fn)
+        job = fn[: -len(".out")]
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            if not line.startswith("RESULT "):
+                continue
+            try:
+                result = json.loads(line[len("RESULT "):])
+            except ValueError:
+                continue
+            metric = str(result.get("metric", ""))
+            if (job, result.get("value"), metric) in have:
+                continue
+            mode, layout = infer_mode_layout(job, metric)
+            append(result, job=job, mode=mode, layout=layout, ts=mtime)
+            have.add((job, result.get("value"), metric))
+            added += 1
+    return added

@@ -25,6 +25,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: no-op on CPU-only runs unless
+# GUBER_COMPILE_CACHE_CPU=1 (XLA:CPU AOT reloads are not portable across
+# heterogeneous hosts); opt in locally to speed warm suite reruns.
+from gubernator_tpu.utils.compilecache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 import asyncio  # noqa: E402
 import threading  # noqa: E402
 

@@ -1,0 +1,8 @@
+# TIMEOUT: 300
+import time
+import jax, jax.numpy as jnp
+t0 = time.time()
+x = jnp.ones((1024, 1024), dtype=jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(f"sanity ok platform={jax.devices()[0].platform} compile+run={time.time()-t0:.2f}s")
+print(f"compile cache dir: {jax.config.jax_compilation_cache_dir}")

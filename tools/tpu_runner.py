@@ -1,29 +1,110 @@
-"""Persistent TPU job runner for the axon tunnel.
+"""Persistent TPU job runner for the axon tunnel (VERDICT r3 item 1a).
 
 The tunnel allows one device claim, and a process killed while holding
 (or acquiring) it wedges the claim for a long time. So: claim ONCE in a
 long-lived process and feed it work as files — never kill it.
 
+Round-3 lesson: a job stuck on a dead tunnel RPC froze the runner's
+single-threaded loop for hours, and everything queued behind it (the
+driver's bench among it) starved. Jobs now run on worker threads with a
+per-job watchdog: after `# TIMEOUT: <secs>` (default 1800s) the job is
+abandoned — its partial output + a TIMEOUT marker land in <name>.out,
+.done records "timeout", and the queue keeps draining. An abandoned
+thread that later finishes writes to <name>.out.late. (A native call
+that sleeps while holding the GIL can still freeze the process — that
+failure mode is why the heartbeat exists: consumers see the stale mtime
+and fall back.)
+
 Protocol (dir: /tmp/tpu_jobs):
   - runner writes `status` = READY <platform> once the claim succeeds,
     or FAILED <err> (then exits 1; the outer loop retries with a fresh
     process — backend-init failure is cached per-process in jax).
+  - status mtime is heartbeat-touched every 15s; stale >3min = wedged.
   - submit work by writing <name>.py then touching <name>.go
-  - runner execs the file (globals persist across jobs: keep tables/
-    compiled fns alive between experiments), writes stdout+traceback to
-    <name>.out and then <name>.done
-  - touch STOP to make the runner exit cleanly.
+  - runner execs the file on a worker thread (shared globals dict:
+    tables/compiled fns persist across jobs), writes stdout+traceback
+    to <name>.out then <name>.done
+  - any `RESULT {json}` stdout line is archived to the results ledger
+    (/tmp/tpu_jobs/results.jsonl + bench_results/results.jsonl).
+  - touch STOP to make the runner exit cleanly (between jobs).
 
 Usage:  while ! python tools/tpu_runner.py; do sleep 90; done
 """
 
 import io
+import json
 import os
 import sys
+import threading
 import time
 import traceback
 
 JOBS = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")
+DEFAULT_TIMEOUT_S = float(os.environ.get("TPU_JOB_TIMEOUT", "1800"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _Demux(io.TextIOBase):
+    """Route stdout per-thread: each job thread registers its own buffer;
+    unregistered threads (the runner itself, stray library threads) write
+    through to the real stdout. An abandoned job keeps printing into its
+    own buffer, not into the next job's output."""
+
+    def __init__(self, real):
+        self.real = real
+        self.bufs: dict[int, io.StringIO] = {}
+        self.lock = threading.Lock()
+
+    def register(self, buf: io.StringIO) -> None:
+        with self.lock:
+            self.bufs[threading.get_ident()] = buf
+
+    def unregister(self) -> None:
+        with self.lock:
+            self.bufs.pop(threading.get_ident(), None)
+
+    def write(self, s: str) -> int:
+        buf = self.bufs.get(threading.get_ident())
+        return (buf or self.real).write(s)
+
+    def flush(self) -> None:
+        buf = self.bufs.get(threading.get_ident())
+        (buf or self.real).flush()
+
+
+def _archive_results(name: str, text: str) -> None:
+    try:
+        from gubernator_tpu.utils import ledger
+
+        n = 0
+        for line in text.splitlines():
+            if line.startswith("RESULT "):
+                try:
+                    result = json.loads(line[len("RESULT "):])
+                except ValueError:
+                    continue
+                mode, layout = ledger.infer_mode_layout(
+                    name, str(result.get("metric", ""))
+                )
+                ledger.append(result, job=name, mode=mode, layout=layout)
+                n += 1
+        if n:
+            print(f"  archived {n} RESULT line(s) from {name}", flush=True)
+    except Exception as e:  # ledger failure must not kill the runner
+        print(f"  ledger archive failed for {name}: {e!r}", flush=True)
+
+
+def _job_timeout(py_path: str) -> float:
+    try:
+        with open(py_path) as f:
+            head = f.read(2048)
+        for line in head.splitlines()[:5]:
+            if line.startswith("# TIMEOUT:"):
+                return float(line.split(":", 1)[1].strip())
+    except (OSError, ValueError):
+        pass
+    return DEFAULT_TIMEOUT_S
 
 
 def main() -> int:
@@ -39,10 +120,11 @@ def main() -> int:
     try:
         # sitecustomize pins jax_platforms to the tunnel at interpreter
         # start; honor an explicit JAX_PLATFORMS (tests force cpu)
-        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from gubernator_tpu.utils.compilecache import enable_compile_cache
         from gubernator_tpu.utils.platform import honor_env_platforms
 
         honor_env_platforms()
+        cache_dir = enable_compile_cache()
         import jax
 
         devs = jax.devices()
@@ -51,17 +133,30 @@ def main() -> int:
         put_status(f"FAILED {time.time() - t0:.0f}s {e!r}"[:500])
         return 1
     put_status(f"READY {plat} n={len(devs)} claim={time.time() - t0:.1f}s")
-    print(f"claimed {plat} x{len(devs)} in {time.time() - t0:.1f}s", flush=True)
+    print(
+        f"claimed {plat} x{len(devs)} in {time.time() - t0:.1f}s "
+        f"(compile cache: {cache_dir})",
+        flush=True,
+    )
 
-    # Heartbeat: touch the status file every 30s from a side thread —
+    # Recover RESULT lines from a previous runner's outputs into the
+    # ledger before taking new work (crash-safety for measurements).
+    try:
+        from gubernator_tpu.utils import ledger
+
+        n = ledger.scan_job_outputs(JOBS)
+        if n:
+            print(f"seeded ledger with {n} archived RESULT line(s)", flush=True)
+    except Exception as e:
+        print(f"ledger seed failed: {e!r}", flush=True)
+
+    # Heartbeat: touch the status file every 15s from a side thread —
     # ALSO while a job executes. Consumers (bench.py's runner relay)
-    # treat a stale mtime as "runner wedged on a dead tunnel RPC" and
-    # fall back, so the heartbeat must only stop if this process dies.
-    import threading
-
+    # treat a stale mtime as "runner wedged" and fall back, so the
+    # heartbeat must only stop if this process (or its GIL) is dead.
     def beat() -> None:
         while True:
-            time.sleep(30)
+            time.sleep(15)
             try:
                 os.utime(status, None)
             except OSError:
@@ -69,14 +164,62 @@ def main() -> int:
 
     threading.Thread(target=beat, daemon=True).start()
 
+    demux = _Demux(sys.stdout)
+    sys.stdout = demux
+
     env: dict = {"__name__": "__tpu_job__"}
+    abandoned = 0
+
+    def claim_done(done: str, verdict: str) -> bool:
+        """Atomically decide who finalizes a job: the job thread or the
+        watchdog. O_EXCL creation is the arbiter — exactly one side wins,
+        so a job finishing at ~timeout can't have its full output
+        clobbered by the partial+TIMEOUT record (or vice versa)."""
+        try:
+            fd = os.open(done, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(verdict + "\n")
+        return True
+
+    def write_atomic(path: str, text: str) -> None:
+        tmp = f"{path}.tmp{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    def run_job(name, py, out, done, buf, job_env):
+        demux.register(buf)
+        ok = False
+        try:
+            with open(py) as f:
+                code = f.read()
+            exec(compile(code, py, "exec"), job_env)
+            ok = True
+        except BaseException:
+            buf.write("\n" + traceback.format_exc())
+        finally:
+            demux.unregister()
+        # Full output becomes visible BEFORE .done so a poller never sees
+        # .done with a missing/partial .out.
+        write_atomic(out, buf.getvalue())
+        if claim_done(done, "ok" if ok else "error"):
+            verdict = "ok" if ok else "ERROR"
+        else:
+            # Watchdog abandoned us first; record the late completion.
+            with open(out + ".late", "w") as f:
+                f.write(buf.getvalue())
+            verdict = f"LATE {'ok' if ok else 'ERROR'}"
+        _archive_results(name, buf.getvalue())
+        demux.real.write(f"job {name}: {verdict}\n")
+        demux.real.flush()
+
     while True:
         if os.path.exists(os.path.join(JOBS, "STOP")):
             put_status("STOPPED")
             return 0
-        ready = sorted(
-            f[:-3] for f in os.listdir(JOBS) if f.endswith(".go")
-        )
+        ready = sorted(f[:-3] for f in os.listdir(JOBS) if f.endswith(".go"))
         ran = False
         for name in ready:
             go = os.path.join(JOBS, name + ".go")
@@ -84,27 +227,55 @@ def main() -> int:
             out = os.path.join(JOBS, name + ".out")
             done = os.path.join(JOBS, name + ".done")
             if os.path.exists(done) or not os.path.exists(py):
+                try:
+                    os.remove(go)
+                except OSError:
+                    pass
                 continue
             ran = True
+            timeout_s = _job_timeout(py)
             buf = io.StringIO()
-            old = sys.stdout
-            sys.stdout = buf
+            t1 = time.time()
+            th = threading.Thread(
+                target=run_job, args=(name, py, out, done, buf, env),
+                daemon=True,
+            )
+            th.start()
+            th.join(timeout_s)
+            if th.is_alive():
+                # Watchdog: abandon the job, keep draining the queue.
+                # Never kill the process — it holds the claim. Partial
+                # output first (skipped if the job just wrote its own),
+                # then the atomic done claim.
+                if not os.path.exists(out):
+                    write_atomic(
+                        out,
+                        buf.getvalue()
+                        + f"\nTIMEOUT after {timeout_s:.0f}s — job "
+                        f"abandoned by watchdog (thread left running; "
+                        f"late output, if any, lands in {name}.out.late)\n",
+                    )
+                if claim_done(done, "timeout"):
+                    abandoned += 1
+                    _archive_results(name, buf.getvalue())
+                    demux.real.write(
+                        f"job {name}: TIMEOUT after {timeout_s:.0f}s "
+                        f"(abandoned={abandoned})\n"
+                    )
+                    demux.real.flush()
+                    # The abandoned thread keeps exec-ing in its own
+                    # globals; snapshot a fresh dict for later jobs so a
+                    # waking zombie can't rebind names mid-job under
+                    # them (jax arrays are immutable, so shared values
+                    # are safe — rebinding is the hazard).
+                    env = dict(env)
+            else:
+                demux.real.write(f"  ({name} took {time.time() - t1:.1f}s)\n")
+                demux.real.flush()
             try:
-                with open(py) as f:
-                    code = f.read()
-                exec(compile(code, py, "exec"), env)
-                ok = True
-            except BaseException:
-                buf.write("\n" + traceback.format_exc())
-                ok = False
-            finally:
-                sys.stdout = old
-            with open(out, "w") as f:
-                f.write(buf.getvalue())
-            with open(done, "w") as f:
-                f.write("ok\n" if ok else "error\n")
-            os.remove(go)
-            print(f"job {name}: {'ok' if ok else 'ERROR'}", flush=True)
+                os.remove(go)
+            except OSError:
+                pass
         if not ran:
             time.sleep(0.5)
 
