@@ -204,7 +204,12 @@ def _try_runner_relay(args, timeout_s: float = 2400.0):
     except OSError:
         return False
     name = f"bench_{args.mode}_{args.layout}_{os.getpid()}"
+    timeout_s = float(os.environ.get("GUBER_BENCH_RUNNER_TIMEOUT", timeout_s))
     body = (
+        # Align the runner's per-job watchdog with the relay's own wait
+        # budget, or a long bench (kernel10m) gets abandoned at the
+        # runner's shorter default while the relay would still wait.
+        f"# TIMEOUT: {int(timeout_s)}\n"
         "import sys, json\n"
         f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
         # The runner process is long-lived and caches modules across
@@ -233,9 +238,7 @@ def _try_runner_relay(args, timeout_s: float = 2400.0):
         pass
     done = os.path.join(jobs, name + ".done")
     out = os.path.join(jobs, name + ".out")
-    deadline = time.monotonic() + float(
-        os.environ.get("GUBER_BENCH_RUNNER_TIMEOUT", timeout_s)
-    )
+    deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if os.path.exists(done):
             try:

@@ -224,6 +224,21 @@ class Metrics:
             "gubernator_global_broadcast_errors",
             "Failed GLOBAL broadcast pushes to peers.",
         )
+        # ICI replica-tier overflow (no reference analog: its owner cache
+        # is LRU-unbounded-by-group, lrucache.go; a W-way replica table
+        # needs the degraded regime to be observable — see
+        # docs/architecture.md "Overflow and drift bounds")
+        self.global_overflow_keys = Gauge(
+            "gubernator_global_overflow_keys",
+            "GLOBAL entries currently degraded to per-replica counting "
+            "(owner group full; summed across mesh devices).",
+            registry=r,
+        )
+        self.global_overflow_drops = counter(
+            "gubernator_global_overflow_drops_count",
+            "Overflow entries dropped at sync under full-group pressure "
+            "(local counter and un-synced deltas lost).",
+        )
 
         # MULTI_REGION behavior (no reference analog — the reference's
         # RegionPicker ships unimplemented, region_picker.go:19-103;
@@ -306,5 +321,8 @@ def engine_sync(engine):
         m.command_counter.set(em.requests)
         m.worker_queue_length.set(engine.queue_depth())
         m.cache_size.set(engine.live_count())
+        if hasattr(engine, "overflow_keys"):  # ici-mode engines only
+            m.global_overflow_keys.set(engine.overflow_keys)
+            m.global_overflow_drops.set(engine.overflow_drops)
 
     return _sync

@@ -122,6 +122,32 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         worker_count=_env_int("GUBER_WORKER_COUNT", 0),
     )
 
+    # ICI-mode sizing (GUBER_GLOBAL_MODE=ici): the replica table must be
+    # sized so live GLOBAL keys per group stay <= replica ways, or keys
+    # degrade to per-replica counting (docs/architecture.md "Overflow
+    # and drift bounds"). Analog of the reference's GUBER_CACHE_SIZE for
+    # the collective tier.
+    if conf.global_mode == "ici" and any(
+        os.environ.get(k)
+        for k in (
+            "GUBER_ICI_NUM_GROUPS", "GUBER_ICI_WAYS",
+            "GUBER_ICI_NUM_SLOTS", "GUBER_ICI_REPLICA_WAYS",
+        )
+    ):
+        from gubernator_tpu.runtime.ici_engine import IciEngineConfig
+
+        base = IciEngineConfig()
+        conf.ici = IciEngineConfig(
+            num_groups=_env_int("GUBER_ICI_NUM_GROUPS", base.num_groups),
+            ways=_env_int("GUBER_ICI_WAYS", base.ways),
+            num_slots=_env_int("GUBER_ICI_NUM_SLOTS", base.num_slots),
+            replica_ways=_env_int(
+                "GUBER_ICI_REPLICA_WAYS", base.replica_ways
+            ),
+            # the collective tick honors GlobalSyncWait like the gRPC tier
+            sync_wait_s=behaviors.global_sync_wait_s,
+        )
+
     # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2
     static = _env("GUBER_STATIC_PEERS")
     if static:

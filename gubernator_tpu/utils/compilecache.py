@@ -41,13 +41,19 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         return path
     import jax
 
-    # CPU-only processes (tests, dryruns) skip the cache by default:
-    # XLA:CPU AOT reload compares machine-feature lists and can refuse —
-    # or worse, SIGILL — across heterogeneous hosts, and CPU compiles
-    # are seconds, not the ~123s TPU kernel compiles the cache exists
-    # for. GUBER_COMPILE_CACHE_CPU=1 opts in.
+    # CPU-backed processes skip the cache by default: XLA:CPU AOT reload
+    # compares machine-feature lists and can refuse — or worse, SIGILL —
+    # across heterogeneous hosts, and CPU compiles are seconds, not the
+    # ~123s TPU kernel compiles the cache exists for. An explicitly
+    # cpu-pinned process (tests, dryruns) opts in via
+    # GUBER_COMPILE_CACHE_CPU=1; when the platform is UNRESOLVED (no
+    # pin — probing the backend here would trigger the device claim
+    # prematurely) only an explicit GUBER_COMPILE_CACHE opts in, since it
+    # may well resolve to CPU.
     platforms = (jax.config.jax_platforms or "").lower()
     if platforms == "cpu" and not os.environ.get("GUBER_COMPILE_CACHE_CPU"):
+        return None
+    if not platforms and not os.environ.get("GUBER_COMPILE_CACHE"):
         return None
     try:
         os.makedirs(path, exist_ok=True)

@@ -260,13 +260,13 @@ def _run_fuzz(seed: int, num_slots: int, ways: int):
                 f"{(int(want.status), int(want.remaining), int(want.reset_time))}"
             )
         elif r < 0.9:
-            state = sync_fn(state, now)
+            state, _diag = sync_fn(state, now)
             model.sync(now)
         else:
             now += rng.choice([1, 100, 1_000, 10_000])
 
     # final sync then full read-back comparison on every device
-    state = sync_fn(state, now)
+    state, _diag = sync_fn(state, now)
     model.sync(now)
 
     for key in keys:

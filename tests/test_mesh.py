@@ -101,7 +101,7 @@ def test_ici_replica_answers_locally_and_converges(mesh):
     assert int(out0.remaining[0]) == 1000
 
     # Sync tick: deltas psum to the owner, authoritative state rebroadcast.
-    state = sync_fn(state, NOW + 2)
+    state, _diag = sync_fn(state, NOW + 2)
 
     # After sync every replica agrees.
     for d in range(NDEV):
@@ -123,7 +123,7 @@ def test_ici_hits_from_many_replicas_sum_at_owner(mesh):
         b = encode_batch([_global_req(key, 5)], NOW + d, num_slots, 4)
         state, _ = replica_fn(state, b, np.full((4,), d, dtype=np.int64), NOW + d)
 
-    state = sync_fn(state, NOW + 100)
+    state, _diag = sync_fn(state, NOW + 100)
 
     b = encode_batch([_global_req(key, 0)], NOW + 200, num_slots, 4)
     state, out = replica_fn(state, b, np.zeros((4,), np.int64), NOW + 200)
@@ -154,7 +154,7 @@ def test_ici_over_limit_drains(mesh):
     state, o2 = replica_fn(state, b2, np.full((4,), h2, np.int64), NOW + 1)
     assert int(o2.remaining[0]) == 300  # its own replica also saw only 700
 
-    state = sync_fn(state, NOW + 10)
+    state, _diag = sync_fn(state, NOW + 10)
 
     b3 = encode_batch([_global_req(key, 0)], NOW + 20, num_slots, 4)
     state, o3 = replica_fn(state, b3, np.full((4,), owner_dev, np.int64), NOW + 20)
@@ -193,7 +193,7 @@ def test_ici_eviction_drops_stale_pending(mesh):
     bb = encode_batch([_global_req(key_b, 3)], NOW + 1, num_slots, 4)
     state, _ = replica_fn(state, bb, hm, NOW + 1)
 
-    state = sync_fn(state, NOW + 10)
+    state, _diag = sync_fn(state, NOW + 10)
 
     # B's counter reflects only B's hits; A's hits were dropped with its
     # evicted entry (documented direct-mapped trade-off), never credited

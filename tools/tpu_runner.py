@@ -115,7 +115,22 @@ def main() -> int:
         with open(status, "w") as f:
             f.write(s + "\n")
 
-    put_status("CLAIMING")
+    def other_runner_ready() -> bool:
+        """Several runner processes may race for the one claim (e.g. two
+        retry loops); a loser must not clobber the winner's READY."""
+        try:
+            with open(status) as f:
+                st = f.read()
+            return (
+                st.startswith("READY")
+                and f"pid={os.getpid()}" not in st
+                and time.time() - os.path.getmtime(status) < 60
+            )
+        except OSError:
+            return False
+
+    if not other_runner_ready():
+        put_status("CLAIMING")
     t0 = time.time()
     try:
         # sitecustomize pins jax_platforms to the tunnel at interpreter
@@ -130,9 +145,14 @@ def main() -> int:
         devs = jax.devices()
         plat = devs[0].platform
     except Exception as e:
-        put_status(f"FAILED {time.time() - t0:.0f}s {e!r}"[:500])
+        if not other_runner_ready():
+            put_status(f"FAILED {time.time() - t0:.0f}s {e!r}"[:500])
         return 1
-    put_status(f"READY {plat} n={len(devs)} claim={time.time() - t0:.1f}s")
+    ready_line = (
+        f"READY {plat} n={len(devs)} claim={time.time() - t0:.1f}s "
+        f"pid={os.getpid()}"
+    )
+    put_status(ready_line)
     print(
         f"claimed {plat} x{len(devs)} in {time.time() - t0:.1f}s "
         f"(compile cache: {cache_dir})",
@@ -150,15 +170,17 @@ def main() -> int:
     except Exception as e:
         print(f"ledger seed failed: {e!r}", flush=True)
 
-    # Heartbeat: touch the status file every 15s from a side thread —
-    # ALSO while a job executes. Consumers (bench.py's runner relay)
-    # treat a stale mtime as "runner wedged" and fall back, so the
-    # heartbeat must only stop if this process (or its GIL) is dead.
+    # Heartbeat: REWRITE the READY line every 15s from a side thread —
+    # ALSO while a job executes. Rewriting (not just touching) means a
+    # racing loser runner's FAILED write is healed within a beat.
+    # Consumers (bench.py's runner relay) treat a stale mtime as "runner
+    # wedged" and fall back, so the heartbeat must only stop if this
+    # process (or its GIL) is dead.
     def beat() -> None:
         while True:
             time.sleep(15)
             try:
-                os.utime(status, None)
+                put_status(ready_line)
             except OSError:
                 return
 
@@ -189,6 +211,8 @@ def main() -> int:
             f.write(text)
         os.replace(tmp, path)
 
+    abandoned_len: dict = {}  # job -> stdout bytes archived by watchdog
+
     def run_job(name, py, out, done, buf, job_env):
         demux.register(buf)
         ok = False
@@ -201,17 +225,20 @@ def main() -> int:
             buf.write("\n" + traceback.format_exc())
         finally:
             demux.unregister()
-        # Full output becomes visible BEFORE .done so a poller never sees
-        # .done with a missing/partial .out.
-        write_atomic(out, buf.getvalue())
+        payload = buf.getvalue()
         if claim_done(done, "ok" if ok else "error"):
+            # Archive before exposing .out: a poller that races the
+            # write falls back to the ledger, which already has it.
+            _archive_results(name, payload)
+            write_atomic(out, payload)
             verdict = "ok" if ok else "ERROR"
         else:
-            # Watchdog abandoned us first; record the late completion.
-            with open(out + ".late", "w") as f:
-                f.write(buf.getvalue())
+            # Watchdog abandoned us first; the TIMEOUT record in .out
+            # stays authoritative — late completion lands in .out.late,
+            # and only the tail the watchdog never saw is archived.
+            write_atomic(out + ".late", payload)
+            _archive_results(name, payload[abandoned_len.pop(name, 0):])
             verdict = f"LATE {'ok' if ok else 'ERROR'}"
-        _archive_results(name, buf.getvalue())
         demux.real.write(f"job {name}: {verdict}\n")
         demux.real.flush()
 
@@ -257,7 +284,9 @@ def main() -> int:
                     )
                 if claim_done(done, "timeout"):
                     abandoned += 1
-                    _archive_results(name, buf.getvalue())
+                    partial = buf.getvalue()
+                    abandoned_len[name] = len(partial)
+                    _archive_results(name, partial)
                     demux.real.write(
                         f"job {name}: TIMEOUT after {timeout_s:.0f}s "
                         f"(abandoned={abandoned})\n"
