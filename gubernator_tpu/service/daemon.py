@@ -10,6 +10,8 @@ like the reference's cluster harness (cluster/cluster.go:151-189).
 from __future__ import annotations
 
 import asyncio
+import logging
+import time
 from typing import List, Optional, Sequence
 
 import grpc
@@ -23,6 +25,8 @@ from gubernator_tpu.service.config import DaemonConfig
 from gubernator_tpu.service.gateway import build_app
 from gubernator_tpu.service.grpc_service import PeersV1Servicer, V1Servicer
 from gubernator_tpu.service.server import V1Service
+
+log = logging.getLogger("gubernator.daemon")
 
 
 class Daemon:
@@ -67,6 +71,21 @@ class Daemon:
             from gubernator_tpu.store import load_engine
 
             load_engine(self.engine, conf.loader)
+
+        # Optionally block startup until the kernel bucket ladder is
+        # warm, so the very first NO_BATCHING request already gets a
+        # width-sized kernel (GUBER_PREWARM_BUCKETS; cheap on restart
+        # under the persistent compile cache — see utils/compilecache).
+        if conf.prewarm_buckets and hasattr(self.engine, "wait_warm"):
+            t0 = time.monotonic()
+            done = await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.wait_warm, conf.prewarm_timeout_s
+            )
+            log.info(
+                "bucket prewarm %s in %.1fs",
+                "complete" if done else "TIMED OUT (serving anyway)",
+                time.monotonic() - t0,
+            )
 
         metrics = Metrics()
         from gubernator_tpu.metrics import engine_sync
