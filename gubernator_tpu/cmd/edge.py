@@ -23,6 +23,8 @@ import logging
 import os
 import signal
 
+from gubernator_tpu.utils.net import parse_listen_address
+
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="gubernator-tpu edge")
@@ -46,13 +48,17 @@ def main() -> None:
     listen = os.environ.get("GUBER_GRPC_ADDRESS", "127.0.0.1:81")
     http_listen = os.environ.get("GUBER_HTTP_ADDRESS", "")
     if http_listen:
-        hhost, _, hport_s = http_listen.rpartition(":")
-        if not hhost or not hport_s.isdigit() or int(hport_s) == 0:
+        # An empty host (":8080") binds all interfaces, Go-style
+        # (ADVICE r4: rejecting it was a behavior regression).
+        try:
+            hhost, hport = parse_listen_address(http_listen)
+        except ValueError:
+            hport = 0
+        if hport == 0:
             raise SystemExit(
-                "GUBER_HTTP_ADDRESS must be host:port with an explicit "
+                "GUBER_HTTP_ADDRESS must be [host]:port with an explicit "
                 f"port (edges are load-balancer targets), got {http_listen!r}"
             )
-        hport = int(hport_s)
     n_conns = int(os.environ.get("GUBER_EDGE_CONNECTIONS", "2"))
 
     async def run() -> None:

@@ -25,6 +25,7 @@ from gubernator_tpu.service.config import DaemonConfig
 from gubernator_tpu.service.gateway import build_app
 from gubernator_tpu.service.grpc_service import PeersV1Servicer, V1Servicer
 from gubernator_tpu.service.server import V1Service
+from gubernator_tpu.utils import net
 
 log = logging.getLogger("gubernator.daemon")
 
@@ -152,19 +153,27 @@ class Daemon:
 
         # HTTP gateway + metrics (reference daemon.go:251-299); serves TLS
         # with the same certs as the gRPC listener when configured.
-        app = build_app(self.svc)
-        self.http_runner = web.AppRunner(app)
-        await self.http_runner.setup()
-        hhost, hport = conf.http_listen_address.rsplit(":", 1)
-        ssl_ctx = None
-        if conf.tls is not None:
-            from gubernator_tpu.service.tls import http_ssl_context
+        self.http_runner = None
+        self.http_address = ""
+        if conf.http_listen_address:
+            app = build_app(self.svc)
+            self.http_runner = web.AppRunner(app)
+            await self.http_runner.setup()
+            # ":80" binds all interfaces Go-style; "" disables the
+            # listener entirely (GUBER_HTTP_ADDRESS= in the environment
+            # previously crashed spawn with an unpack error).
+            hhost, hport = net.parse_listen_address(conf.http_listen_address)
+            ssl_ctx = None
+            if conf.tls is not None:
+                from gubernator_tpu.service.tls import http_ssl_context
 
-            ssl_ctx = http_ssl_context(conf.tls)
-        site = web.TCPSite(self.http_runner, hhost, int(hport), ssl_context=ssl_ctx)
-        await site.start()
-        actual = site._server.sockets[0].getsockname()
-        self.http_address = f"{hhost}:{actual[1]}"
+                ssl_ctx = http_ssl_context(conf.tls)
+            site = web.TCPSite(
+                self.http_runner, hhost, int(hport), ssl_context=ssl_ctx
+            )
+            await site.start()
+            actual = site._server.sockets[0].getsockname()
+            self.http_address = f"{hhost}:{actual[1]}"
 
         # Optional health-only listener that never requests a client cert
         # (reference daemon.go:305-333): lets load balancers probe
@@ -177,14 +186,16 @@ class Daemon:
             status_app = build_status_app(self.svc)
             self.status_runner = web.AppRunner(status_app)
             await self.status_runner.setup()
-            shost, sport = conf.status_http_listen_address.rsplit(":", 1)
+            shost, sport = net.parse_listen_address(
+                conf.status_http_listen_address
+            )
             status_ssl = None
             if conf.tls is not None:
                 from gubernator_tpu.service.tls import http_ssl_context
 
                 status_ssl = http_ssl_context(conf.tls, no_client_auth=True)
             ssite = web.TCPSite(
-                self.status_runner, shost, int(sport), ssl_context=status_ssl
+                self.status_runner, shost, sport, ssl_context=status_ssl
             )
             await ssite.start()
             sactual = ssite._server.sockets[0].getsockname()
@@ -276,7 +287,7 @@ class Daemon:
     async def wait_for_connect(self, timeout_s: float = 10.0) -> None:
         """Dial each listener until it accepts a TCP connection
         (reference daemon.go:451-488)."""
-        addrs = [self.grpc_address, self.http_address]
+        addrs = [a for a in (self.grpc_address, self.http_address) if a]
         if self.status_address:
             addrs.append(self.status_address)
         deadline = asyncio.get_running_loop().time() + timeout_s

@@ -120,6 +120,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         # Sizes the reference's goroutine pool; N/A for the device engine
         # (see DaemonConfig.worker_count).
         worker_count=_env_int("GUBER_WORKER_COUNT", 0),
+        # Block startup on the width-bucket compile ladder (config.py
+        # prewarm_buckets docs; ADVICE r4: these were documented but
+        # never read from the environment).
+        prewarm_buckets=_env_bool("GUBER_PREWARM_BUCKETS"),
+        prewarm_timeout_s=parse_duration_s(_env("GUBER_PREWARM_TIMEOUT"), 600.0),
     )
 
     # ICI-mode sizing (GUBER_GLOBAL_MODE=ici): the replica table must be
@@ -127,13 +132,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     # degrade to per-replica counting (docs/architecture.md "Overflow
     # and drift bounds"). Analog of the reference's GUBER_CACHE_SIZE for
     # the collective tier.
-    if conf.global_mode == "ici" and any(
-        os.environ.get(k)
-        for k in (
-            "GUBER_ICI_NUM_GROUPS", "GUBER_ICI_WAYS",
-            "GUBER_ICI_NUM_SLOTS", "GUBER_ICI_REPLICA_WAYS",
-        )
-    ):
+    if conf.global_mode == "ici":
+        # Always built in ici mode (not only when a GUBER_ICI_* sizing
+        # var is present), or GUBER_BATCH_WAIT / GUBER_BATCH_LIMIT /
+        # GUBER_GLOBAL_SYNC_WAIT would silently fall back to dataclass
+        # defaults in an env-sized-by-default deployment.
         from gubernator_tpu.runtime.ici_engine import IciEngineConfig
 
         base = IciEngineConfig()
@@ -144,8 +147,12 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             replica_ways=_env_int(
                 "GUBER_ICI_REPLICA_WAYS", base.replica_ways
             ),
-            # the collective tick honors GlobalSyncWait like the gRPC tier
+            # the collective tick honors GlobalSyncWait like the gRPC
+            # tier, and the micro-batch pump honors GUBER_BATCH_* (ADVICE
+            # r4: these were silently reset to dataclass defaults).
             sync_wait_s=behaviors.global_sync_wait_s,
+            batch_wait_s=behaviors.batch_wait_s,
+            batch_limit=behaviors.batch_limit,
         )
 
     # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2

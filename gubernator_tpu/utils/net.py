@@ -11,6 +11,21 @@ def split_host_port(address: str) -> Tuple[str, int]:
     return host, int(port)
 
 
+def parse_listen_address(address: str) -> Tuple[str, int]:
+    """`[host]:port` -> (bind host, port) for a TCP listener.
+
+    Go-style: an empty host (":8080") means all interfaces; bracketed
+    IPv6 hosts are unwrapped. One shared parser so every listener site
+    (daemon HTTP, status HTTP, edge HTTP) agrees on the format instead
+    of hand-rolling rsplit variants that drift."""
+    host, _, port_s = address.rpartition(":")
+    if not port_s.isdigit():
+        raise ValueError(
+            f"listen address must be [host]:port, got {address!r}"
+        )
+    return (host.strip("[]") or "0.0.0.0"), int(port_s)
+
+
 def discover_ip() -> str:
     """A non-loopback interface IP usable as an advertise address."""
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

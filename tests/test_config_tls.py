@@ -180,6 +180,25 @@ def test_setup_daemon_config_parity_tail(monkeypatch):
     assert conf.debug is True
 
 
+def test_prewarm_and_ici_batch_env(monkeypatch):
+    """ADVICE r4: GUBER_PREWARM_* must reach DaemonConfig, and the ICI
+    engine config must carry GUBER_BATCH_WAIT/GUBER_BATCH_LIMIT rather
+    than dataclass defaults."""
+    monkeypatch.setenv("GUBER_PREWARM_BUCKETS", "true")
+    monkeypatch.setenv("GUBER_PREWARM_TIMEOUT", "90s")
+    monkeypatch.setenv("GUBER_GLOBAL_MODE", "ici")
+    monkeypatch.setenv("GUBER_ICI_NUM_GROUPS", "2048")
+    monkeypatch.setenv("GUBER_BATCH_WAIT", "2ms")
+    monkeypatch.setenv("GUBER_BATCH_LIMIT", "250")
+    conf = setup_daemon_config()
+    assert conf.prewarm_buckets is True
+    assert conf.prewarm_timeout_s == 90.0
+    assert conf.ici is not None
+    assert conf.ici.num_groups == 2048
+    assert conf.ici.batch_wait_s == 2e-3
+    assert conf.ici.batch_limit == 250
+
+
 def test_env_validation_errors(monkeypatch):
     import pytest as _pytest
 

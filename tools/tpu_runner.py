@@ -192,18 +192,25 @@ def main() -> int:
     env: dict = {"__name__": "__tpu_job__"}
     abandoned = 0
 
-    def claim_done(done: str, verdict: str) -> bool:
+    def claim_finalize(claim: str) -> bool:
         """Atomically decide who finalizes a job: the job thread or the
-        watchdog. O_EXCL creation is the arbiter — exactly one side wins,
-        so a job finishing at ~timeout can't have its full output
-        clobbered by the partial+TIMEOUT record (or vice versa)."""
+        watchdog. O_EXCL creation of a side `.claim` file is the arbiter —
+        exactly one side wins, so a job finishing at ~timeout can't have
+        its full output clobbered by the partial+TIMEOUT record (or vice
+        versa). The winner then archives RESULTs and writes .out BEFORE
+        creating .done: consumers (bench.py's relay) poll .done and read
+        .out/the ledger, so .done must be the LAST artifact to appear
+        (ADVICE r4: the old done-first ordering opened a window where a
+        finished job had no .out and no ledger record)."""
         try:
-            fd = os.open(done, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
             return False
-        with os.fdopen(fd, "w") as f:
-            f.write(verdict + "\n")
+        os.close(fd)
         return True
+
+    def put_done(done: str, verdict: str) -> None:
+        write_atomic(done, verdict + "\n")
 
     def write_atomic(path: str, text: str) -> None:
         tmp = f"{path}.tmp{threading.get_ident()}"
@@ -212,6 +219,20 @@ def main() -> int:
         os.replace(tmp, path)
 
     abandoned_len: dict = {}  # job -> stdout bytes archived by watchdog
+
+    # A runner that died between winning the finalize claim and writing
+    # .done leaves a stale .claim that would make the re-executed job lose
+    # its own finalize race and never produce .done. A fresh process has
+    # no in-flight job threads, so any .claim without a .done is from a
+    # dead runner: sweep them so queued jobs re-run to completion.
+    for f in os.listdir(JOBS):
+        if f.endswith(".done.claim") and not os.path.exists(
+            os.path.join(JOBS, f[: -len(".claim")])
+        ):
+            try:
+                os.remove(os.path.join(JOBS, f))
+            except OSError:
+                pass
 
     def run_job(name, py, out, done, buf, job_env):
         demux.register(buf)
@@ -226,11 +247,12 @@ def main() -> int:
         finally:
             demux.unregister()
         payload = buf.getvalue()
-        if claim_done(done, "ok" if ok else "error"):
-            # Archive before exposing .out: a poller that races the
-            # write falls back to the ledger, which already has it.
+        if claim_finalize(done + ".claim"):
+            # Archive + expose .out first, .done last: a poller that sees
+            # .done must find the result already durable.
             _archive_results(name, payload)
             write_atomic(out, payload)
+            put_done(done, "ok" if ok else "error")
             verdict = "ok" if ok else "ERROR"
         else:
             # Watchdog abandoned us first; the TIMEOUT record in .out
@@ -271,22 +293,25 @@ def main() -> int:
             th.join(timeout_s)
             if th.is_alive():
                 # Watchdog: abandon the job, keep draining the queue.
-                # Never kill the process — it holds the claim. Partial
-                # output first (skipped if the job just wrote its own),
-                # then the atomic done claim.
-                if not os.path.exists(out):
-                    write_atomic(
-                        out,
-                        buf.getvalue()
-                        + f"\nTIMEOUT after {timeout_s:.0f}s — job "
-                        f"abandoned by watchdog (thread left running; "
-                        f"late output, if any, lands in {name}.out.late)\n",
-                    )
-                if claim_done(done, "timeout"):
+                # Never kill the process — it holds the claim. Record
+                # abandoned_len BEFORE taking the claim (ADVICE r4: a job
+                # thread finishing in the window after the claim would pop
+                # 0 and re-archive its full payload, duplicating ledger
+                # rows); if the job wins the race instead, drop the entry.
+                partial = buf.getvalue()
+                abandoned_len[name] = len(partial)
+                if claim_finalize(done + ".claim"):
                     abandoned += 1
-                    partial = buf.getvalue()
-                    abandoned_len[name] = len(partial)
+                    if not os.path.exists(out):
+                        write_atomic(
+                            out,
+                            partial
+                            + f"\nTIMEOUT after {timeout_s:.0f}s — job "
+                            f"abandoned by watchdog (thread left running; "
+                            f"late output, if any, lands in {name}.out.late)\n",
+                        )
                     _archive_results(name, partial)
+                    put_done(done, "timeout")
                     demux.real.write(
                         f"job {name}: TIMEOUT after {timeout_s:.0f}s "
                         f"(abandoned={abandoned})\n"
@@ -298,6 +323,10 @@ def main() -> int:
                     # them (jax arrays are immutable, so shared values
                     # are safe — rebinding is the hazard).
                     env = dict(env)
+                else:
+                    # The job thread won the finalize race at ~timeout;
+                    # it archives its own full payload.
+                    abandoned_len.pop(name, None)
             else:
                 demux.real.write(f"  ({name} took {time.time() - t1:.1f}s)\n")
                 demux.real.flush()
