@@ -73,10 +73,22 @@ class EtcdClient:
         self._token: Optional[str] = None
         self._build()
 
-    def next_endpoint(self) -> None:
-        """Rotate to the next configured etcd member (failover)."""
+    @property
+    def endpoint_ix(self) -> int:
+        return self._endpoint_ix
+
+    def next_endpoint(self, observed_ix: Optional[int] = None) -> None:
+        """Rotate to the next configured etcd member (failover).
+
+        `observed_ix` is the endpoint index the caller saw fail; rotation
+        is skipped when another caller already rotated away from it.
+        Without this CAS the register and watch loops — which share one
+        client — each rotate after failing on the same dead member and
+        land straight back on it, a livelock with two endpoints."""
         if len(self.endpoints) <= 1:
             return
+        if observed_ix is not None and observed_ix != self._endpoint_ix:
+            return  # someone else already failed over
         old = self.channel
         self._endpoint_ix = (self._endpoint_ix + 1) % len(self.endpoints)
         self._token = None  # tokens are per-member sessions
@@ -222,6 +234,7 @@ class EtcdPool:
     async def _register_loop(self) -> None:
         backoff = 0.5
         while self._running:
+            ix = self.client.endpoint_ix
             try:
                 await self._register_once()
                 log.info(
@@ -238,7 +251,7 @@ class EtcdPool:
                 if not self._running:
                     return
                 log.warning("etcd registration failed: %s", e)
-                self.client.next_endpoint()
+                self.client.next_endpoint(ix)
             await asyncio.sleep(min(backoff, BACKOFF_S))
             backoff *= 2
 
@@ -318,6 +331,7 @@ class EtcdPool:
 
     async def _watch_loop(self) -> None:
         while self._running:
+            ix = self.client.endpoint_ix
             try:
                 revision = await self._collect_peers()
                 md = await self.client.auth_metadata()
@@ -350,7 +364,7 @@ class EtcdPool:
                 if not self._running:
                     return
                 log.warning("etcd watch failed, restarting: %s", e)
-                self.client.next_endpoint()
+                self.client.next_endpoint(ix)
             if self._running:
                 await asyncio.sleep(0.5)
 
