@@ -66,18 +66,28 @@ class FusedTable(NamedTuple):
     def num_slots(self) -> int:
         return self.data.shape[0]
 
-    # Wide-compatible host views (live_count, key pruning, tests)
+    # Wide-compatible host views (live_count, key pruning, tests).
+    # `...` indexing so they also work on a device-stacked (D, N, C)
+    # table (parallel/ici.py IciState).
     @property
     def used(self) -> jnp.ndarray:
-        return (self.data[:, META] & META_USED) != 0
+        return (self.data[..., META] & META_USED) != 0
 
     @property
     def key_hi(self) -> jnp.ndarray:
-        return self.data[:, KHI]
+        return self.data[..., KHI]
 
     @property
     def key_lo(self) -> jnp.ndarray:
-        return self.data[:, KLO]
+        return self.data[..., KLO]
+
+    @property
+    def expire_at(self) -> jnp.ndarray:
+        return self.data[..., EXP]
+
+    @property
+    def remaining(self) -> jnp.ndarray:
+        return self.data[..., REM]
 
     @staticmethod
     def create(num_groups: int, ways: int = 8) -> "FusedTable":

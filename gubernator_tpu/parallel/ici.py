@@ -45,26 +45,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.api.types import Behavior
 from gubernator_tpu.models.bucket import FIXED_SHIFT
-from gubernator_tpu.ops.decide import _decide_impl
+from gubernator_tpu.ops.kernels import get_raw_kernels
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
 
 AXIS = "owners"
 I64 = jnp.int64
 
+# Same flagship default as the single-chip engine and the sharded tier
+# (VERDICT r4 item 2): the replica decide runs layout-native; only the
+# sync tick's merge goes through the wide view (to_wide/from_wide).
+DEFAULT_LAYOUT = "fused"
+
 
 class IciState(NamedTuple):
     """Per-device replica tables + pending hit deltas.
 
-    Every SlotTable leaf is stacked (D, N) and sharded on the device
+    Every table leaf is stacked (D, ...) and sharded on the device
     axis; `pending` is (D, N) int64 hit deltas awaiting the next sync,
     recorded at the slot where the key resides on THAT device.
     """
 
-    table: SlotTable
+    table: object  # layout-native table, leaves stacked (D, ...)
     pending: jnp.ndarray
 
 
-def create_ici_state(mesh: Mesh, num_slots: int, ways: int = 1) -> IciState:
+def create_ici_state(
+    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT
+) -> IciState:
     n_dev = mesh.devices.size
     assert num_slots % ways == 0, "num_slots must divide by ways"
     num_groups = num_slots // ways
@@ -72,7 +79,7 @@ def create_ici_state(mesh: Mesh, num_slots: int, ways: int = 1) -> IciState:
         "num_slots/ways (group count) must divide by mesh size"
     )
     sharding = NamedSharding(mesh, P(AXIS))
-    table = SlotTable.create(num_groups, ways)
+    table = get_raw_kernels(layout).create(num_groups, ways)
     stacked = jax.tree.map(
         lambda x: jax.device_put(
             jnp.broadcast_to(x[None], (n_dev,) + x.shape), sharding
@@ -93,7 +100,39 @@ def _unsqueeze(tree):
     return jax.tree.map(lambda x: x[None], tree)
 
 
-def make_replica_decide(mesh: Mesh, num_slots: int, ways: int = 1):
+def _replica_step(RK, ways, groups_per, num_slots, dev, tbl, pending,
+                  batch, home, now):
+    """One device-local replica decide: answer my lanes, maintain pending
+    deltas. Shared by the single-step and scan factories."""
+    mine = batch.active & (home == dev)
+    local_batch = batch._replace(active=mine)
+
+    tbl, out = RK.decide(tbl, local_batch, now, ways)
+
+    # If this request replaced a DIFFERENT key at its landing slot
+    # (W-way eviction), the old key's un-synced pending hits must not
+    # be credited to the new key — drop them. A freed slot (token
+    # RESET_REMAINING) likewise clears its pending: the reset erased
+    # the entry the delta belonged to.
+    drop = mine & (
+        (out.evicted_hi != 0) | (out.evicted_lo != 0) | out.freed
+    )
+    evict_idx = jnp.where(drop, out.slot, num_slots)
+    pending = pending.at[evict_idx].set(0, mode="drop")
+
+    # Accumulate deltas for lanes I answered but do not own
+    # (reference globalManager.QueueHit, global.go:74-78).
+    owned = (batch.group.astype(I64) // groups_per) == dev
+    is_global = (batch.behavior & int(Behavior.GLOBAL)) != 0
+    pend_mask = mine & ~owned & is_global & (batch.hits != 0)
+    idx = jnp.where(pend_mask, out.slot, num_slots)
+    pending = pending.at[idx].add(batch.hits, mode="drop")
+    return tbl, pending, out
+
+
+def make_replica_decide(
+    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT
+):
     """decide(state, batch, home, now): lane i is answered by device
     home[i]'s replica (the node the request arrived at); non-owned GLOBAL
     hits are accumulated into that device's pending deltas at the slot
@@ -101,36 +140,14 @@ def make_replica_decide(mesh: Mesh, num_slots: int, ways: int = 1):
     n_dev = mesh.devices.size
     num_groups = num_slots // ways
     groups_per = num_groups // n_dev
+    RK = get_raw_kernels(layout)
 
     def local(state: IciState, batch: RequestBatch, home, now):
         dev = jax.lax.axis_index(AXIS).astype(I64)
-        tbl = _squeeze(state.table)
-        pending = state.pending[0]
-
-        mine = batch.active & (home == dev)
-        local_batch = batch._replace(active=mine)
-
-        tbl, out = _decide_impl(tbl, local_batch, now, ways=ways)
-
-        # If this request replaced a DIFFERENT key at its landing slot
-        # (W-way eviction), the old key's un-synced pending hits must not
-        # be credited to the new key — drop them. A freed slot (token
-        # RESET_REMAINING) likewise clears its pending: the reset erased
-        # the entry the delta belonged to.
-        drop = mine & (
-            (out.evicted_hi != 0) | (out.evicted_lo != 0) | out.freed
+        tbl, pending, out = _replica_step(
+            RK, ways, groups_per, num_slots, dev,
+            _squeeze(state.table), state.pending[0], batch, home, now,
         )
-        evict_idx = jnp.where(drop, out.slot, num_slots)
-        pending = pending.at[evict_idx].set(0, mode="drop")
-
-        # Accumulate deltas for lanes I answered but do not own
-        # (reference globalManager.QueueHit, global.go:74-78).
-        owned = (batch.group.astype(I64) // groups_per) == dev
-        is_global = (batch.behavior & int(Behavior.GLOBAL)) != 0
-        pend_mask = mine & ~owned & is_global & (batch.hits != 0)
-        idx = jnp.where(pend_mask, out.slot, num_slots)
-        pending = pending.at[idx].add(batch.hits, mode="drop")
-
         out = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
         return IciState(table=_unsqueeze(tbl), pending=pending[None]), out
 
@@ -150,18 +167,67 @@ def make_replica_decide(mesh: Mesh, num_slots: int, ways: int = 1):
     return decide_fn
 
 
-def make_inject_replicas(mesh: Mesh, num_slots: int, ways: int = 1):
+def make_replica_decide_scan(
+    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT
+):
+    """Scan variant: decide(state, batches, homes, nows) where every
+    input is stacked (S, ...) — S replica decide steps in ONE dispatch.
+    Benchmarks need this to cancel per-dispatch tunnel RTT the same way
+    decide_scan does for the single-chip kernel (bench.py kernel mode)."""
+    n_dev = mesh.devices.size
+    num_groups = num_slots // ways
+    groups_per = num_groups // n_dev
+    RK = get_raw_kernels(layout)
+
+    def local(state: IciState, batches: RequestBatch, homes, nows):
+        dev = jax.lax.axis_index(AXIS).astype(I64)
+
+        def step(carry, xs):
+            tbl, pending = carry
+            b, home, now = xs
+            tbl, pending, out = _replica_step(
+                RK, ways, groups_per, num_slots, dev,
+                tbl, pending, b, home, now,
+            )
+            return (tbl, pending), out
+
+        (tbl, pending), outs = jax.lax.scan(
+            step, (_squeeze(state.table), state.pending[0]),
+            (batches, homes, nows),
+        )
+        # One collective per output leaf on the stacked (S, B) results,
+        # instead of one per scan step.
+        outs = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), outs)
+        return IciState(table=_unsqueeze(tbl), pending=pending[None]), outs
+
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(), P(), P()),
+        out_specs=(P(AXIS), P()),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scan_fn(state: IciState, batches: RequestBatch, homes, nows):
+        return sharded(
+            state, batches, jnp.asarray(homes, I64), jnp.asarray(nows, I64)
+        )
+
+    return scan_fn
+
+
+def make_inject_replicas(
+    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT
+):
     """Apply authoritative state rows to EVERY device's replica — the
     landing side of a cross-pod UpdatePeerGlobals push (the intra-pod
     sync uses make_sync_step's rebroadcast instead)."""
-    from gubernator_tpu.ops.inject import InjectBatch  # noqa: F401
+    RK = get_raw_kernels(layout)
 
     def local(state: IciState, items, now):
-        from gubernator_tpu.ops.inject import _inject_impl
-
         tbl = _squeeze(state.table)
         pending = state.pending[0]
-        tbl, _ehi, _elo = _inject_impl(tbl, items, now, ways=ways)
+        tbl, _ehi, _elo = RK.inject(tbl, items, now, ways)
         # The authoritative push supersedes this pod's un-synced local
         # deltas for these keys (the host tier already carried them to
         # the owner); leaving them would re-apply the same hits at the
@@ -190,7 +256,9 @@ def make_inject_replicas(mesh: Mesh, num_slots: int, ways: int = 1):
     return inject_fn
 
 
-def make_sync_step(mesh: Mesh, num_slots: int, ways: int = 1):
+def make_sync_step(
+    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT
+):
     """One collective sync tick: deltas -> owners -> authoritative apply ->
     replica rebroadcast. Replaces both gRPC legs of the reference's
     globalManager with ~20 psums over ICI.
@@ -198,15 +266,21 @@ def make_sync_step(mesh: Mesh, num_slots: int, ways: int = 1):
     With W>1 the merge key-matches across the ways of each group (a key
     sits in different ways on different devices); adoption stays
     per-slot-position and is deduplicated within the group afterwards so
-    the rebroadcast layout never holds the same key twice."""
+    the rebroadcast layout never holds the same key twice.
+
+    The merge itself is layout-agnostic: a non-wide replica table is
+    unpacked to the wide column view at tick entry and repacked at exit
+    (two elementwise passes — the decide hot path stays layout-native;
+    only this 10Hz tick pays the conversion)."""
     n_dev = mesh.devices.size
     num_groups = num_slots // ways
     groups_per = num_groups // n_dev
     G, W = num_groups, ways
+    RK = get_raw_kernels(layout)
 
     def local(state: IciState, now):
         dev = jax.lax.axis_index(AXIS).astype(I64)
-        t = _squeeze(state.table)
+        t = RK.to_wide(_squeeze(state.table))
         pending = state.pending[0]
         psum = lambda x: jax.lax.psum(x, AXIS)  # noqa: E731
 
@@ -244,15 +318,25 @@ def make_sync_step(mesh: Mesh, num_slots: int, ways: int = 1):
         )
         inc_match = crossway_inc(ow_hi, ow_lo, ow_lv)
 
-        # Adoption: a replica holds a live entry with pending hits whose
-        # key is absent from the owner's layout (the relayed request
-        # would have created the entry at the owner in the reference).
-        # Candidates are selected per slot position (lowest device index
-        # wins), deduplicated, then packed into the owner group's EMPTY
-        # ways in rank order — a candidate is not tied to its own way
-        # position, so an owner group with free space always absorbs
-        # overflow keys regardless of where replicas placed them.
-        cand = live & (pending != 0)
+        # Adoption: a replica holds a live entry whose key is absent from
+        # the owner's layout (the relayed request would have created the
+        # entry at the owner in the reference — including zero-hit reads:
+        # gating on pending!=0 left read-created buckets replica-local
+        # FOREVER, permanently inflating the overflow-kept gauge).
+        # Candidacy pre-filters keys already in the owner layout for the
+        # group, so a rebroadcast copy never shadows a genuinely-missing
+        # key at the same way position. Candidates are selected per slot
+        # position (lowest device index wins), deduplicated, then packed
+        # into the owner group's EMPTY ways in rank order — a candidate
+        # is not tied to its own way position, so an owner group with
+        # free space always absorbs overflow keys regardless of where
+        # replicas placed them.
+        in_own_src = (
+            ow_lv[:, None, :]
+            & (lk_hi[:, :, None] == ow_hi[:, None, :])
+            & (lk_lo[:, :, None] == ow_lo[:, None, :])
+        ).any(axis=2)  # [g, w_src]: my key at (g, w_src) is owner-known
+        cand = live & ~in_own_src.reshape(num_slots)
         sel = jax.lax.pmin(jnp.where(cand, dev, n_dev), AXIS)
         is_sel = cand & (dev == sel)
         adopted_key_hi = psum(jnp.where(is_sel, t.key_hi, 0))
@@ -267,19 +351,11 @@ def make_sync_step(mesh: Mesh, num_slots: int, ways: int = 1):
         def adopt(field):
             return psum(jnp.where(is_sel, field.astype(I64), 0))
 
-        # A candidate is dropped when its key already lives somewhere in
-        # the owner's layout for the group (its deltas were credited
-        # there by inc_match), and deduplicated against lower-way
-        # candidates holding the same key (two devices may hold the same
-        # pending key at different way positions). Both masks are vacuous
-        # at W=1.
-        dup_own = (
-            ad_ok[:, :, None]
-            & ow_lv[:, None, :]
-            & (ad_hi[:, :, None] == ow_hi[:, None, :])
-            & (ad_lo[:, :, None] == ow_lo[:, None, :])
-        ).any(axis=2)
-        ua1 = ad_ok & ~dup_own
+        # Owner-layout keys were already excluded at candidacy
+        # (in_own_src), so only same-key dedup against lower-way
+        # candidates remains (two devices may hold the same key at
+        # different way positions). Vacuous at W=1.
+        ua1 = ad_ok
         same = (ad_hi[:, :, None] == ad_hi[:, None, :]) & (
             ad_lo[:, :, None] == ad_lo[:, None, :]
         )
@@ -422,7 +498,10 @@ def make_sync_step(mesh: Mesh, num_slots: int, ways: int = 1):
         kept_total = jnp.sum(kept.astype(I64))
         diag = jnp.stack([kept_total, surv_total - kept_total])[None, :]
         return (
-            IciState(table=_unsqueeze(new_table), pending=new_pending[None]),
+            IciState(
+                table=_unsqueeze(RK.from_wide(new_table)),
+                pending=new_pending[None],
+            ),
             diag,
         )
 

@@ -67,6 +67,9 @@ class IciEngineConfig:
     max_flush_items: int = 8192
     max_waves: int = 32  # per-flush wave cap; overflow carries over
     sync_wait_s: float = 0.1  # GLOBAL sync cadence (reference 100ms)
+    # Table layout for BOTH the sharded and replica tiers (ops/kernels.py);
+    # fused is the TPU production layout (VERDICT r4 item 2).
+    layout: str = "fused"
 
 
 class IciEngine(EngineBase):
@@ -93,20 +96,26 @@ class IciEngine(EngineBase):
         self.metrics = EngineMetrics()
 
         # Owner-sharded authoritative path
-        self.table = pmesh.create_sharded_table(self.mesh, cfg.num_groups, cfg.ways)
-        self._decide = pmesh.make_sharded_decide(self.mesh, cfg.num_groups, cfg.ways)
+        self.table = pmesh.create_sharded_table(
+            self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
+        )
+        self._decide = pmesh.make_sharded_decide(
+            self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
+        )
 
         # GLOBAL replica path
         self.num_rgroups = cfg.num_slots // cfg.replica_ways
         self.ici_state = ici.create_ici_state(
-            self.mesh, cfg.num_slots, cfg.replica_ways
+            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
         )
         self._replica = ici.make_replica_decide(
-            self.mesh, cfg.num_slots, cfg.replica_ways
+            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
         )
-        self._sync = ici.make_sync_step(self.mesh, cfg.num_slots, cfg.replica_ways)
+        self._sync = ici.make_sync_step(
+            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
+        )
         self._inject_replicas = ici.make_inject_replicas(
-            self.mesh, cfg.num_slots, cfg.replica_ways
+            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
         )
 
         self._lock = threading.Lock()

@@ -153,6 +153,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             sync_wait_s=behaviors.global_sync_wait_s,
             batch_wait_s=behaviors.batch_wait_s,
             batch_limit=behaviors.batch_limit,
+            layout=_env("GUBER_ICI_LAYOUT", base.layout),
         )
 
     # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2

@@ -212,6 +212,53 @@ def test_graft_entry_single_chip():
     assert int(out.misses) > 0
 
 
+def test_replica_scan_matches_single_steps(mesh):
+    """make_replica_decide_scan (one dispatch, S steps) must produce the
+    same outputs and final state as S single-step dispatches."""
+    num_slots, ways, S = 64 * NDEV, 4, 5
+    state_a = ici.create_ici_state(mesh, num_slots, ways)
+    state_b = ici.create_ici_state(mesh, num_slots, ways)
+    step_fn = ici.make_replica_decide(mesh, num_slots, ways)
+    scan_fn = ici.make_replica_decide_scan(mesh, num_slots, ways)
+
+    num_groups = num_slots // ways
+    batches, homes, nows = [], [], []
+    for s in range(S):
+        b = encode_batch(
+            [_global_req(f"scan:{s}:{i}", hits=2 + s) for i in range(3)],
+            NOW + s, num_groups, 8,
+        )
+        batches.append(b)
+        homes.append(np.full((8,), s % NDEV, dtype=np.int64))
+        nows.append(NOW + s)
+
+    outs_a = []
+    for b, h, t in zip(batches, homes, nows):
+        state_a, out = step_fn(state_a, b, h, t)
+        outs_a.append(out)
+
+    import jax as _jax
+
+    stacked = _jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    state_b, outs_b = scan_fn(
+        state_b, stacked, np.stack(homes), np.array(nows, dtype=np.int64)
+    )
+
+    for s, out in enumerate(outs_a):
+        for f in ("status", "remaining", "reset_time", "limit"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, f)),
+                np.asarray(getattr(outs_b, f))[s],
+                err_msg=f"step {s} field {f}",
+            )
+    np.testing.assert_array_equal(
+        np.asarray(state_a.table.data), np.asarray(state_b.table.data)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state_a.pending), np.asarray(state_b.pending)
+    )
+
+
 def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 

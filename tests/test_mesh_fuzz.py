@@ -22,11 +22,16 @@ NUM_GROUPS = 8 * NDEV  # tiny: forces group collisions -> multi-batch waves
 B = 16
 
 
-@pytest.mark.parametrize("seed", [21, 22, 23])
-def test_sharded_mesh_fuzz(seed):
+@pytest.mark.parametrize(
+    "seed,layout",
+    # fused is the factory default (flagship); wide keeps explicit
+    # differential coverage of the same SPMD path (VERDICT r4 item 2).
+    [(21, "fused"), (22, "fused"), (23, "fused"), (21, "wide")],
+)
+def test_sharded_mesh_fuzz(seed, layout):
     mesh = pmesh.make_mesh(jax.devices()[:NDEV])
-    table = pmesh.create_sharded_table(mesh, NUM_GROUPS, ways=4)
-    decide_fn = pmesh.make_sharded_decide(mesh, NUM_GROUPS, ways=4)
+    table = pmesh.create_sharded_table(mesh, NUM_GROUPS, ways=4, layout=layout)
+    decide_fn = pmesh.make_sharded_decide(mesh, NUM_GROUPS, ways=4, layout=layout)
     oracle = OracleEngine()
 
     rng = random.Random(seed)
