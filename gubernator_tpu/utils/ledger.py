@@ -117,7 +117,7 @@ def latest(
 
 
 _MODE_FROM_JOB = re.compile(
-    r"(kernel10m|kernel|engine|server|global|latency|edge)"
+    r"(kernel10m|kernel|engine|server|global|latency|edge|ici)"
 )
 _LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide)")
 

@@ -1,0 +1,66 @@
+"""Serial load-generator worker for the edge-tier aggregate bench.
+
+One process = one client connection driving pre-serialized GetRateLimits
+batches at a single edge's gRPC listener (bench.py --mode edge spawns N
+edges x K of these). Serial on purpose: per-process scaling is the thing
+being measured, and a serial client's throughput is bounded by the full
+round-trip latency, so aggregate/clients also bounds per-call p99.
+
+argv: <edge_grpc_addr> <n_calls> <batch_items> <key_space>
+stdout: one JSON line {t_start, t_end, calls, items, lat_ms: [...]}
+(wall-clock epoch stamps so the parent can merge concurrent windows).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    addr, n_calls, batch, n_keys = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import grpc
+    import numpy as np
+
+    from gubernator_tpu.service import pb
+
+    rng = np.random.default_rng(os.getpid())
+    payloads = []
+    for _ in range(8):
+        msg = pb.pb.GetRateLimitsReq()
+        for k in rng.integers(0, n_keys, batch):
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name="bench_edge", unique_key=f"e{k}",
+                    duration=60_000, limit=1_000_000_000, hits=1,
+                )
+            )
+        payloads.append(msg.SerializeToString())
+
+    async def run():
+        async with grpc.aio.insecure_channel(addr) as ch:
+            call = ch.unary_unary("/pb.gubernator.V1/GetRateLimits")
+            for p in payloads[:3]:  # warm the connection + daemon path
+                await call(p)
+            lat = []
+            t_start = time.time()
+            for i in range(n_calls):
+                t1 = time.perf_counter()
+                raw = await call(payloads[i % len(payloads)])
+                lat.append((time.perf_counter() - t1) * 1e3)
+                assert len(raw) > 0
+            return t_start, time.time(), lat
+
+    t_start, t_end, lat = asyncio.run(run())
+    print(json.dumps({
+        "t_start": t_start, "t_end": t_end, "calls": n_calls,
+        "items": n_calls * batch, "lat_ms": lat,
+    }))
+
+
+if __name__ == "__main__":
+    main()
