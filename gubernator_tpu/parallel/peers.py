@@ -219,7 +219,7 @@ class PeerMesh:
         self,
         svc,
         behaviors: BehaviorConfig,
-        hash_name: str = "fnv1",
+        hash_name: str = "fnv1a-mix",
         replicas: int = 512,
         credentials=None,
     ):
@@ -373,7 +373,7 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     mesh = PeerMesh(
         svc,
         conf.behaviors,
-        hash_name=getattr(conf, "peer_picker_hash", "fnv1"),
+        hash_name=getattr(conf, "peer_picker_hash", "fnv1a-mix"),
         replicas=getattr(conf, "hash_replicas", 512),
         credentials=credentials,
     )

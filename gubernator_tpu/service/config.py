@@ -144,8 +144,11 @@ class DaemonConfig:
     # intentionally has no effect (documented N/A).
     worker_count: int = 0
 
-    # Peer picker tuning (reference config.go:421-443)
-    peer_picker_hash: str = "fnv1"
+    # Peer picker tuning (reference config.go:421-443). Default
+    # fnv1a-mix (fnv1a + murmur fmix64 finalizer) for distribution
+    # quality — bare FNV skews badly on sequential keys; "fnv1" is the
+    # reference-compat opt-in for drop-in key->owner ring parity.
+    peer_picker_hash: str = "fnv1a-mix"
     hash_replicas: int = 512
 
     # Optional TLS (service.tls.TlsConfig); None = plaintext

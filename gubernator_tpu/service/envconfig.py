@@ -242,23 +242,21 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             )
 
     # Peer picker (reference config.go:421-443): GUBER_PEER_PICKER selects
-    # the implementation (only replicated-hash exists); its hash defaults
-    # to fnv1a when selected explicitly, fnv1 otherwise (matching the
-    # reference's two defaults).
+    # the implementation (only replicated-hash exists). The hash defaults
+    # to fnv1a-mix for distribution quality (bare FNV skews badly on
+    # sequential keys); set GUBER_PEER_PICKER_HASH=fnv1 ONLY for
+    # drop-in key->owner parity with a live reference cluster.
     picker = _env("GUBER_PEER_PICKER", "")
-    if picker:
-        if picker != "replicated-hash":
-            raise ValueError(
-                f"'GUBER_PEER_PICKER={picker}' is invalid; choices are "
-                "['replicated-hash', 'consistent-hash']"
-            )
-        conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1a")
-    else:
-        conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1")
-    if conf.peer_picker_hash not in ("fnv1", "fnv1a"):
+    if picker and picker != "replicated-hash":
+        raise ValueError(
+            f"'GUBER_PEER_PICKER={picker}' is invalid; choices are "
+            "['replicated-hash', 'consistent-hash']"
+        )
+    conf.peer_picker_hash = _env("GUBER_PEER_PICKER_HASH", "fnv1a-mix")
+    if conf.peer_picker_hash not in ("fnv1", "fnv1a", "fnv1a-mix"):
         raise ValueError(
             f"'GUBER_PEER_PICKER_HASH={conf.peer_picker_hash}' is invalid; "
-            "choices are [fnv1, fnv1a]"
+            "choices are [fnv1, fnv1a, fnv1a-mix]"
         )
     conf.hash_replicas = _env_int("GUBER_REPLICATED_HASH_REPLICAS", 512)
 

@@ -55,6 +55,7 @@ _SLOW_BEHAVIOR = (
 _RING_VARIANT = {
     hash_ring.fnv1_64: "fnv1",
     hash_ring.fnv1a_64: "fnv1a",
+    hash_ring.fnv1a_mix_64: "fnv1a-mix",
 }
 
 

@@ -245,4 +245,13 @@ def fnv1_batch(key_data: np.ndarray, key_offsets: np.ndarray, variant: str = "fn
     out = np.empty(n, np.uint64)
     fn = lib.guber_fnv1_batch if variant == "fnv1" else lib.guber_fnv1a_batch
     fn(key_data, key_offsets, n, out)
+    if variant == "fnv1a-mix":
+        # murmur3 fmix64 finalizer, vectorized (must match
+        # hash_ring.fmix64 bit-for-bit — ring placement parity).
+        with np.errstate(over="ignore"):
+            out ^= out >> np.uint64(33)
+            out *= np.uint64(0xFF51AFD7ED558CCD)
+            out ^= out >> np.uint64(33)
+            out *= np.uint64(0xC4CEB9FE1A85EC53)
+            out ^= out >> np.uint64(33)
     return out

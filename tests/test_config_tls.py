@@ -160,9 +160,9 @@ def test_setup_daemon_config_parity_tail(monkeypatch):
     assert conf.status_http_listen_address == "127.0.0.1:0"
     assert conf.dns_resolv_conf == "/tmp/resolv.conf"
     assert conf.gossip_advertise == "10.0.0.5:7946"
-    # GUBER_PEER_PICKER selected -> hash defaults to fnv1a (reference
-    # config.go:429)
-    assert conf.peer_picker_hash == "fnv1a"
+    # hash defaults to fnv1a-mix regardless of GUBER_PEER_PICKER
+    # (distribution quality; fnv1 is the reference-parity opt-in)
+    assert conf.peer_picker_hash == "fnv1a-mix"
     assert conf.hash_replicas == 128
     assert conf.tls.min_version == ssl.TLSVersion.TLSv1_2
     assert conf.tls.client_auth_server_name == "gubernator.example"

@@ -348,8 +348,11 @@ def test_local_mask_matches_get():
     offsets = np.zeros(len(keys) + 1, np.int64)
     data = b"".join(k.encode() for k in keys)
     np.cumsum([len(k) for k in keys], out=offsets[1:])
+    from gubernator_tpu.service.fastpath import _RING_VARIANT
+
     hashes = wire.fnv1_batch(
-        np.frombuffer(data, np.uint8).copy(), offsets, "fnv1"
+        np.frombuffer(data, np.uint8).copy(), offsets,
+        _RING_VARIANT[ring.hash_fn],
     )
     mask = ring.local_mask(hashes)
     for i, k in enumerate(keys):

@@ -10,6 +10,7 @@ from gubernator_tpu.parallel.hash_ring import (
     ReplicatedConsistentHash,
     fnv1_64,
     fnv1a_64,
+    fnv1a_mix_64,
 )
 
 
@@ -39,7 +40,7 @@ def test_fnv_vectors():
     assert fnv1_64("a") == 0xAF63BD4C8601B7BE
 
 
-@pytest.mark.parametrize("hash_name", ["fnv1", "fnv1a"])
+@pytest.mark.parametrize("hash_name", ["fnv1", "fnv1a", "fnv1a-mix"])
 def test_distribution_quality(hash_name):
     """Well-spread keys distribute within the reference's observed skew
     (its own test records ~2948/3592/3460 for 10k keys on 3 hosts)."""
@@ -52,6 +53,25 @@ def test_distribution_quality(hash_name):
     assert sum(counts.values()) == 10_000
     for h in HOSTS:
         assert 2000 < counts[h] < 5000, (hash_name, dict(counts))
+
+
+def test_sequential_key_distribution_default_hash():
+    """Why fnv1a-mix is the default: sequential short-suffix keys
+    ("acct:0".."acct:9999") — the shape real rate-limit keys take —
+    must spread within the reference's ~±10% tolerance. Bare FNV (either
+    variant) never avalanches its trailing bytes, so 10k sequential keys
+    span only ~2^53 of the 64-bit space and cluster in a narrow ring
+    band (measured worst-host skew here: fnv1 +65%, fnv1a +31%); the
+    murmur fmix64 finalizer brings that to ~4%."""
+    ring = ReplicatedConsistentHash()  # default hash
+    assert ring.hash_fn is fnv1a_mix_64
+    for h in HOSTS:
+        ring.add(FakePeer(h))
+    keys = [f"acct:{i}" for i in range(10_000)]
+    counts = Counter(ring.get(k).info.grpc_address for k in keys)
+    mean = 10_000 / len(HOSTS)
+    for h in HOSTS:
+        assert abs(counts[h] - mean) / mean < 0.10, dict(counts)
 
 
 def test_empty_ring_raises():
