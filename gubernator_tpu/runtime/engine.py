@@ -1333,12 +1333,14 @@ class DeviceEngine(EngineBase):
 
 def _select_columns(cols, select: np.ndarray):
     """Subset view of RequestColumns for check_columns(select=...): field
-    arrays are fancy-indexed; key bytes are NOT re-sliced (the caller
-    passes precomputed hashes, and key_string() is only used on the
-    original columns)."""
+    arrays are fancy-indexed; key bytes are NOT re-sliced — key hashes
+    are computed from the ORIGINAL columns before selection, and
+    key_string() must be called on the original columns too. key_offsets
+    is poisoned to None so any code path that tries to hash or slice
+    keys on the subset view fails loudly (TypeError) instead of reading
+    misaligned offsets."""
     import dataclasses as _dc
 
-    empty = np.zeros(1, np.int64)
     return _dc.replace(
         cols,
         n=int(len(select)),
@@ -1353,7 +1355,7 @@ def _select_columns(cols, select: np.ndarray):
         slow=cols.slow[select],
         name_lens=cols.name_lens[select],
         key_data=cols.key_data,
-        key_offsets=empty,  # unusable after select; hashes are precomputed
+        key_offsets=None,  # poisoned: unusable after select (see above)
     )
 
 

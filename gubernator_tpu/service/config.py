@@ -107,6 +107,9 @@ class DaemonConfig:
     gossip_advertise: str = ""  # reference GUBER_MEMBERLIST_ADVERTISE_ADDRESS
     gossip_seeds: List[str] = dataclasses.field(default_factory=list)
     gossip_interval_s: float = 1.0
+    # Shared HMAC key authenticating gossip datagrams (memberlist
+    # SecretKey analog; authenticates, does not encrypt). "" = off.
+    gossip_secret: str = ""
     # etcd / k8s discovery blocks (populated by the matching env vars)
     etcd: Optional[EtcdConfig] = None
     k8s: Optional[K8sConfig] = None

@@ -253,6 +253,7 @@ class Daemon:
                 seeds=conf.gossip_seeds,
                 interval_s=conf.gossip_interval_s,
                 advertise=conf.gossip_advertise,
+                secret=conf.gossip_secret,
             )
             await self._pool.started()  # resolve the ephemeral bind
         elif conf.discovery == "etcd":

@@ -191,13 +191,18 @@ class GossipPool:
     backend, reference memberlist.go:38-299, reimagined on stdlib
     asyncio UDP).
 
-    TRUST MODEL: datagrams are unauthenticated JSON — deploy only on
-    trusted LANs / private VPCs (the reference's memberlist default is
-    the same unless its encryption key is set). On a hostile network an
-    attacker can forge `from` fields to refresh a dead peer's liveness
-    or clear its tombstone, and forged suspect/dead gossip can evict a
-    live peer until it refutes. Use the etcd/k8s/DNS backends where the
-    network is not trusted.
+    TRUST MODEL: by default datagrams are unauthenticated JSON — deploy
+    only on trusted LANs / private VPCs (the reference's memberlist
+    default is the same unless its encryption key is set). On a hostile
+    network an attacker can forge `from` fields to refresh a dead peer's
+    liveness or clear its tombstone, and forged suspect/dead gossip can
+    evict a live peer until it refutes. Set `secret` (all nodes must
+    share it — the memberlist-SecretKey analog) to authenticate every
+    datagram with HMAC-SHA256: sends are prefixed with a 16-byte tag and
+    unauthenticated receives are dropped before parsing. Note HMAC
+    authenticates but does NOT encrypt (memberlist's SecretKey also
+    encrypts); membership views are still readable on the wire. Use the
+    etcd/k8s/DNS backends where the network is not trusted at all.
 
     Each node carries its own PeerInfo in its gossip state and
     periodically sends its full membership view (JSON datagram) to a few
@@ -237,12 +242,14 @@ class GossipPool:
         suspicion_intervals: int = 3,
         indirect_probes: int = 3,
         tombstone_intervals: int = 10,
+        secret: "str | bytes" = b"",  # shared HMAC key; b"" = unauthenticated
     ):
         import json as _json
         import random as _random
 
         self._json = _json
         self._random = _random
+        self._secret = secret.encode() if isinstance(secret, str) else secret
         self.bind = bind
         self.advertise = advertise
         self.info = info
@@ -337,8 +344,30 @@ class GossipPool:
                 }
         return self._json.dumps({"from": self.advertise, "peers": peers}).encode()
 
+    _TAG_LEN = 16  # truncated HMAC-SHA256, memberlist-style overhead
+
+    def _sign(self, payload: bytes) -> bytes:
+        import hmac as _hmac
+
+        tag = _hmac.new(self._secret, payload, "sha256").digest()
+        return tag[: self._TAG_LEN] + payload
+
+    def _authenticate(self, data: bytes) -> "bytes | None":
+        """Strip + verify the tag; None = drop (forged/unauthenticated)."""
+        import hmac as _hmac
+
+        if len(data) <= self._TAG_LEN:
+            return None
+        tag, payload = data[: self._TAG_LEN], data[self._TAG_LEN:]
+        want = _hmac.new(self._secret, payload, "sha256").digest()
+        if not _hmac.compare_digest(tag, want[: self._TAG_LEN]):
+            return None
+        return payload
+
     def _sendto(self, payload: bytes, addr: str) -> None:
         try:
+            if self._secret:
+                payload = self._sign(payload)
             host, port = addr.rsplit(":", 1)
             self._transport.sendto(payload, (host, int(port)))
         except Exception:
@@ -360,6 +389,10 @@ class GossipPool:
         import time as _time
 
         try:
+            if self._secret:
+                data = self._authenticate(data)
+                if data is None:
+                    return  # forged or unauthenticated: drop pre-parse
             msg = self._json.loads(data)
             if not isinstance(msg, dict):
                 return

@@ -183,6 +183,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     conf.gossip_interval_s = parse_duration_s(
         _env("GUBER_MEMBERLIST_GOSSIP_INTERVAL"), 1.0
     )
+    conf.gossip_secret = _env("GUBER_MEMBERLIST_SECRET_KEY", "")
     if conf.discovery == "member-list" and not conf.gossip_seeds:
         raise ValueError(
             "when using `member-list` for peer discovery, you MUST provide a "

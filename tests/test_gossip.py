@@ -74,6 +74,67 @@ def test_gossip_pool_convergence_and_expiry(loop_thread):
     assert loop_thread.run(run(), timeout=30)
 
 
+def test_gossip_hmac_authentication(loop_thread):
+    """With a shared secret, signed pools converge; an unauthenticated
+    (or wrong-secret) sender is ignored — its datagrams are dropped
+    before parsing, so it never joins the signed membership."""
+
+    async def run():
+        p0 = GossipPool(
+            "127.0.0.1:0",
+            PeerInfo(grpc_address="s0:81"),
+            lambda peers: None,
+            interval_s=0.05,
+            secret="swordfish",
+        )
+        await p0._started
+        p1 = GossipPool(
+            "127.0.0.1:0",
+            PeerInfo(grpc_address="s1:81"),
+            lambda peers: None,
+            seeds=[p0.advertise],
+            interval_s=0.05,
+            secret="swordfish",
+        )
+        await p1._started
+        # forger: same seed, wrong key; intruder: no key at all
+        forger = GossipPool(
+            "127.0.0.1:0",
+            PeerInfo(grpc_address="evil:81"),
+            lambda peers: None,
+            seeds=[p0.advertise],
+            interval_s=0.05,
+            secret="wrong-key",
+        )
+        await forger._started
+        intruder = GossipPool(
+            "127.0.0.1:0",
+            PeerInfo(grpc_address="plain:81"),
+            lambda peers: None,
+            seeds=[p0.advertise],
+            interval_s=0.05,
+        )
+        await intruder._started
+        try:
+            want = {"s0:81", "s1:81"}
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if all(
+                    {p.grpc_address for p in pool.members()} == want
+                    for pool in (p0, p1)
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            for pool in (p0, p1):
+                got = {p.grpc_address for p in pool.members()}
+                assert got == want, got  # no evil/plain infiltration
+        finally:
+            for pool in (p0, p1, forger, intruder):
+                pool.close()
+
+    loop_thread.run(run(), timeout=30)
+
+
 def test_gossip_discovered_daemon_cluster(loop_thread):
     """Daemons that find each other purely via gossip route to one owner."""
 
