@@ -239,6 +239,14 @@ class Metrics:
             "Overflow entries dropped at sync under full-group pressure "
             "(local counter and un-synced deltas lost).",
         )
+        self.global_sync_backlog = Gauge(
+            "gubernator_global_sync_backlog",
+            "Active groups beyond the per-tick sync cap "
+            "(GUBER_ICI_SYNC_GROUPS) carried to the next tick; sustained "
+            "nonzero means GLOBAL convergence is running behind the "
+            "sync cadence.",
+            registry=r,
+        )
 
         # MULTI_REGION behavior (no reference analog — the reference's
         # RegionPicker ships unimplemented, region_picker.go:19-103;
@@ -324,5 +332,6 @@ def engine_sync(engine):
         if hasattr(engine, "overflow_keys"):  # ici-mode engines only
             m.global_overflow_keys.set(engine.overflow_keys)
             m.global_overflow_drops.set(engine.overflow_drops)
+            m.global_sync_backlog.set(getattr(engine, "sync_backlog", 0))
 
     return _sync

@@ -70,6 +70,13 @@ class IciEngineConfig:
     # Table layout for BOTH the sharded and replica tiers (ops/kernels.py);
     # fused is the TPU production layout (VERDICT r4 item 2).
     layout: str = "fused"
+    # Per-tick sync work cap (groups). The tick merges only groups whose
+    # content diverges across replicas or that hold pending deltas, up
+    # to this many per tick (overflow carries; diag backlog gauge).
+    # Bounds tick device time by ACTIVE traffic instead of table size,
+    # keeping the 100ms cadence at 10M+ key geometries. None = merge
+    # the full table every tick.
+    max_sync_groups: "int | None" = 65536
 
 
 class IciEngine(EngineBase):
@@ -112,7 +119,8 @@ class IciEngine(EngineBase):
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
         )
         self._sync = ici.make_sync_step(
-            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
+            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout,
+            max_sync_groups=cfg.max_sync_groups,
         )
         self._inject_replicas = ici.make_inject_replicas(
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
@@ -126,6 +134,7 @@ class IciEngine(EngineBase):
         # entries dropped under full-group pressure.
         self.overflow_keys = 0
         self.overflow_drops = 0
+        self.sync_backlog = 0
 
         self._warmup()
         self._init_base("ici-engine")
@@ -143,8 +152,14 @@ class IciEngine(EngineBase):
         with self._lock:
             self.ici_state, diag = self._sync(self.ici_state, now)
             d = np.asarray(diag)
+            # kept/dropped cover groups merged THIS tick; under a capped
+            # backlog, retained keys in unmerged groups surface when
+            # their group's turn comes. The backlog gauge (identical on
+            # every device; diag rows replicate it) is the overload
+            # signal.
             self.overflow_keys = int(d[:, 0].sum())
             self.overflow_drops += int(d[:, 1].sum())
+            self.sync_backlog = int(d[:, 2].max())
 
     def inject_globals(self, globals_) -> None:
         """Apply an authoritative UpdatePeerGlobals push to every replica

@@ -154,6 +154,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             batch_wait_s=behaviors.batch_wait_s,
             batch_limit=behaviors.batch_limit,
             layout=_env("GUBER_ICI_LAYOUT", base.layout),
+            # 0 = unbounded (merge the full table every tick)
+            max_sync_groups=(
+                _env_int("GUBER_ICI_SYNC_GROUPS", base.max_sync_groups or 0)
+                or None
+            ),
         )
 
     # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2
