@@ -412,10 +412,15 @@ def bench_global() -> dict:
     platform = jax.devices()[0].platform
 
     async def run():
+        import grpc
+
+        from gubernator_tpu.service import pb
+
         c = await Cluster.start(
             4, behaviors=BehaviorConfig(global_sync_wait_s=0.1), cache_size=65536
         )
         clients = [GubernatorClient(d.grpc_address) for d in c.daemons]
+        chans = []
         try:
             reqs = [
                 RateLimitReq(
@@ -427,22 +432,43 @@ def bench_global() -> dict:
             ]
             for cl in clients:
                 await cl.get_rate_limits(reqs[:100])  # warm all replicas
+            # Drive pre-serialized payloads over raw byte stubs: the
+            # measurement targets SERVER capacity; client-side protobuf
+            # objects would otherwise share the process GIL and dominate.
+            msg = pb.pb.GetRateLimitsReq()
+            for r in reqs:
+                msg.requests.append(pb.req_to_pb(r))
+            payload = msg.SerializeToString()
+            chans = [
+                grpc.aio.insecure_channel(d.grpc_address) for d in c.daemons
+            ]
+            calls = [
+                ch.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                for ch in chans
+            ]
+            sanity = pb.pb.GetRateLimitsResp.FromString(
+                await calls[0](payload)
+            )
+            assert len(sanity.responses) == len(reqs)
             total = 0
             t0 = time.perf_counter()
 
-            async def worker(cl, n):
+            async def worker(call, n):
                 nonlocal total
                 for _ in range(n):
-                    out = await cl.get_rate_limits(reqs)
-                    total += len(out)
+                    raw = await call(payload)
+                    assert len(raw) > 0
+                    total += len(reqs)
 
             # 3 concurrent clients per node, all four nodes
             await asyncio.gather(
-                *(worker(cl, 6) for cl in clients for _ in range(3))
+                *(worker(call, 6) for call in calls for _ in range(3))
             )
             dt = time.perf_counter() - t0
             return total / dt
         finally:
+            for ch in chans:
+                await ch.close()
             for cl in clients:
                 await cl.close()
             await c.stop()

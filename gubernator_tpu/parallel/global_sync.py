@@ -105,6 +105,10 @@ class GlobalManager:
         self.svc = svc
         self.b = behaviors
         self.mode = mode
+        # Constructed on the daemon's event loop (Daemon.spawn); queue
+        # state and asyncio events are loop-affine — off-loop producers
+        # must enter via queue_from_thread.
+        self._loop = asyncio.get_running_loop()
         m = svc.metrics
 
         def hits_error(take, e):
@@ -170,6 +174,22 @@ class GlobalManager:
             r, metadata=dict(r.metadata)
         )
         self._upd_q.notify()
+
+    def queue_from_thread(self, legs) -> None:
+        """Thread-safe batch enqueue for the columnar serving executor:
+        `legs` is [(owned, req), ...]; one call_soon_threadsafe hop runs
+        every queue mutation on the manager's loop (BatchQueue dicts and
+        asyncio events are not thread-safe — an off-loop insert can race
+        the flush's dict swap and lose legs)."""
+
+        def apply():
+            for owned, req in legs:
+                if owned:
+                    self.queue_update(req)
+                else:
+                    self.queue_hit(req)
+
+        self._loop.call_soon_threadsafe(apply)
 
     # -- send hits to owners (reference global.go:144-187) -------------------
 

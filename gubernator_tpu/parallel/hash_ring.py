@@ -118,27 +118,65 @@ class ReplicatedConsistentHash:
             idx = 0
         return self._ring_peers[idx]
 
-    def local_mask(self, key_hashes) -> "object":
-        """Vectorized ownership check for the columnar edge: True per key
-        iff this node owns it. `key_hashes` are uint64 values of the SAME
-        hash function as hash_fn (the native fnv1 batch). Identical
-        placement to get(): bisect_left on the sorted ring with
-        wraparound. The ring arrays are cached (invalidated by add()) —
-        rebuilding replicas*peers entries per call would dominate the
-        edge's per-call budget."""
+    def _ring_arrays(self):
+        """Cached (hashes, is_owner, addr_padded, addr_lens) ring arrays
+        for the vectorized edge (invalidated by add() — rebuilding
+        replicas*peers entries per call would dominate the edge's
+        per-call budget). addr_padded/addr_lens support fully-vectorized
+        ragged packing of per-item owner bytes (owner_spans)."""
         import numpy as np
 
         cache = self._mask_cache
         if cache is None:
+            addrs = [
+                p.info.grpc_address.encode() for p in self._ring_peers
+            ]
+            maxlen = max((len(a) for a in addrs), default=1)
+            padded = np.zeros((max(len(addrs), 1), maxlen), dtype=np.uint8)
+            for i, a in enumerate(addrs):
+                padded[i, : len(a)] = np.frombuffer(a, np.uint8)
             cache = (
                 np.asarray(self._ring_hashes, dtype=np.uint64),
                 np.asarray(
                     [bool(p.info.is_owner) for p in self._ring_peers],
                     dtype=bool,
                 ),
+                padded,
+                np.asarray([len(a) for a in addrs], dtype=np.int64),
             )
             self._mask_cache = cache
-        ring, is_owner = cache
+        return cache
+
+    def _ring_idx(self, key_hashes):
+        """Identical placement to get(): bisect_left on the sorted ring
+        with wraparound. `key_hashes` are uint64 values of the SAME hash
+        function as hash_fn (the native batch)."""
+        import numpy as np
+
+        ring = self._ring_arrays()[0]
         idx = np.searchsorted(ring, key_hashes, side="left")
-        idx = np.where(idx == len(ring), 0, idx)
-        return is_owner[idx]
+        return np.where(idx == len(ring), 0, idx)
+
+    def local_mask(self, key_hashes) -> "object":
+        """Vectorized ownership check for the columnar edge: True per key
+        iff this node owns it."""
+        return self._ring_arrays()[1][self._ring_idx(key_hashes)]
+
+    def owner_spans(self, key_hashes, need) -> tuple:
+        """(owner_data uint8, owner_offsets int64) — per-item owner
+        address bytes where `need` is True, empty spans elsewhere; the
+        exact shape wire.build_responses_md consumes. Fully vectorized
+        ragged packing (no per-item Python)."""
+        import numpy as np
+
+        _, _, padded, alens = self._ring_arrays()
+        idx = self._ring_idx(key_hashes)
+        need = np.asarray(need, dtype=bool)
+        lens = np.where(need, alens[idx], 0)
+        offsets = np.zeros(len(idx) + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        rows = padded[idx[need]]
+        mask = (
+            np.arange(padded.shape[1])[None, :] < alens[idx[need]][:, None]
+        )
+        return rows[mask], offsets

@@ -261,6 +261,10 @@ class PeerMesh:
         """Vectorized ownership check (see hash_ring.local_mask)."""
         return self.local_ring.local_mask(key_hashes)
 
+    def owner_spans(self, key_hashes, need):
+        """Vectorized owner metadata spans (see hash_ring.owner_spans)."""
+        return self.local_ring.owner_spans(key_hashes, need)
+
     def region_peers(self) -> List[Peer]:
         return self.region_picker.peers()
 

@@ -115,11 +115,10 @@ class Daemon:
         # decide -> write-behind sequence as the object path (and records
         # key strings). A Loader-only daemon keeps the object path so the
         # key-string dictionary stays complete for snapshots without the
-        # columnar path paying O(n) string decodes; force_global sends
-        # every item down the GLOBAL path anyway.
-        self.svc.fast_edge = (
-            conf.loader is None or conf.store is not None
-        ) and not conf.behaviors.force_global
+        # columnar path paying O(n) string decodes. GLOBAL (including
+        # force_global) is served columnar too (fastpath.try_serve ORs
+        # the flag in and queues the replication legs).
+        self.svc.fast_edge = conf.loader is None or conf.store is not None
 
         # gRPC server hosting both services (reference daemon.go:139-167)
         # with the reference's hardening: 1MB receive cap (daemon.go:122)

@@ -45,12 +45,12 @@ def _committed_error():
     return TableCommittedError
 
 _SLOW_BEHAVIOR = (
-    int(Behavior.GLOBAL)
-    | int(Behavior.DURATION_IS_GREGORIAN)
+    int(Behavior.DURATION_IS_GREGORIAN)
     # MULTI_REGION items need the object path's region_mgr.observe hook
     # (cross-region delta/broadcast queueing).
     | int(Behavior.MULTI_REGION)
 )
+_GLOBAL = int(Behavior.GLOBAL)
 
 _RING_VARIANT = {
     hash_ring.fnv1_64: "fnv1",
@@ -79,11 +79,20 @@ def try_serve(svc, data: bytes, peer_call: bool):
 
     Returns:
     - bytes — the complete response (all items served columnar);
-    - ("mixed", n, local_pos, local_arrays, nonlocal_reqs) — locally
-      owned items already DECIDED columnar; the async caller forwards
-      `nonlocal_reqs` through the object path and splices with
-      merge_mixed() (V1 only; peer calls are all-local by construction);
+    - ("mixed", n, local_pos, local_arrays, nonlocal_reqs, md) — locally
+      served items (owned, plus ALL GLOBAL items) already DECIDED
+      columnar; the async caller forwards `nonlocal_reqs` through the
+      object path and splices with merge_mixed() (V1 only; peer calls
+      are all-local by construction). `md` carries the GLOBAL non-owner
+      owner-metadata spans, or None;
     - None — fall back to the object path entirely.
+
+    GLOBAL items (V1 calls, grpc global mode): answered from the local
+    table whether owned or not (reference gubernator.go:395-421), with
+    the replication legs queued through the GlobalManager after the
+    decide commits — queue_update for owned items, queue_hit plus
+    metadata={"owner": ...} for non-owned. Peer relays and ici-mode
+    engines keep the object path (drain semantics / internal routing).
     """
     cols = wire.parse_requests(data)
     if cols is None or cols.n == 0 or cols.n > MAX_BATCH_SIZE:
@@ -92,6 +101,20 @@ def try_serve(svc, data: bytes, peer_call: bool):
         return None
     if np.any((cols.behavior & _SLOW_BEHAVIOR) != 0):
         return None
+    if not peer_call and getattr(svc, "force_global", False):
+        # GUBER_FORCE_GLOBAL: every V1 item becomes GLOBAL (the same OR
+        # the object path applies per item, server.py).
+        cols.behavior = cols.behavior | np.int64(_GLOBAL)
+    g_mask = (cols.behavior & _GLOBAL) != 0
+    has_global = bool(g_mask.any())
+    if has_global and (
+        peer_call
+        or getattr(svc.engine, "routes_global_internally", False)
+    ):
+        # Relayed peer GLOBAL hits need drain semantics + owner-side
+        # queue_update; ici-mode engines route GLOBAL internally. Both
+        # keep the object path.
+        return None
     # Validation needs per-item error strings -> object path.
     key_lens = np.diff(cols.key_offsets)
     if np.any(cols.name_lens == 0) or np.any(
@@ -99,6 +122,8 @@ def try_serve(svc, data: bytes, peer_call: bool):
     ):
         return None
     local = None
+    g_owned = g_mask  # standalone daemon: owner of everything
+    owner_addrs = None
     if not peer_call:
         picker = svc.picker
         if picker is not None and picker.peers():
@@ -106,25 +131,93 @@ def try_serve(svc, data: bytes, peer_call: bool):
             if variant is None:
                 return None
             ring_h = wire.fnv1_batch(cols.key_data, cols.key_offsets, variant)
-            mask = picker.local_mask(ring_h)
-            if not mask.all():
-                local = np.asarray(mask, dtype=bool)
+            mask = np.asarray(picker.local_mask(ring_h), dtype=bool)
+            if has_global:
+                # GLOBAL items are answered from the LOCAL table whether
+                # owned or not (reference gubernator.go:395-421); only
+                # non-GLOBAL peer-owned items forward.
+                if not hasattr(picker, "owner_spans"):
+                    return None
+                g_owned = g_mask & mask
+                owner_addrs = (picker, ring_h)  # spans built post-decide
+                serve = mask | g_mask
+            else:
+                serve = mask
+            if not serve.all():
+                local = serve
+    now = None
+    if has_global:
+        # One timestamp for BOTH the local decide and the replicated
+        # legs — the object path stamps created_at before the engine
+        # call and replicates that same value (server.py); a later
+        # re-stamp could land the owner's apply in the next window.
+        now = svc.engine.now_fn()
+        # Queue the replication legs ONLY for items the decide applies
+        # (built from the pre-strip behavior; zero-hit items queue
+        # nothing, matching GlobalManager's own gate). Objects are built
+        # up front so a failed construction falls back BEFORE any table
+        # commit.
+        g_queue = [
+            (bool(g_owned[i]), _req_from_columns(cols, int(i)))
+            for i in np.nonzero(g_mask & (cols.hits != 0))[0]
+        ]
+        for _, req in g_queue:
+            if req.created_at is None:
+                req.created_at = now
+        # The standard engine expects GLOBAL stripped (the daemon's
+        # global manager owns replication, engine.routes_global_internally
+        # False) — same strip the object path does (server.py).
+        cols.behavior = cols.behavior & ~np.int64(_GLOBAL)
+
+    def queue_legs():
+        gm = svc.global_mgr
+        if gm is None:
+            return
+        # try_serve runs on the serving executor; the manager's queues
+        # are loop-affine — hop the whole batch over in one callback.
+        gm.queue_from_thread(g_queue)
+
+    def count_metrics(served_mask):
+        # Label parity with the object path: owned GLOBAL items count
+        # as "local" (server.py checks is_owner before the GLOBAL
+        # branch); only non-owner GLOBAL answers count as "global".
+        n_glob = (
+            int((g_mask & ~g_owned & served_mask).sum()) if has_global else 0
+        )
+        m = getattr(svc, "_m_global", None)
+        if n_glob and m is not None:
+            m.inc(n_glob)
+        m = getattr(svc, "_m_local", None)
+        if m is not None:
+            m.inc(int(served_mask.sum()) - n_glob)
+
+    def owner_spans(positions):
+        """(owner_data, owner_offsets) for build_responses_md: non-owned
+        GLOBAL items report their authoritative owner; everything else
+        gets an empty span (no metadata). Fully vectorized in the ring."""
+        pick, rh = owner_addrs
+        need = (g_mask & ~g_owned)[positions]
+        return pick.owner_spans(rh[positions], need)
+
     if local is None:
         # NOTE: a failure BEFORE the table commits falls back safely;
         # a failure AFTER waves committed to a surviving table raises
         # TableCommittedError, which must propagate (a silent fallback
         # would re-apply every committed hit).
         try:
-            out = svc.engine.check_columns(cols)
+            out = svc.engine.check_columns(cols, now=now)
         except _committed_error():
             raise
         except Exception:
             return None
         if out is None:
             return None
-        m = getattr(svc, "_m_local", None)
-        if m is not None:
-            m.inc(cols.n)
+        count_metrics(np.ones(cols.n, dtype=bool))
+        if has_global:
+            queue_legs()
+            if owner_addrs is not None and bool((g_mask & ~g_owned).any()):
+                odata, ooffs = owner_spans(np.arange(cols.n))
+                return wire.build_responses_md(*out, odata, ooffs)
         return wire.build_responses(*out)
     if not local.any():
         return None  # nothing local to decide: pure forwarding batch
@@ -143,17 +236,22 @@ def try_serve(svc, data: bytes, peer_call: bool):
         svc.engine.cfg.num_groups,
     )
     try:
-        out = svc.engine.check_columns(cols, select=local_pos, hashes=hashes)
+        out = svc.engine.check_columns(
+            cols, now=now, select=local_pos, hashes=hashes
+        )
     except _committed_error():
         raise
     except Exception:
         return None
     if out is None:
         return None
-    m = getattr(svc, "_m_local", None)
-    if m is not None:
-        m.inc(len(local_pos))
-    return ("mixed", cols.n, local_pos, out, nonlocal_reqs)
+    count_metrics(local)
+    md = None
+    if has_global:
+        queue_legs()
+        if owner_addrs is not None and bool((g_mask & ~g_owned).any()):
+            md = owner_spans(local_pos)
+    return ("mixed", cols.n, local_pos, out, nonlocal_reqs, md)
 
 
 def _req_from_columns(cols, i: int):
@@ -171,11 +269,13 @@ def _varint(v: int) -> bytes:
     return bytes(out)
 
 
-def merge_mixed(n: int, local_pos, local_out, nonlocal_resps) -> bytes:
+def merge_mixed(n: int, local_pos, local_out, nonlocal_resps, md=None) -> bytes:
     """Splice columnar-decided local items with forwarded object-path
     responses, preserving request order. Repeated message items frame
     independently, so native-built runs and protobuf-serialized items
-    concatenate into one valid GetRateLimitsResp."""
+    concatenate into one valid GetRateLimitsResp. `md` (owner_data,
+    owner_offsets aligned with local_out order) adds the GLOBAL
+    non-owner metadata={"owner": ...} entries."""
     from gubernator_tpu.service import pb
 
     status, limit, remaining, reset_time = local_out
@@ -188,6 +288,17 @@ def merge_mixed(n: int, local_pos, local_out, nonlocal_resps) -> bytes:
         nonlocal li
         if count:
             s = slice(li - count, li)
+            if md is not None:
+                odata, ooffs = md
+                sub = ooffs[li - count: li + 1]
+                chunks.append(
+                    wire.build_responses_md(
+                        status[s], limit[s], remaining[s], reset_time[s],
+                        odata[int(sub[0]): int(sub[-1])],
+                        (sub - sub[0]).astype("int64"),
+                    )
+                )
+                return
             chunks.append(
                 wire.build_responses(
                     status[s], limit[s], remaining[s], reset_time[s]

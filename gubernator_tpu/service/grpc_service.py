@@ -55,7 +55,7 @@ async def serve_get_rate_limits_bytes(svc: V1Service, request_bytes) -> bytes:
         if isinstance(res, bytes):
             return res
         if res is not None:  # mixed ownership: forward the rest
-            _, n, local_pos, local_out, nl_reqs = res
+            _, n, local_pos, local_out, nl_reqs, md = res
             # Local hits are already committed — a forwarding
             # failure must degrade the REMOTE items to per-item
             # errors, never fail the RPC (a client retry would
@@ -66,7 +66,7 @@ async def serve_get_rate_limits_bytes(svc: V1Service, request_bytes) -> bytes:
                 nl_resps = await svc.get_rate_limits(nl_reqs)
             except Exception as e:
                 nl_resps = [RateLimitResp(error=str(e)) for _ in nl_reqs]
-            return fastpath.merge_mixed(n, local_pos, local_out, nl_resps)
+            return fastpath.merge_mixed(n, local_pos, local_out, nl_resps, md)
     try:
         request = pb.pb.GetRateLimitsReq.FromString(request_bytes)
     except Exception:

@@ -95,6 +95,21 @@ def load() -> Optional[ctypes.CDLL]:
             lib.guber_build_responses.restype = ctypes.c_int64
             lib.guber_responses_size.argtypes = [ctypes.c_int]
             lib.guber_responses_size.restype = ctypes.c_int64
+            lib.guber_build_responses_md.argtypes = [
+                ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int8),
+                np.ctypeslib.ndpointer(np.int64),
+                np.ctypeslib.ndpointer(np.int64),
+                np.ctypeslib.ndpointer(np.int64),
+                np.ctypeslib.ndpointer(np.uint8),   # owner_data
+                np.ctypeslib.ndpointer(np.int64),   # owner_offsets
+                np.ctypeslib.ndpointer(np.uint8),
+            ]
+            lib.guber_build_responses_md.restype = ctypes.c_int64
+            lib.guber_responses_size_md.argtypes = [
+                ctypes.c_int, ctypes.c_int64,
+            ]
+            lib.guber_responses_size_md.restype = ctypes.c_int64
             for name in ("guber_fnv1_batch", "guber_fnv1a_batch"):
                 fn = getattr(lib, name)
                 fn.argtypes = [
@@ -233,6 +248,32 @@ def build_responses(status, limit, remaining, reset_time) -> bytes:
         np.ascontiguousarray(limit, dtype=np.int64),
         np.ascontiguousarray(remaining, dtype=np.int64),
         np.ascontiguousarray(reset_time, dtype=np.int64),
+        out,
+    )
+    return out[:written].tobytes()
+
+
+def build_responses_md(
+    status, limit, remaining, reset_time, owner_data, owner_offsets
+) -> bytes:
+    """build_responses + per-item metadata={"owner": ...} for items with
+    a nonzero owner span (the GLOBAL non-owner answer contract)."""
+    lib = load()
+    assert lib is not None
+    n = len(status)
+    odata = np.ascontiguousarray(owner_data, dtype=np.uint8)
+    ooffs = np.ascontiguousarray(owner_offsets, dtype=np.int64)
+    out = np.empty(
+        int(lib.guber_responses_size_md(n, int(ooffs[-1]))), np.uint8
+    )
+    written = lib.guber_build_responses_md(
+        n,
+        np.ascontiguousarray(status, dtype=np.int8),
+        np.ascontiguousarray(limit, dtype=np.int64),
+        np.ascontiguousarray(remaining, dtype=np.int64),
+        np.ascontiguousarray(reset_time, dtype=np.int64),
+        odata,
+        ooffs,
         out,
     )
     return out[:written].tobytes()

@@ -386,6 +386,70 @@ int64_t guber_responses_size(int n) {
   return (int64_t)n * (3 + 4 * 11);
 }
 
+// Variant with per-item owner metadata: items where
+// owner_offsets[i] < owner_offsets[i+1] get
+// metadata = {"owner": <addr bytes>} (map field 6; one entry, key
+// "owner"). The GLOBAL serving path answers non-owner items from the
+// local replica and reports the authoritative owner this way
+// (reference gubernator.go:395-421 metadata contract).
+int64_t guber_build_responses_md(int n, const int8_t* status,
+                                 const int64_t* limit,
+                                 const int64_t* remaining,
+                                 const int64_t* reset_time,
+                                 const uint8_t* owner_data,
+                                 const int64_t* owner_offsets,
+                                 uint8_t* out) {
+  uint8_t* p = out;
+  for (int i = 0; i < n; i++) {
+    int64_t olen = owner_offsets[i + 1] - owner_offsets[i];
+    // map entry body: key field ("owner") + value field (addr)
+    int64_t entry = 0;
+    if (olen > 0) entry = (1 + 1 + 5) + 1 + varint_size((uint64_t)olen) + olen;
+    int64_t body = 0;
+    if (status[i]) body += 1 + varint_size((uint64_t)status[i]);
+    if (limit[i]) body += 1 + varint_size((uint64_t)limit[i]);
+    if (remaining[i]) body += 1 + varint_size((uint64_t)remaining[i]);
+    if (reset_time[i]) body += 1 + varint_size((uint64_t)reset_time[i]);
+    if (olen > 0) body += 1 + varint_size((uint64_t)entry) + entry;
+    *p++ = 0x0A;  // repeated responses: field 1, wire type 2
+    p = put_varint(p, (uint64_t)body);
+    if (status[i]) {
+      *p++ = 0x08;
+      p = put_varint(p, (uint64_t)status[i]);
+    }
+    if (limit[i]) {
+      *p++ = 0x10;
+      p = put_varint(p, (uint64_t)limit[i]);
+    }
+    if (remaining[i]) {
+      *p++ = 0x18;
+      p = put_varint(p, (uint64_t)remaining[i]);
+    }
+    if (reset_time[i]) {
+      *p++ = 0x20;
+      p = put_varint(p, (uint64_t)reset_time[i]);
+    }
+    if (olen > 0) {
+      *p++ = 0x32;  // metadata: field 6, wire type 2
+      p = put_varint(p, (uint64_t)entry);
+      *p++ = 0x0A;  // map key: field 1
+      *p++ = 5;
+      *p++ = 'o'; *p++ = 'w'; *p++ = 'n'; *p++ = 'e'; *p++ = 'r';
+      *p++ = 0x12;  // map value: field 2
+      p = put_varint(p, (uint64_t)olen);
+      const uint8_t* src = owner_data + owner_offsets[i];
+      for (int64_t j = 0; j < olen; j++) *p++ = src[j];
+    }
+  }
+  return p - out;
+}
+
+// Worst-case output size for guber_build_responses_md.
+int64_t guber_responses_size_md(int n, int64_t owner_total) {
+  // base fields + per-item metadata framing (<=20B) + owner bytes
+  return (int64_t)n * (3 + 4 * 11 + 20) + owner_total;
+}
+
 // Batch fnv1-64 over keys (ring routing; reference replicated_hash.go
 // uses fnv1/fnv1a over the key string).
 void guber_fnv1_batch(const uint8_t* data, const int64_t* offsets, int n,

@@ -601,6 +601,116 @@ def test_mixed_ownership_split(loop_thread):
     loop_thread.run(scenario(), timeout=120)
 
 
+def test_global_columnar_matches_object_path(loop_thread):
+    """GLOBAL batches through the columnar fast edge must behave exactly
+    like a fast-path-disabled cluster: same responses (owner metadata on
+    non-owner answers included), same replica-local counting, and the
+    same replication legs — hits reach the owner and the broadcast
+    converges every replica."""
+    import asyncio
+    import time as _time
+
+    import grpc as grpc_mod
+
+    from gubernator_tpu.cluster import Cluster
+
+    async def drive(fast: bool):
+        c = await Cluster.start(3, cache_size=4096)
+        try:
+            if not fast:
+                for d in c.daemons:
+                    d.svc.fast_edge = False
+            entry = c.daemons[0]
+            keys = [f"{i * 7919}glb" for i in range(12)]
+            owners = {
+                k: c.find_owning_daemon("gl", k).grpc_address for k in keys
+            }
+            assert len(set(owners.values())) >= 2
+            msg = pb.pb.GetRateLimitsReq()
+            for rep in range(2):
+                for j, k in enumerate(keys):
+                    msg.requests.append(
+                        pb.pb.RateLimitReq(
+                            name="gl", unique_key=k, duration=600_000,
+                            limit=100, hits=j % 3,  # incl. zero-hit reads
+                            behavior=int(Behavior.GLOBAL),
+                        )
+                    )
+            payload = msg.SerializeToString()
+            async with grpc_mod.aio.insecure_channel(
+                entry.grpc_address
+            ) as ch:
+                call = ch.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                raw = await call(payload)
+            out = pb.pb.GetRateLimitsResp.FromString(raw)
+            # cross-run comparable fields only: owner ADDRESSES (and which
+            # keys are entry-local) differ between fresh clusters; the
+            # metadata contract is asserted against THIS run's owners map
+            # below.
+            records = [
+                (r.status, r.limit, r.remaining) for r in out.responses
+            ]
+            # metadata owner appears exactly on non-owner answers
+            for j, r in enumerate(out.responses):
+                k = keys[j % len(keys)]
+                want = owners[k]
+                got = dict(r.metadata).get("owner", "")
+                if want == entry.grpc_address:
+                    assert got == "", (j, got)
+                else:
+                    assert got == want, (j, got, want)
+            if fast:
+                # label parity: only NON-owner GLOBAL answers count as
+                # "global" (owned GLOBAL items are "local", like the
+                # object path's is_owner-first routing)
+                want_glob = 2 * sum(
+                    1 for k in keys if owners[k] != entry.grpc_address
+                )
+                glob_served = entry.svc.metrics.getratelimit_counter.labels(
+                    "global"
+                ).get()
+                assert glob_served >= want_glob > 0, (glob_served, want_glob)
+            # replication legs: every replica converges on the owner's
+            # authoritative remaining (total hits per key = 2*(j%3))
+            deadline = _time.monotonic() + 10
+            want_rem = {
+                k: 100 - 2 * (j % 3) for j, k in enumerate(keys)
+            }
+            while _time.monotonic() < deadline:
+                probe = pb.pb.GetRateLimitsReq()
+                for k in keys:
+                    probe.requests.append(
+                        pb.pb.RateLimitReq(
+                            name="gl", unique_key=k, duration=600_000,
+                            limit=100, hits=0,
+                            behavior=int(Behavior.GLOBAL),
+                        )
+                    )
+                async with grpc_mod.aio.insecure_channel(
+                    c.daemons[2].grpc_address
+                ) as ch:
+                    call = ch.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                    praw = await call(probe.SerializeToString())
+                pr = pb.pb.GetRateLimitsResp.FromString(praw)
+                got_rem = {
+                    k: r.remaining for k, r in zip(keys, pr.responses)
+                }
+                if got_rem == want_rem:
+                    break
+                await asyncio.sleep(0.1)
+            assert got_rem == want_rem, (fast, got_rem, want_rem)
+            return records
+        finally:
+            await c.stop()
+
+    async def scenario():
+        fast_records = await drive(True)
+        slow_records = await drive(False)
+        assert fast_records == slow_records
+
+    loop_thread.run(scenario(), timeout=120)
+
+
 @pytest.mark.parametrize("seed", [31])
 def test_columns_adversarial_domain(seed):
     """In-domain adversarial values (limits near MAX_COUNT, huge hits,
