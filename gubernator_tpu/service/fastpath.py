@@ -51,6 +51,7 @@ _SLOW_BEHAVIOR = (
     | int(Behavior.MULTI_REGION)
 )
 _GLOBAL = int(Behavior.GLOBAL)
+_DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
 
 _RING_VARIANT = {
     hash_ring.fnv1_64: "fnv1",
@@ -87,12 +88,14 @@ def try_serve(svc, data: bytes, peer_call: bool):
       owner-metadata spans, or None;
     - None — fall back to the object path entirely.
 
-    GLOBAL items (V1 calls, grpc global mode): answered from the local
-    table whether owned or not (reference gubernator.go:395-421), with
-    the replication legs queued through the GlobalManager after the
-    decide commits — queue_update for owned items, queue_hit plus
-    metadata={"owner": ...} for non-owned. Peer relays and ici-mode
-    engines keep the object path (drain semantics / internal routing).
+    GLOBAL items (grpc global mode): V1 calls are answered from the
+    local table whether owned or not (reference gubernator.go:395-421),
+    with the replication legs queued through the GlobalManager after
+    the decide commits — queue_update for owned items, queue_hit plus
+    metadata={"owner": ...} for non-owned. Peer relays apply drain
+    semantics at the owner (DRAIN_OVER_LIMIT forced) and queue the
+    broadcast; items carrying trace metadata, and ici-mode engines
+    (internal GLOBAL routing), keep the object path.
     """
     cols = wire.parse_requests(data)
     if cols is None or cols.n == 0 or cols.n > MAX_BATCH_SIZE:
@@ -107,14 +110,15 @@ def try_serve(svc, data: bytes, peer_call: bool):
         cols.behavior = cols.behavior | np.int64(_GLOBAL)
     g_mask = (cols.behavior & _GLOBAL) != 0
     has_global = bool(g_mask.any())
-    if has_global and (
-        peer_call
-        or getattr(svc.engine, "routes_global_internally", False)
-    ):
-        # Relayed peer GLOBAL hits need drain semantics + owner-side
-        # queue_update; ici-mode engines route GLOBAL internally. Both
-        # keep the object path.
-        return None
+    if has_global and getattr(svc.engine, "routes_global_internally", False):
+        return None  # ici-mode engines route GLOBAL internally
+    if peer_call and has_global:
+        # Owner applying relayed GLOBAL hits always drains (reference
+        # gubernator.go:510-512) and queues a broadcast; items with
+        # trace metadata took the object path already (cols.slow).
+        cols.behavior = np.where(
+            g_mask, cols.behavior | np.int64(_DRAIN), cols.behavior
+        )
     # Validation needs per-item error strings -> object path.
     key_lens = np.diff(cols.key_offsets)
     if np.any(cols.name_lens == 0) or np.any(
