@@ -211,5 +211,43 @@ def test_engine_overflow_gauges():
         text = m.render().decode()
         assert "gubernator_global_overflow_keys" in text
         assert "gubernator_global_overflow_drops_count" in text
+        assert "gubernator_global_sync_backlog" in text
+    finally:
+        eng.close()
+
+
+def test_engine_sync_backlog_gauge():
+    """With a 1-group-per-tick cap, a multi-group burst leaves a backlog
+    the engine must surface through the gauge, and the backlog drains to
+    zero over subsequent ticks."""
+    from gubernator_tpu.runtime.ici_engine import IciEngine, IciEngineConfig
+
+    eng = IciEngine(
+        IciEngineConfig(
+            num_groups=64, ways=2, num_slots=32, replica_ways=4,
+            batch_size=128, sync_wait_s=3600.0,  # tick manually
+            max_sync_groups=1,
+        )
+    )
+    try:
+        # few keys: spread over >1 of the 8 groups WITHOUT exceeding any
+        # group's 4 ways (a permanently overflow-retained group stays
+        # active by design and would hold the backlog above zero)
+        reqs = [
+            RateLimitReq(
+                name="bkl", unique_key=f"b{i}", behavior=Behavior.GLOBAL,
+                duration=600_000, limit=100, hits=1,
+            )
+            for i in range(8)
+        ]
+        for f in [eng.check_async(r) for r in reqs]:
+            f.result(timeout=30)
+        eng.sync_now()
+        assert eng.sync_backlog > 0, eng.sync_backlog
+        for _ in range(16):
+            eng.sync_now()
+            if eng.sync_backlog == 0:
+                break
+        assert eng.sync_backlog == 0
     finally:
         eng.close()
