@@ -86,6 +86,10 @@ class RegionManager:
     def __init__(self, svc, behaviors: BehaviorConfig):
         self.svc = svc
         self.b = behaviors
+        # Constructed on the daemon's event loop; queue state and asyncio
+        # events are loop-affine — off-loop producers (the columnar
+        # serving executor) must enter via observe_from_thread.
+        self._loop = asyncio.get_running_loop()
 
         def hits_error(take, e):
             log.exception("MULTI_REGION hit-delta flush failed")
@@ -156,6 +160,17 @@ class RegionManager:
             self.queue_update(req)
         else:
             self.queue_hit(req)
+
+    def observe_from_thread(self, reqs) -> None:
+        """Thread-safe batch observe from the columnar serving executor:
+        one call_soon_threadsafe hop runs every queue mutation on the
+        manager's loop (same hazard as GlobalManager.queue_from_thread)."""
+
+        def apply():
+            for req in reqs:
+                self.observe(req)
+
+        self._loop.call_soon_threadsafe(apply)
 
     @staticmethod
     def _is_noop(r: RateLimitReq) -> bool:

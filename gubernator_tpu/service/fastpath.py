@@ -44,14 +44,13 @@ def _committed_error():
 
     return TableCommittedError
 
-_SLOW_BEHAVIOR = (
-    int(Behavior.DURATION_IS_GREGORIAN)
-    # MULTI_REGION items need the object path's region_mgr.observe hook
-    # (cross-region delta/broadcast queueing).
-    | int(Behavior.MULTI_REGION)
-)
+# Gregorian durations need host-side calendar math the columnar decide
+# doesn't carry — the only behavior still pinned to the object path.
+_SLOW_BEHAVIOR = int(Behavior.DURATION_IS_GREGORIAN)
 _GLOBAL = int(Behavior.GLOBAL)
 _DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
+_MULTI_REGION = int(Behavior.MULTI_REGION)
+_RESET = int(Behavior.RESET_REMAINING)
 
 _RING_VARIANT = {
     hash_ring.fnv1_64: "fnv1",
@@ -128,6 +127,7 @@ def try_serve(svc, data: bytes, peer_call: bool):
     local = None
     g_owned = g_mask  # standalone daemon: owner of everything
     owner_addrs = None
+    ring_mask = None
     if not peer_call:
         picker = svc.picker
         if picker is not None and picker.peers():
@@ -136,6 +136,7 @@ def try_serve(svc, data: bytes, peer_call: bool):
                 return None
             ring_h = wire.fnv1_batch(cols.key_data, cols.key_offsets, variant)
             mask = np.asarray(picker.local_mask(ring_h), dtype=bool)
+            ring_mask = mask
             if has_global:
                 # GLOBAL items are answered from the LOCAL table whether
                 # owned or not (reference gubernator.go:395-421); only
@@ -149,13 +150,33 @@ def try_serve(svc, data: bytes, peer_call: bool):
                 serve = mask
             if not serve.all():
                 local = serve
+    # MULTI_REGION: the in-region owner's apply queues the cross-region
+    # leg (server.py observe call sites). V1 owned items qualify (the
+    # non-owned forward and observe at their in-region owner); peer-call
+    # applies are owner applies by definition. Reqs are built BEFORE the
+    # GLOBAL strip so combined-flag items replicate with both bits.
+    mr_mask = (cols.behavior & _MULTI_REGION) != 0
+    mr_queue = []
+    if bool(mr_mask.any()) and svc.region_mgr is not None:
+        mr_owned = mr_mask if ring_mask is None else (mr_mask & ring_mask)
+        q = mr_owned & (
+            (cols.hits != 0) | ((cols.behavior & _RESET) != 0)
+        )
+        mr_queue = [
+            _req_from_columns(cols, int(i)) for i in np.nonzero(q)[0]
+        ]
+
     now = None
-    if has_global:
+    if has_global or mr_queue:
         # One timestamp for BOTH the local decide and the replicated
         # legs — the object path stamps created_at before the engine
         # call and replicates that same value (server.py); a later
         # re-stamp could land the owner's apply in the next window.
         now = svc.engine.now_fn()
+        for req in mr_queue:
+            if req.created_at is None:
+                req.created_at = now
+    if has_global:
         # Queue the replication legs ONLY for items the decide applies
         # (built from the pre-strip behavior; zero-hit items queue
         # nothing, matching GlobalManager's own gate). Objects are built
@@ -174,12 +195,12 @@ def try_serve(svc, data: bytes, peer_call: bool):
         cols.behavior = cols.behavior & ~np.int64(_GLOBAL)
 
     def queue_legs():
-        gm = svc.global_mgr
-        if gm is None:
-            return
-        # try_serve runs on the serving executor; the manager's queues
-        # are loop-affine — hop the whole batch over in one callback.
-        gm.queue_from_thread(g_queue)
+        # try_serve runs on the serving executor; the managers' queues
+        # are loop-affine — hop each batch over in one callback.
+        if has_global and svc.global_mgr is not None and g_queue:
+            svc.global_mgr.queue_from_thread(g_queue)
+        if mr_queue:
+            svc.region_mgr.observe_from_thread(mr_queue)
 
     def count_metrics(served_mask):
         # Label parity with the object path: owned GLOBAL items count
@@ -217,11 +238,13 @@ def try_serve(svc, data: bytes, peer_call: bool):
         if out is None:
             return None
         count_metrics(np.ones(cols.n, dtype=bool))
-        if has_global:
+        if has_global or mr_queue:
             queue_legs()
-            if owner_addrs is not None and bool((g_mask & ~g_owned).any()):
-                odata, ooffs = owner_spans(np.arange(cols.n))
-                return wire.build_responses_md(*out, odata, ooffs)
+        if has_global and owner_addrs is not None and bool(
+            (g_mask & ~g_owned).any()
+        ):
+            odata, ooffs = owner_spans(np.arange(cols.n))
+            return wire.build_responses_md(*out, odata, ooffs)
         return wire.build_responses(*out)
     if not local.any():
         return None  # nothing local to decide: pure forwarding batch
@@ -251,10 +274,12 @@ def try_serve(svc, data: bytes, peer_call: bool):
         return None
     count_metrics(local)
     md = None
-    if has_global:
+    if has_global or mr_queue:
         queue_legs()
-        if owner_addrs is not None and bool((g_mask & ~g_owned).any()):
-            md = owner_spans(local_pos)
+    if has_global and owner_addrs is not None and bool(
+        (g_mask & ~g_owned).any()
+    ):
+        md = owner_spans(local_pos)
     return ("mixed", cols.n, local_pos, out, nonlocal_reqs, md)
 
 

@@ -134,6 +134,62 @@ def test_multiregion_convergence(loop_thread):
     loop_thread.run(scenario(), timeout=120)
 
 
+def test_multiregion_columnar_fast_edge(loop_thread):
+    """MULTI_REGION items ride the columnar fast edge (no object-path
+    fallback) AND still fire the cross-region legs: try_serve returns
+    complete response bytes for an in-region-owner batch, and the
+    non-home region's hit-delta reaches the home region."""
+    from gubernator_tpu import wire
+    from gubernator_tpu.service import fastpath, pb
+
+    if not wire.available():
+        pytest.skip("native wirepath unavailable")
+
+    async def scenario():
+        c = await Cluster.start(
+            4,
+            datacenters=["dc-a", "dc-a", "dc-b", "dc-b"],
+            behaviors=BehaviorConfig(global_sync_wait_s=0.05),
+        )
+        clients = []
+        try:
+            uk = _key_homed_in("dc-a", ["dc-a", "dc-b"])
+            # the dc-b daemon that OWNS the key in-region: its batch is
+            # all-local, so try_serve must return bytes directly
+            owner_b = next(
+                d
+                for d in c.daemons
+                if d.conf.data_center == "dc-b"
+                and d.svc.picker.get(f"mr_{uk}").info.grpc_address
+                == d.svc.local_info.grpc_address
+            )
+            msg = pb.pb.GetRateLimitsReq()
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name="mr", unique_key=uk, duration=600_000, limit=100,
+                    hits=7, behavior=int(Behavior.MULTI_REGION),
+                )
+            )
+            raw = fastpath.try_serve(
+                owner_b.svc, msg.SerializeToString(), False
+            )
+            assert isinstance(raw, bytes), type(raw)
+            out = pb.pb.GetRateLimitsResp.FromString(raw)
+            assert out.responses[0].remaining == 93
+            # delta leg fired: the home region's authoritative counter
+            # converges without any dc-a traffic
+            a = GubernatorClient(c.get_random_peer("dc-a").grpc_address)
+            clients.append(a)
+            got = await _poll(a, uk, 93)
+            assert got == 93, f"columnar observe leg never converged: {got}"
+        finally:
+            for cl in clients:
+                await cl.close()
+            await c.stop()
+
+    loop_thread.run(scenario(), timeout=120)
+
+
 def test_multiregion_reset_propagates(loop_thread):
     """A RESET_REMAINING (hits=0) issued in a NON-home region must reach
     the home region — otherwise the next authoritative broadcast silently
