@@ -57,3 +57,74 @@ def test_thundering_herd_exact_consumption(loop_thread):
         assert remaining == LIMIT - 60 * 5 * 7
     finally:
         loop_thread.run(c.stop())
+
+
+def test_thundering_herd_global_exact_replication(loop_thread):
+    """GLOBAL herd through the columnar fast edge: many concurrent
+    batches from every daemon, replication legs hopping from the serving
+    executor to each daemon's loop — the owner's authoritative counter
+    must converge to the EXACT total (no lost or double-queued hits),
+    and every replica must agree."""
+    import time as _time
+
+    from gubernator_tpu.api.types import Behavior
+
+    c = loop_thread.run(Cluster.start(3, cache_size=4096), timeout=120)
+
+    async def run():
+        clients = [GubernatorClient(d.grpc_address) for d in c.daemons]
+        try:
+            per_client_calls, hits_per_call, n_tasks = 5, 3, 30
+            keys = [f"gh{j}" for j in range(8)]
+
+            async def hammer(i):
+                cl = clients[i % len(clients)]
+                for _ in range(per_client_calls):
+                    out = await cl.get_rate_limits(
+                        [
+                            RateLimitReq(
+                                name="gherd", unique_key=k,
+                                duration=600_000, limit=LIMIT,
+                                hits=hits_per_call,
+                                behavior=Behavior.GLOBAL,
+                            )
+                            for k in keys
+                        ]
+                    )
+                    for r in out:
+                        assert r.error == ""
+
+            await asyncio.gather(*(hammer(i) for i in range(n_tasks)))
+
+            want = LIMIT - n_tasks * per_client_calls * hits_per_call
+            deadline = _time.monotonic() + 15
+            got = {}
+            while _time.monotonic() < deadline:
+                got = {}
+                for cl in clients:  # every replica must agree
+                    out = await cl.get_rate_limits(
+                        [
+                            RateLimitReq(
+                                name="gherd", unique_key=k,
+                                duration=600_000, limit=LIMIT, hits=0,
+                                behavior=Behavior.GLOBAL,
+                            )
+                            for k in keys
+                        ]
+                    )
+                    for k, r in zip(keys, out):
+                        got.setdefault(k, set()).add(r.remaining)
+                if all(v == {want} for v in got.values()):
+                    return got
+                await asyncio.sleep(0.2)
+            return got
+        finally:
+            for cl in clients:
+                await cl.close()
+
+    try:
+        got = loop_thread.run(run(), timeout=180)
+        want = LIMIT - 30 * 5 * 3
+        assert all(v == {want} for v in got.values()), (got, want)
+    finally:
+        loop_thread.run(c.stop())
