@@ -120,19 +120,13 @@ def encode_rows(
     key_lo = [0] * n
     group = [0] * n
 
-    # Clamp on Python ints (like encode_one): values beyond int64 would
-    # make the numpy conversions raise and poison the whole flush.
     for j, (r, hi, lo, grp) in enumerate(rows):
         if r.behavior & _GREG:
             raise EncodeError("encode_rows cannot take Gregorian items")
-        hits[j] = min(max(int(r.hits), -MAX_COUNT), MAX_COUNT)
-        lim = min(max(int(r.limit), -MAX_COUNT), MAX_COUNT)
-        limit[j] = lim
-        duration[j] = min(max(int(r.duration), 0), MAX_DURATION_MS)
-        b = min(max(int(r.burst), 0), MAX_COUNT)
-        if b == 0 and r.algorithm == _LEAKY:
-            b = lim
-        burst[j] = b
+        hits[j] = r.hits
+        limit[j] = r.limit
+        duration[j] = r.duration
+        burst[j] = r.burst
         algo[j] = int(r.algorithm)
         behavior[j] = int(r.behavior)
         created[j] = int(r.created_at) if r.created_at is not None else now_ms
@@ -140,8 +134,29 @@ def encode_rows(
         key_lo[j] = lo
         group[j] = grp
 
+    def clamped(vals, lo_b, hi_b):
+        # Vectorized clamp (the per-item min/max pairs dominated this
+        # function's profile). Values beyond int64 make the conversion
+        # raise and would poison the whole flush — clamp those on
+        # Python ints, but only on that rare path.
+        try:
+            a = np.array(vals, dtype=np.int64)
+        except OverflowError:
+            a = np.array(
+                [min(max(int(v), lo_b), hi_b) for v in vals],
+                dtype=np.int64,
+            )
+        return np.clip(a, lo_b, hi_b)
+
+    hits = clamped(hits, -MAX_COUNT, MAX_COUNT)
+    limit = clamped(limit, -MAX_COUNT, MAX_COUNT)
+    burst = clamped(burst, 0, MAX_COUNT)
+    # leaky items with burst 0 default to their limit (encode_one parity)
+    is_leaky = np.array(algo, dtype=np.int8) == _LEAKY
+    burst = np.where(is_leaky & (burst == 0), limit, burst)
+
     lanes = np.asarray(lanes, dtype=np.int64)
-    dur = np.array(duration, dtype=np.int64)
+    dur = clamped(duration, 0, MAX_DURATION_MS)
     wb.key_hi[lanes] = key_hi
     wb.key_lo[lanes] = key_lo
     wb.group[lanes] = np.array(group, dtype=np.int32)
