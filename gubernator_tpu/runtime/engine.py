@@ -725,7 +725,6 @@ class DeviceEngine(EngineBase):
         bytes need no re-slicing. Results align with `select`'s order.
         """
         from gubernator_tpu import native as _native
-        from gubernator_tpu.models.bucket import MAX_COUNT, MAX_DURATION_MS
 
         cfg = self.cfg
         store = self.store
@@ -757,90 +756,17 @@ class DeviceEngine(EngineBase):
                 int(sel_map[j]) if sel_map is not None else j
             )
 
-        # Wave = occurrence rank within the group (stable sort keeps
-        # arrival order, preserving per-key sequencing); lane = arrival
-        # rank within the wave.
-        order = np.argsort(grp, kind="stable")
-        sg = grp[order]
-        wave_sorted = np.arange(n) - np.searchsorted(sg, sg, side="left")
-        wave = np.empty(n, np.int64)
-        wave[order] = wave_sorted
-        num_waves = int(wave.max()) + 1
-        if num_waves > cfg.max_waves:
-            return None
-        order2 = np.argsort(wave, kind="stable")
-        sw = wave[order2]
-        lane_sorted = np.arange(n) - np.searchsorted(sw, sw, side="left")
-        max_lane = int(lane_sorted.max())
-        if max_lane >= cfg.batch_size:
-            return None
-        lane = np.empty(n, np.int64)
-        lane[order2] = lane_sorted
-
-        # Bucket the device batch width to the actual occupancy: the
-        # kernel's cost is per-LANE, so running a 2048-wide batch for a
-        # 500-item call wastes 4x device time. Only ALREADY-WARM shapes
-        # are used (batch_size always is; smaller buckets appear as the
-        # background warmer finishes compiling them).
-        B = cfg.batch_size
-        if store is None:
-            # With a store, only batch_size-wide store-path kernels are
-            # warmed (warm_store_path); narrower buckets would cold-
-            # compile probe/inject/gather under the serving lock.
-            for s in self._warm_shapes:  # immutable snapshot; warmer swaps atomically
-                if s > max_lane and s < B:
-                    B = s
-
-        # Encode columns (the encode_one clamps, vectorized).
-        hits = np.clip(cols.hits, -MAX_COUNT, MAX_COUNT)
-        limit = np.clip(cols.limit, -MAX_COUNT, MAX_COUNT)
-        duration = np.clip(cols.duration, 0, MAX_DURATION_MS)
-        burst = np.clip(cols.burst, 0, MAX_COUNT)
-        is_leaky = cols.algo.astype(np.int64) == 1
-        burst = np.where(is_leaky & (burst == 0), limit, burst)
-        # created_at==0 counts as absent, like the object path (server.py
-        # treats 0 the same as unset before handing to the engine).
-        created = np.where(
-            cols.has_created.astype(bool) & (cols.created_at != 0),
-            cols.created_at,
-            np.int64(now),
+        asm = _assemble_column_waves(
+            cols, hi, lo, grp, now, cfg.batch_size, cfg.max_waves,
+            # Width bucketing uses only ALREADY-WARM shapes (batch_size
+            # always is). With a store, only batch_size-wide store-path
+            # kernels are warmed (warm_store_path); narrower buckets
+            # would cold-compile probe/inject/gather under the lock.
+            width_candidates=self._warm_shapes if store is None else (),
         )
-
-        W = num_waves
-
-        def stack(dtype):
-            return np.zeros((W, B), dtype=dtype)
-
-        wb = RequestBatch(
-            key_hi=stack(np.int64),
-            key_lo=stack(np.int64),
-            group=stack(np.int32),
-            algo=stack(np.int8),
-            behavior=stack(np.int32),
-            hits=stack(np.int64),
-            limit=stack(np.int64),
-            duration=stack(np.int64),
-            rate_num=stack(np.int64),
-            eff_duration=stack(np.int64),
-            greg_expire=stack(np.int64),
-            burst=stack(np.int64),
-            created_at=stack(np.int64),
-            active=stack(bool),
-        )
-        ix = (wave, lane)
-        wb.key_hi[ix] = hi
-        wb.key_lo[ix] = lo
-        wb.group[ix] = grp
-        wb.algo[ix] = cols.algo.astype(np.int8)
-        wb.behavior[ix] = cols.behavior.astype(np.int32)
-        wb.hits[ix] = hits
-        wb.limit[ix] = limit
-        wb.duration[ix] = duration
-        wb.rate_num[ix] = duration
-        wb.eff_duration[ix] = duration
-        wb.burst[ix] = burst
-        wb.created_at[ix] = created
-        wb.active[ix] = True
+        if asm is None:
+            return None
+        wb, wave, lane, ix, W, B = asm
 
         # Store path pre-work (the columnar twin of _process's read-through
         # plumbing): request objects are built LAZILY, only for miss lanes;
@@ -906,10 +832,7 @@ class DeviceEngine(EngineBase):
             wave_slices, lane_reqs, now, prefetched, req_resolver=resolver
         )
 
-        status = np.stack([np.asarray(o.status) for o in outs])
-        r_limit = np.stack([np.asarray(o.limit) for o in outs])
-        remaining = np.stack([np.asarray(o.remaining) for o in outs])
-        reset_time = np.stack([np.asarray(o.reset_time) for o in outs])
+        status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
 
         if store is not None:
             # Write-behind from the per-wave gathered rows (last-op-wins
@@ -922,10 +845,7 @@ class DeviceEngine(EngineBase):
             if cfg.keep_key_strings:
                 self._drop_displaced_strings(events)
 
-        tot_hits = sum(int(o.hits) for o in outs)
-        tot_miss = sum(int(o.misses) for o in outs)
-        tot_evic = sum(int(o.unexpired_evictions) for o in outs)
-        tot_over = sum(int(o.over_limit) for o in outs)
+        tot_hits, tot_miss, tot_evic, tot_over = _wave_totals(outs)
         self.metrics.observe(
             tot_hits, tot_miss, tot_evic, tot_over, W, n,
             time.perf_counter() - t_start,
@@ -1329,6 +1249,121 @@ class DeviceEngine(EngineBase):
             self.table = self.K.from_wide(SlotTable(**fields))
         with self._keys_lock:
             self._key_strings = dict(snap.get("key_strings", {}))
+
+
+def _assemble_column_waves(
+    cols, hi, lo, grp, now, batch_size: int, max_waves: int,
+    width_candidates=(),
+):
+    """Vectorized wave assembly shared by the engines' columnar paths:
+    wave = occurrence rank within the group (stable sort keeps arrival
+    order, preserving per-key sequencing); lane = arrival rank within
+    the wave. Returns (wb, wave, lane, ix, W, B) with `wb` a (W, B)
+    stacked RequestBatch, or None when the batch exceeds the wave/lane
+    bounds (caller falls back to the object path).
+
+    `width_candidates` optionally narrows the device batch width to the
+    actual occupancy — the kernel's cost is per-LANE — using only
+    already-compiled widths."""
+    from gubernator_tpu.models.bucket import MAX_COUNT, MAX_DURATION_MS
+
+    n = cols.n
+    order = np.argsort(grp, kind="stable")
+    sg = grp[order]
+    wave_sorted = np.arange(n) - np.searchsorted(sg, sg, side="left")
+    wave = np.empty(n, np.int64)
+    wave[order] = wave_sorted
+    num_waves = int(wave.max()) + 1
+    if num_waves > max_waves:
+        return None
+    order2 = np.argsort(wave, kind="stable")
+    sw = wave[order2]
+    lane_sorted = np.arange(n) - np.searchsorted(sw, sw, side="left")
+    max_lane = int(lane_sorted.max())
+    if max_lane >= batch_size:
+        return None
+    lane = np.empty(n, np.int64)
+    lane[order2] = lane_sorted
+
+    B = batch_size
+    for s in width_candidates:  # immutable snapshot; warmer swaps atomically
+        if s > max_lane and s < B:
+            B = s
+
+    # Encode columns (the encode_one clamps, vectorized).
+    hits = np.clip(cols.hits, -MAX_COUNT, MAX_COUNT)
+    limit = np.clip(cols.limit, -MAX_COUNT, MAX_COUNT)
+    duration = np.clip(cols.duration, 0, MAX_DURATION_MS)
+    burst = np.clip(cols.burst, 0, MAX_COUNT)
+    is_leaky = cols.algo.astype(np.int64) == 1
+    burst = np.where(is_leaky & (burst == 0), limit, burst)
+    # created_at==0 counts as absent, like the object path (server.py
+    # treats 0 the same as unset before handing to the engine).
+    created = np.where(
+        cols.has_created.astype(bool) & (cols.created_at != 0),
+        cols.created_at,
+        np.int64(now),
+    )
+
+    W = num_waves
+
+    def stack(dtype):
+        return np.zeros((W, B), dtype=dtype)
+
+    wb = RequestBatch(
+        key_hi=stack(np.int64),
+        key_lo=stack(np.int64),
+        group=stack(np.int32),
+        algo=stack(np.int8),
+        behavior=stack(np.int32),
+        hits=stack(np.int64),
+        limit=stack(np.int64),
+        duration=stack(np.int64),
+        rate_num=stack(np.int64),
+        eff_duration=stack(np.int64),
+        greg_expire=stack(np.int64),
+        burst=stack(np.int64),
+        created_at=stack(np.int64),
+        active=stack(bool),
+    )
+    ix = (wave, lane)
+    wb.key_hi[ix] = hi
+    wb.key_lo[ix] = lo
+    wb.group[ix] = grp
+    wb.algo[ix] = cols.algo.astype(np.int8)
+    wb.behavior[ix] = cols.behavior.astype(np.int32)
+    wb.hits[ix] = hits
+    wb.limit[ix] = limit
+    wb.duration[ix] = duration
+    wb.rate_num[ix] = duration
+    wb.eff_duration[ix] = duration
+    wb.burst[ix] = burst
+    wb.created_at[ix] = created
+    wb.active[ix] = True
+    return wb, wave, lane, ix, W, B
+
+
+def _stack_wave_outputs(outs):
+    """(status, limit, remaining, reset_time) stacked (W, B) host arrays
+    from per-wave DecideOutputs — the demux shared by the engines'
+    columnar paths."""
+    return (
+        np.stack([np.asarray(o.status) for o in outs]),
+        np.stack([np.asarray(o.limit) for o in outs]),
+        np.stack([np.asarray(o.remaining) for o in outs]),
+        np.stack([np.asarray(o.reset_time) for o in outs]),
+    )
+
+
+def _wave_totals(outs):
+    """(hits, misses, unexpired_evictions, over_limit) summed across
+    waves for EngineMetrics.observe."""
+    return (
+        sum(int(o.hits) for o in outs),
+        sum(int(o.misses) for o in outs),
+        sum(int(o.unexpired_evictions) for o in outs),
+        sum(int(o.over_limit) for o in outs),
+    )
 
 
 def _select_columns(cols, select: np.ndarray):

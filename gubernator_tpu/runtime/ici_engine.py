@@ -47,7 +47,12 @@ from gubernator_tpu.parallel import mesh as pmesh
 from gubernator_tpu.runtime.engine import (
     EngineBase,
     EngineMetrics,
+    TableCommittedError,
     _WaveAssembler,
+    _assemble_column_waves,
+    _select_columns,
+    _stack_wave_outputs,
+    _wave_totals,
 )
 from gubernator_tpu.utils import clock as _clock
 
@@ -200,6 +205,108 @@ class IciEngine(EngineBase):
                 state = self._inject_replicas(state, ib, now)
             self.ici_state = state
 
+    def check_columns(
+        self,
+        cols,
+        now: Optional[int] = None,
+        select: Optional[np.ndarray] = None,
+        hashes: Optional[tuple] = None,
+    ):
+        """Columnar serving for the owner-sharded (non-GLOBAL) tier:
+        the shared wave assembler feeds one SPMD sharded decide per wave
+        — the multi-chip daemon's fast edge. GLOBAL columns are NOT
+        accepted (defensive None): the replica tier's home round-robin
+        and pending bookkeeping run through the object path, and
+        fastpath already bails on routes_global_internally engines.
+        Waves always run at the full batch width — a narrower width
+        would cold-compile a second SPMD program per shape."""
+        from gubernator_tpu import native as _native
+
+        cfg = self.cfg
+        if cols.n == 0:
+            return None
+        t_start = time.perf_counter()
+        if now is None:
+            now = self.now_fn()
+        if np.any((cols.behavior & int(Behavior.GLOBAL)) != 0):
+            return None
+        if hashes is None:
+            hi, lo, grp = _native.hash128_batch_raw(
+                cols.key_data.tobytes(), cols.key_offsets, cfg.num_groups
+            )
+        else:
+            hi, lo, grp = hashes
+        if select is not None:
+            if len(select) == 0:
+                return None
+            hi, lo, grp = hi[select], lo[select], grp[select]
+            cols = _select_columns(cols, select)
+        asm = _assemble_column_waves(
+            cols, hi, lo, grp, now, cfg.batch_size, cfg.max_waves
+        )
+        if asm is None:
+            return None
+        wb, wave, lane, ix, W, _B = asm
+        wave_slices = [
+            jax.tree.map(lambda a, w=w: a[w], wb) for w in range(W)
+        ]
+        outs = []
+        with self._lock:
+            table = self.table
+            try:
+                for ws in wave_slices:
+                    table, out = self._decide(table, ws, now)
+                    outs.append(out)
+                self.table = table
+            except Exception as e:
+                # Keep the last surviving intermediate table; if the
+                # donated buffers were consumed, rebuild so the engine
+                # keeps serving. Committed waves on a SURVIVING table
+                # must NOT be replayed by a fallback path.
+                self.table = table
+                rebuilt = self._recover_tables_locked()
+                if outs and not rebuilt:
+                    raise TableCommittedError(str(e)) from e
+                raise
+        status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
+        th, tm, te, to = _wave_totals(outs)
+        self.metrics.observe(
+            th, tm, te, to, W, cols.n, time.perf_counter() - t_start
+        )
+        return (status[ix], r_limit[ix], remaining[ix], reset_time[ix])
+
+    def _recover_tables_locked(self) -> bool:
+        """Called with the lock held after a failed device call: the
+        jitted decide/replica programs donate their table buffers, so a
+        failure may leave self.table / self.ici_state pointing at
+        consumed arrays — every later call would then fail forever.
+        Rebuild whichever was consumed (counter loss on failure matches
+        the accepted cache-loss-on-restart semantics). Returns True when
+        anything was rebuilt (a fallback replay is then safe, not a
+        double-apply)."""
+        cfg = self.cfg
+
+        def consumed(tree) -> bool:
+            try:
+                leaf = jax.tree_util.tree_leaves(tree)[0]
+                return getattr(leaf, "is_deleted", lambda: False)()
+            except Exception:
+                return True
+
+        rebuilt = False
+        if consumed(self.table):
+            self.table = pmesh.create_sharded_table(
+                self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
+            )
+            rebuilt = True
+        if consumed(self.ici_state):
+            self.ici_state = ici.create_ici_state(
+                self.mesh, cfg.num_slots, cfg.replica_ways,
+                layout=cfg.layout,
+            )
+            rebuilt = True
+        return rebuilt
+
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
@@ -298,18 +405,26 @@ class IciEngine(EngineBase):
                 placements.append(None)
                 continue
 
-        # Execute: sharded waves then replica waves.
+        # Execute: sharded waves then replica waves. On failure keep the
+        # surviving intermediates and rebuild any consumed donated table
+        # (the futures resolve with errors; nothing replays this flush).
         s_out, r_out = [], []
         with self._lock:
             table = self.table
-            for wb in sharded_asm.waves:
-                table, out = self._decide(table, wb, now)
-                s_out.append(out)
-            self.table = table
             state = self.ici_state
-            for wb, hm in zip(replica_asm.waves, replica_homes):
-                state, out = self._replica(state, wb, hm, now)
-                r_out.append(out)
+            try:
+                for wb in sharded_asm.waves:
+                    table, out = self._decide(table, wb, now)
+                    s_out.append(out)
+                for wb, hm in zip(replica_asm.waves, replica_homes):
+                    state, out = self._replica(state, wb, hm, now)
+                    r_out.append(out)
+            except Exception:
+                self.table = table
+                self.ici_state = state
+                self._recover_tables_locked()
+                raise
+            self.table = table
             self.ici_state = state
 
         def host_rows(outs):
