@@ -212,14 +212,15 @@ class IciEngine(EngineBase):
         select: Optional[np.ndarray] = None,
         hashes: Optional[tuple] = None,
     ):
-        """Columnar serving for the owner-sharded (non-GLOBAL) tier:
-        the shared wave assembler feeds one SPMD sharded decide per wave
-        — the multi-chip daemon's fast edge. GLOBAL columns are NOT
-        accepted (defensive None): the replica tier's home round-robin
-        and pending bookkeeping run through the object path, and
-        fastpath already bails on routes_global_internally engines.
-        Waves always run at the full batch width — a narrower width
-        would cold-compile a second SPMD program per shape."""
+        """Columnar serving for BOTH ici tiers — the multi-chip daemon's
+        fast edge. Non-GLOBAL items feed the owner-sharded SPMD decide
+        (shared wave assembler, one collective call per wave); GLOBAL
+        items feed the per-device replica tier with the same round-robin
+        home assignment as the object path (replica decide handles
+        pending bookkeeping internally; the GLOBAL bit stays SET — this
+        engine routes_global_internally). Waves always run at the full
+        batch width — a narrower width would cold-compile a second SPMD
+        program per shape."""
         from gubernator_tpu import native as _native
 
         cfg = self.cfg
@@ -228,8 +229,6 @@ class IciEngine(EngineBase):
         t_start = time.perf_counter()
         if now is None:
             now = self.now_fn()
-        if np.any((cols.behavior & int(Behavior.GLOBAL)) != 0):
-            return None
         if hashes is None:
             hi, lo, grp = _native.hash128_batch_raw(
                 cols.key_data.tobytes(), cols.key_offsets, cfg.num_groups
@@ -241,39 +240,108 @@ class IciEngine(EngineBase):
                 return None
             hi, lo, grp = hi[select], lo[select], grp[select]
             cols = _select_columns(cols, select)
-        asm = _assemble_column_waves(
-            cols, hi, lo, grp, now, cfg.batch_size, cfg.max_waves
-        )
-        if asm is None:
-            return None
-        wb, wave, lane, ix, W, _B = asm
-        wave_slices = [
-            jax.tree.map(lambda a, w=w: a[w], wb) for w in range(W)
-        ]
-        outs = []
+        n = cols.n
+        g_mask = (np.asarray(cols.behavior) & int(Behavior.GLOBAL)) != 0
+        ng_idx = np.nonzero(~g_mask)[0]
+        g_idx = np.nonzero(g_mask)[0]
+
+        # -- assemble the sharded (non-GLOBAL) waves --
+        s_asm = None
+        if len(ng_idx):
+            s_cols = (
+                cols if len(g_idx) == 0 else _select_columns(cols, ng_idx)
+            )
+            s_asm = _assemble_column_waves(
+                s_cols, hi[ng_idx], lo[ng_idx], grp[ng_idx], now,
+                cfg.batch_size, cfg.max_waves,
+            )
+            if s_asm is None:
+                return None
+
+        # -- assemble the replica (GLOBAL) waves --
+        r_asm, homes_wb = None, None
+        if len(g_idx):
+            r_cols = _select_columns(cols, g_idx)
+            r_lo = lo[g_idx]
+            slot = (r_lo.astype(np.uint64) % np.uint64(self.num_rgroups)
+                    ).astype(np.int64)
+            with self._lock:  # round-robin base, racing the pump thread
+                rr0 = self._home_rr
+                self._home_rr += len(g_idx)
+            homes = (rr0 + np.arange(len(g_idx))) % self.n_dev
+            # Wave conflicts are per (home, slot) PAIR (the object path's
+            # place key): encode the pair as the assembly "group", then
+            # overwrite the batch's group column with the real slot.
+            pair = homes * np.int64(self.num_rgroups) + slot
+            r_asm = _assemble_column_waves(
+                r_cols, hi[g_idx], r_lo, pair, now,
+                cfg.batch_size, cfg.max_waves,
+            )
+            if r_asm is None:
+                return None
+            r_wb, _rw, _rl, r_ix, RW, RB = r_asm
+            r_wb.group[r_ix] = slot.astype(np.int32)
+            homes_wb = np.zeros((RW, RB), dtype=np.int64)
+            homes_wb[r_ix] = homes
+
+        s_outs, r_outs = [], []
         with self._lock:
             table = self.table
+            state = self.ici_state
             try:
-                for ws in wave_slices:
-                    table, out = self._decide(table, ws, now)
-                    outs.append(out)
-                self.table = table
+                if s_asm is not None:
+                    wb = s_asm[0]
+                    for w in range(s_asm[4]):
+                        ws = jax.tree.map(lambda a, w=w: a[w], wb)
+                        table, out = self._decide(table, ws, now)
+                        s_outs.append(out)
+                if r_asm is not None:
+                    r_wb = r_asm[0]
+                    for w in range(r_asm[4]):
+                        ws = jax.tree.map(lambda a, w=w: a[w], r_wb)
+                        state, out = self._replica(
+                            state, ws, homes_wb[w], now
+                        )
+                        r_outs.append(out)
             except Exception as e:
-                # Keep the last surviving intermediate table; if the
-                # donated buffers were consumed, rebuild so the engine
-                # keeps serving. Committed waves on a SURVIVING table
-                # must NOT be replayed by a fallback path.
+                # Keep the last surviving intermediates; if donated
+                # buffers were consumed, rebuild so the engine keeps
+                # serving. Committed waves on SURVIVING tables must NOT
+                # be replayed by a fallback path.
                 self.table = table
+                self.ici_state = state
                 rebuilt = self._recover_tables_locked()
-                if outs and not rebuilt:
+                if (s_outs or r_outs) and not rebuilt:
                     raise TableCommittedError(str(e)) from e
                 raise
-        status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
-        th, tm, te, to = _wave_totals(outs)
+            self.table = table
+            self.ici_state = state
+
+        status = np.zeros(n, np.int64)
+        r_limit = np.zeros(n, np.int64)
+        remaining = np.zeros(n, np.int64)
+        reset_time = np.zeros(n, np.int64)
+        waves_total = 0
+        tots = [0, 0, 0, 0]
+        for outs, asm, idx in (
+            (s_outs, s_asm, ng_idx), (r_outs, r_asm, g_idx),
+        ):
+            if asm is None:
+                continue
+            st, li, re, rt = _stack_wave_outputs(outs)
+            ix = asm[3]
+            status[idx] = st[ix]
+            r_limit[idx] = li[ix]
+            remaining[idx] = re[ix]
+            reset_time[idx] = rt[ix]
+            waves_total += asm[4]
+            for j, v in enumerate(_wave_totals(outs)):
+                tots[j] += v
         self.metrics.observe(
-            th, tm, te, to, W, cols.n, time.perf_counter() - t_start
+            tots[0], tots[1], tots[2], tots[3], waves_total, n,
+            time.perf_counter() - t_start,
         )
-        return (status[ix], r_limit[ix], remaining[ix], reset_time[ix])
+        return (status, r_limit, remaining, reset_time)
 
     def _recover_tables_locked(self) -> bool:
         """Called with the lock held after a failed device call: the

@@ -87,14 +87,15 @@ def try_serve(svc, data: bytes, peer_call: bool):
       owner-metadata spans, or None;
     - None — fall back to the object path entirely.
 
-    GLOBAL items (grpc global mode): V1 calls are answered from the
-    local table whether owned or not (reference gubernator.go:395-421),
-    with the replication legs queued through the GlobalManager after
-    the decide commits — queue_update for owned items, queue_hit plus
+    GLOBAL items: V1 calls are answered from the local table whether
+    owned or not (reference gubernator.go:395-421), with the
+    replication legs queued through the GlobalManager after the decide
+    commits — queue_update for owned items, queue_hit plus
     metadata={"owner": ...} for non-owned. Peer relays apply drain
     semantics at the owner (DRAIN_OVER_LIMIT forced) and queue the
-    broadcast; items carrying trace metadata, and ici-mode engines
-    (internal GLOBAL routing), keep the object path.
+    broadcast. Engines that route GLOBAL internally (ici mode) receive
+    the flag unstripped and decide through their replica tier; items
+    carrying trace metadata keep the object path.
     """
     cols = wire.parse_requests(data)
     if cols is None or cols.n == 0 or cols.n > MAX_BATCH_SIZE:
@@ -109,8 +110,10 @@ def try_serve(svc, data: bytes, peer_call: bool):
         cols.behavior = cols.behavior | np.int64(_GLOBAL)
     g_mask = (cols.behavior & _GLOBAL) != 0
     has_global = bool(g_mask.any())
-    if has_global and getattr(svc.engine, "routes_global_internally", False):
-        return None  # ici-mode engines route GLOBAL internally
+    # ici-mode engines route GLOBAL internally (replica tier): the
+    # GLOBAL bit must reach the engine unstripped; the daemon-level
+    # replication legs + owner metadata are identical.
+    strip_global = not getattr(svc.engine, "routes_global_internally", False)
     if peer_call and has_global:
         # Owner applying relayed GLOBAL hits always drains (reference
         # gubernator.go:510-512) and queues a broadcast; items with
@@ -190,9 +193,10 @@ def try_serve(svc, data: bytes, peer_call: bool):
             if req.created_at is None:
                 req.created_at = now
         # The standard engine expects GLOBAL stripped (the daemon's
-        # global manager owns replication, engine.routes_global_internally
-        # False) — same strip the object path does (server.py).
-        cols.behavior = cols.behavior & ~np.int64(_GLOBAL)
+        # global manager owns replication) — same conditional strip the
+        # object path does (server.py).
+        if strip_global:
+            cols.behavior = cols.behavior & ~np.int64(_GLOBAL)
 
     def queue_legs():
         # try_serve runs on the serving executor; the managers' queues

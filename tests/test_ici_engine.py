@@ -153,6 +153,10 @@ def test_ici_check_columns_matches_object_path():
                     algorithm=rng.choice(
                         [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
                     ),
+                    # GLOBAL items route through the replica tier with
+                    # round-robin homes — both engines consume the same
+                    # rr sequence, so decisions must still match
+                    behavior=rng.choice([0, int(Behavior.GLOBAL)]),
                     duration=rng.choice([500, 60_000]),
                     limit=rng.choice([3, 100]),
                     hits=rng.choice([0, 1, 2]),
@@ -212,18 +216,15 @@ def test_ici_daemon_columnar_fast_edge(loop_thread):
         out = pb.pb.GetRateLimitsResp.FromString(raw)
         assert [r.remaining for r in out.responses] == [98, 98, 96, 98, 94]
 
-        # GLOBAL item -> whole batch falls back (replica tier needs the
-        # object path's home assignment), served correctly regardless
+        # A batch containing a GLOBAL item is ALSO columnar: the GLOBAL
+        # lane decides through the replica tier (fresh counter there —
+        # the two tiers hold separate tables, exactly like the object
+        # path), non-GLOBAL lanes continue on the sharded tier.
         msg.requests[1].behavior = int(Behavior.GLOBAL)
-        assert fastpath.try_serve(d.svc, msg.SerializeToString(), False) is None
-
-        async def call():
-            return (
-                await d.client().get_rate_limits(msg, timeout=10)
-            ).responses
-
-        resp = loop_thread.run(call())
-        assert [r.remaining for r in resp] == [92, 98, 90, 96, 88]
+        raw2 = fastpath.try_serve(d.svc, msg.SerializeToString(), False)
+        assert isinstance(raw2, bytes), type(raw2)
+        out2 = pb.pb.GetRateLimitsResp.FromString(raw2)
+        assert [r.remaining for r in out2.responses] == [92, 98, 90, 96, 88]
     finally:
         loop_thread.run(d.close())
 
