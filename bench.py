@@ -232,6 +232,8 @@ def _try_runner_relay(args, timeout_s: float = 2400.0):
         "    r = bench.bench_ici(args.layout)\n"
         "elif args.mode == 'edge':\n"
         "    r = bench.bench_edge()\n"
+        "elif args.mode == 'ab':\n"
+        "    r = bench.bench_ab(cand=args.layout)\n"
         "else:\n"
         "    r = bench.bench_kernel(args.mode, args.layout)\n"
         "print('RESULT ' + json.dumps(r))\n"
@@ -887,7 +889,7 @@ def main() -> None:
     parser.add_argument(
         "--mode", default="kernel",
         choices=("kernel", "engine", "server", "global", "kernel10m",
-                 "latency", "ici", "edge"),
+                 "latency", "ici", "edge", "ab"),
         help="kernel: device decide throughput @1M keys (headline); "
         "engine: end-to-end host+device serving path; "
         "server: full gRPC round trip; "
@@ -896,10 +898,13 @@ def main() -> None:
         "on a 16M-slot table; "
         "latency: device decide step time, tunnel-RTT-cancelled; "
         "ici: multi-device tier — replica GLOBAL decide throughput + "
-        "sync tick device time vs table size",
+        "sync tick device time vs table size; "
+        "ab: --layout vs fused decide-throughput A/B at the 2M- and "
+        "16M-slot geometries, comparison rows ledgered",
     )
     parser.add_argument(
-        "--layout", default="fused", choices=("wide", "packed", "fused"),
+        "--layout", default="fused",
+        choices=("wide", "packed", "fused", "narrow"),  # kernels.LAYOUTS
         help="table layout for kernel modes (ops/kernels.py)",
     )
     args, _ = parser.parse_known_args()
@@ -962,6 +967,9 @@ def main() -> None:
     if args.mode == "edge":
         emit(bench_edge())
         return
+    if args.mode == "ab":
+        emit(bench_ab(cand=args.layout))
+        return
     emit(bench_kernel(args.mode, args.layout))
 
 
@@ -1019,7 +1027,7 @@ def bench_kernel(mode: str = "kernel", layout: str = "fused") -> dict:
     """Device decide() throughput. mode="kernel": BASELINE config (3),
     1M-key Zipfian on a 2M-slot table. mode="kernel10m": config (5),
     10M-key Zipfian mixed behaviors on a 16M-slot table. layout selects
-    the table layout ("wide" | "packed", see ops/kernels.py)."""
+    the table layout (the ops/kernels.py LAYOUTS registry)."""
     import jax
 
     from gubernator_tpu.ops.kernels import get_kernels
@@ -1127,6 +1135,88 @@ def bench_kernel(mode: str = "kernel", layout: str = "fused") -> dict:
         "vs_baseline": round(throughput / 4000.0, 1),
     }
     return result
+
+
+def _bench_kernel_fresh(mode: str, layout: str) -> dict:
+    """bench_kernel in a FRESH interpreter. Back-to-back GB-scale table
+    runs in one process contaminate each other (allocator/page-cache
+    carry-over depressed the LAST of four 16M-slot runs 3.5x on the CPU
+    ladder), so each A/B cell gets its own process. Falls through to
+    in-process on any subprocess failure — a TPU runner's device is
+    already held by this process, so its child can't grab it and the
+    relay path keeps the old single-process behavior."""
+    import subprocess
+    import sys
+
+    script = (
+        "import json\n"
+        "import bench\n"
+        f"r = bench.bench_kernel({mode!r}, {layout!r})\n"
+        "print('RESULT ' + json.dumps(r))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=1800,
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("RESULT "):
+                return json.loads(line[len("RESULT "):])
+        print(f"[bench] fresh-process {mode}/{layout} gave no RESULT "
+              f"(rc={proc.returncode}); falling back in-process", flush=True)
+    except Exception as e:
+        print(f"[bench] fresh-process {mode}/{layout} failed ({e!r}); "
+              f"falling back in-process", flush=True)
+    return bench_kernel(mode, layout)
+
+
+def bench_ab(
+    sizes=("kernel", "kernel10m"), base: str = "fused", cand: str = "narrow"
+) -> dict:
+    """Layout A/B on the kernel benchmark: run `base` then `cand` at each
+    geometry (kernel = 1M keys / 2M slots, kernel10m = 10M keys / 16M
+    slots) under identical batches — each cell in a fresh process (see
+    _bench_kernel_fresh) — and ledger one comparison row per geometry
+    (value = cand/base throughput ratio) into
+    bench_results/results.jsonl. Returns the headline (first-geometry)
+    comparison row; per-layout raw rows are printed as RESULT lines so a
+    runner relay preserves them."""
+    import jax
+
+    from gubernator_tpu.utils import ledger
+
+    platform = jax.devices()[0].platform
+    headline = None
+    for mode in sizes:
+        pair = {}
+        for layout in (base, cand):
+            # A TPU is exclusively held by THIS process — a child could
+            # never initialize it, so only the CPU ladder isolates.
+            if platform == "cpu":
+                r = _bench_kernel_fresh(mode, layout)
+            else:
+                r = bench_kernel(mode, layout)
+            ledger.append(r, job=f"bench_ab_{mode}", mode=mode, layout=layout)
+            print("RESULT " + json.dumps(r), flush=True)
+            pair[layout] = float(r["value"])
+        ratio = pair[cand] / max(pair[base], 1.0)
+        label = "16M" if mode == "kernel10m" else "2M"
+        row = {
+            "metric": (
+                f"{cand}/{base} decide throughput A/B @{label}-slot table "
+                f"({mode}, {platform}); {base}={pair[base]:.0f} "
+                f"{cand}={pair[cand]:.0f} decisions/s"
+            ),
+            "value": round(ratio, 3),
+            "unit": "x",
+            "vs_baseline": round(ratio, 3),
+        }
+        ledger.append(row, job=f"bench_ab_{mode}", mode="ab", layout=cand)
+        print("RESULT " + json.dumps(row), flush=True)
+        if headline is None:
+            headline = row
+    return headline or {}
 
 
 if __name__ == "__main__":

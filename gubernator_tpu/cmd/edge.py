@@ -86,7 +86,11 @@ def main() -> None:
             await http_runner.setup()
             site = web.TCPSite(http_runner, hhost, hport)
             await site.start()
-            logging.info("edge http listening on %s:%s", hhost, hport)
+            from gubernator_tpu.utils.net import recorded_address
+
+            logging.info(
+                "edge http listening on %s", recorded_address(hhost, hport)
+            )
         logging.info(
             "gubernator-tpu edge listening on %s -> upstream %s",
             listen.rsplit(":", 1)[0] + f":{port}", upstream,

@@ -134,21 +134,19 @@ def unpack_table(fused: FusedTable) -> SlotTable:
     )
 
 
-def _probe(rows, batch, now):
-    """Shared way-selection over a gathered (B, W, C) block: returns
-    (exists, matched_way, insert_way, cat). Policy identical to the wide
-    kernel's _choose_slot: matched-expired > empty > expired > LRU."""
-    w_meta = rows[..., META]
+def probe_ways(w_khi, w_klo, w_meta, w_exp, w_inv, batch, now):
+    """Way-selection policy over per-way column arrays (each (B, W)):
+    returns (exists, matched_way, insert_way, cat). Policy identical to
+    the wide kernel's _choose_slot: matched-expired > empty > expired >
+    LRU. Shared by the fused and narrow layouts so the two can never
+    drift — narrow feeds it slices of its (B, W, C64) hot block."""
     w_used = (w_meta & META_USED) != 0
     w_lru = w_meta >> META_LRU_SHIFT
-    w_invalid = rows[..., INV]
-    w_expired = w_used & (
-        (rows[..., EXP] < now) | ((w_invalid != 0) & (w_invalid < now))
-    )
+    w_expired = w_used & ((w_exp < now) | ((w_inv != 0) & (w_inv < now)))
     w_match = (
         w_used
-        & (rows[..., KHI] == batch.key_hi[:, None])
-        & (rows[..., KLO] == batch.key_lo[:, None])
+        & (w_khi == batch.key_hi[:, None])
+        & (w_klo == batch.key_lo[:, None])
     )
     live_match = w_match & ~w_expired
     exists = jnp.any(live_match, axis=1)
@@ -159,11 +157,19 @@ def _probe(rows, batch, now):
         0,
         jnp.where(~w_used, 1, jnp.where(w_expired, 2, 3)),
     ).astype(I64)
-    way_off = jnp.arange(rows.shape[1], dtype=I64)[None, :]
+    way_off = jnp.arange(w_meta.shape[1], dtype=I64)[None, :]
     tie = jnp.where(cat == 3, jnp.clip(w_lru, 0, (1 << 44) - 1), way_off)
     score = (cat << 44) + tie
     insert_way = jnp.argmin(score, axis=1)
     return exists, matched_way, insert_way, cat
+
+
+def _probe(rows, batch, now):
+    """Way selection over a gathered (B, W, C) block (see probe_ways)."""
+    return probe_ways(
+        rows[..., KHI], rows[..., KLO], rows[..., META],
+        rows[..., EXP], rows[..., INV], batch, now,
+    )
 
 
 def _decide_fused_impl(table: FusedTable, batch: RequestBatch, now, *, ways: int):

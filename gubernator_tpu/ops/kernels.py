@@ -8,8 +8,12 @@ The engine selects a table layout by name (EngineConfig.layout):
 - "fused": ONE (N, C) tensor, one gather + one scatter (ops/fused.py) —
   the fastest at scale (the SoA layouts hit XLA defensive whole-table
   copies; see ops/fused.py's module docstring) and the flagship default.
+- "narrow": fused v2 — a split-word (N, 9) tensor (ops/narrow.py)
+  ordered so way selection reads only a 5-column row PREFIX (40 B/way,
+  half of fused's probe DMA) and the int32-clamped counters bit-pack
+  into one word; still exactly one gather + one scatter.
 
-Both are bit-exact against the oracle (tests/test_kernel_fuzz.py runs the
+All are bit-exact against the oracle (tests/test_kernel_fuzz.py runs the
 whole differential suite per layout). Snapshots are ALWAYS exchanged in
 the wide format (to_wide/from_wide), so Loader files are portable across
 layouts.
@@ -18,6 +22,16 @@ layouts.
 from __future__ import annotations
 
 from typing import NamedTuple
+
+# The registry every layout-selection surface validates against
+# (EngineConfig.layout, GUBER_TABLE_LAYOUT / GUBER_ICI_LAYOUT, bench.py
+# --layout, the kernel fuzz suite).
+LAYOUTS = ("wide", "packed", "fused", "narrow")
+
+# Resident bytes per table slot, by layout (engine table-size gates,
+# e.g. the bucket-warmer's scratch-copy budget; see each layout module
+# for the field-by-field accounting).
+BYTES_PER_SLOT = {"wide": 83, "packed": 72, "fused": 80, "narrow": 72}
 
 from gubernator_tpu.ops.decide import (
     decide as _wd,
@@ -39,6 +53,7 @@ class Kernels(NamedTuple):
     gather_rows: object  # (table, slots) -> SlotTable rows (wide view)
     to_wide: object  # table -> SlotTable
     from_wide: object  # SlotTable -> table
+    bytes_per_slot: int = 83  # resident table bytes per slot
 
 
 def _wide_decide(table, batch, now, ways, with_store=False):
@@ -61,6 +76,7 @@ _WIDE = Kernels(
     gather_rows=_wgr,
     to_wide=lambda t: t,
     from_wide=lambda t: t,
+    bytes_per_slot=BYTES_PER_SLOT["wide"],
 )
 
 
@@ -85,6 +101,7 @@ def _packed():
         gather_rows=_p.gather_rows_packed,
         to_wide=_p.unpack_table,
         from_wide=_p.pack_table,
+        bytes_per_slot=BYTES_PER_SLOT["packed"],
     )
 
 
@@ -109,6 +126,32 @@ def _fused():
         gather_rows=_f.gather_rows_fused,
         to_wide=_f.unpack_table,
         from_wide=_f.pack_table,
+        bytes_per_slot=BYTES_PER_SLOT["fused"],
+    )
+
+
+def _narrow():
+    from gubernator_tpu.ops import narrow as _n
+
+    return Kernels(
+        layout="narrow",
+        create=_n.NarrowTable.create,
+        decide=lambda table, batch, now, ways, with_store=False: _n.decide_narrow(
+            table, batch, now, ways=ways
+        ),
+        decide_scan=lambda table, batches, nows, ways, with_store=False: (
+            _n.decide_scan_narrow(table, batches, nows, ways=ways)
+        ),
+        inject=lambda table, items, now, ways: _n.inject_narrow(
+            table, items, now, ways=ways
+        ),
+        probe_exists=lambda table, hi, lo, group, now, ways: (
+            _n.probe_exists_narrow(table, hi, lo, group, now, ways=ways)
+        ),
+        gather_rows=_n.gather_rows_narrow,
+        to_wide=_n.unpack_table,
+        from_wide=_n.pack_table,
+        bytes_per_slot=BYTES_PER_SLOT["narrow"],
     )
 
 
@@ -119,6 +162,8 @@ def get_kernels(layout: str) -> Kernels:
         return _packed()
     if layout == "fused":
         return _fused()
+    if layout == "narrow":
+        return _narrow()
     raise ValueError(f"unknown table layout: {layout!r}")
 
 
@@ -183,5 +228,20 @@ def get_raw_kernels(layout: str) -> RawKernels:
             ),
             to_wide=_f.unpack_table,
             from_wide=_f.pack_table,
+        )
+    if layout == "narrow":
+        from gubernator_tpu.ops import narrow as _n
+
+        return RawKernels(
+            layout="narrow",
+            create=_n.NarrowTable.create,
+            decide=lambda t, b, now, ways: _n._decide_narrow_impl(
+                t, b, now, ways=ways
+            ),
+            inject=lambda t, i, now, ways: _n._inject_narrow_impl(
+                t, i, now, ways
+            ),
+            to_wide=_n.unpack_table,
+            from_wide=_n.pack_table,
         )
     raise ValueError(f"unknown table layout: {layout!r}")

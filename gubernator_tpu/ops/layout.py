@@ -19,6 +19,12 @@ struct-of-arrays region designed for vectorized gather/scatter:
   (reference cache.go:35-40).
 
 All arrays are int64/bool; (key_hi, key_lo) == (0, 0) marks empty.
+
+SlotTable is also the CANONICAL interchange row format: every other
+layout (ops/packed.py, ops/fused.py, ops/narrow.py) converts to/from it
+for Loader snapshots, the ici sync tick's merge, and store write-behind
+rows, so on-disk state and cross-layer seams never depend on the
+device-resident packing (ops/kernels.py to_wide/from_wide).
 """
 
 from __future__ import annotations

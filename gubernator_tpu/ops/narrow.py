@@ -1,0 +1,459 @@
+"""Narrow slot-table layout (fused v2): a split-word tensor that halves
+the probe DMA stream.
+
+The fused layout (ops/fused.py) gathers ALL 10 int64 columns for every
+way it probes — 80 B/way — even though way selection only consults five
+of them (key_hi, key_lo, meta, expire_at, invalid_at) and the clamped
+columns never need 64 bits (limit/burst are bounded by the documented
+MAX_COUNT = 2^31-1 encode contract, models/bucket.py). At large tables
+the W x C probe gather is the memory-bound term of the kernel
+(VERDICT r5 "what's weak" #2), so bytes-per-probed-row is the lever.
+
+This layout keeps ONE (N, 9) int64 tensor — one gather + one scatter,
+exactly like fused — but orders the row so the probe touches only a
+PREFIX of it:
+
+- cols 0:5 (KHI KLO META EXP INV, int64): precisely the columns way
+  selection reads. The probe is an explicit narrow-slice gather
+  (slice_sizes=(1, 5)) pulling the (B, W, 5) block: 40 B/way, HALF of
+  fused's 80. META packs lru<<4 | status<<2 | algo<<1 | used exactly as
+  in ops/packed.py (the cross-layout contract — never redeclared), so
+  algo/status ride free for the state phase.
+- cols 5:9 (LIMBUR DUR REM STM): per-LANE state, read by one full-row
+  gather at the chosen slot only. LIMBUR packs the two int32-clamped
+  counters into one word (limit in the low half, burst in the high half
+  — the same MAX_COUNT clamp contract ops/packed.py relies on);
+  duration, remaining, and stamp stay native int64, so leaky Q44.20
+  remaining, Gregorian durations, and arbitrary created_at stamps all
+  round-trip exactly with no split/join arithmetic on the hot path.
+
+Per-slot bytes: 72 (vs 80 fused, 83 wide). Probe bytes per way: 40 (vs
+80 fused). Group blocks stay contiguous in HBM, so the probe remains
+one coalesced DMA stream per lane.
+
+Why one tensor and bit-packing rather than an int64/int32 tensor PAIR
+(the first cut of this layout): scatter cost is per-ROW dispatch work,
+not per-byte — a second (B, C32) scatter per step cost more than the
+40 int32 bytes it saved, and a two-leaf table doubles the donation /
+scan-carry aliasing surface. Same 72 B/slot, same 40 B/way probe,
+strictly fewer gathers and scatters.
+
+Branch semantics are bit-exact with the wide/packed/fused kernels:
+way-selection policy is the SHARED ops/fused.py `probe_ways`, and
+_token_paths/_leaky_paths from ops/decide.py are reused verbatim after
+widening the row at load. The layout runs the full oracle fuzz
+(tests/test_kernel_fuzz.py) and snapshots round-trip narrow<->wide
+losslessly within the encode clamp contract (tests/test_narrow.py).
+Bucket field contract: reference store.go:29-43; LRU/expiry policy:
+reference lrucache.go:98-118, cache.go:43-57.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gubernator_tpu.api.types import Algorithm, Behavior, Status
+from gubernator_tpu.ops.decide import _leaky_paths, _token_paths
+from gubernator_tpu.ops.fused import probe_ways
+from gubernator_tpu.ops.layout import DecideOutput, RequestBatch, SlotTable
+
+# The meta-word bit layout is a cross-layout contract (Loader snapshot
+# interop): share packed.py's definition, never redeclare it.
+from gubernator_tpu.ops.packed import (
+    META_ALGO_SHIFT,
+    META_LRU_SHIFT,
+    META_STATUS_SHIFT,
+    META_USED,
+    _pack_meta,
+)
+
+I64 = jnp.int64
+I32 = jnp.int32
+
+# Row columns. The probe reads ONLY the first N_HOT; the rest is
+# per-lane state.
+KHI, KLO, META, EXP, INV, LIMBUR, DUR, REM, STM = range(9)
+N_HOT = 5
+NCOLS = 9
+
+# LIMBUR packs both int32-clamped counters into one word; DUR/REM/STM
+# are native int64 — so the row's INFORMATION is 72 bytes even though
+# the tensor is int64 throughout (and on TPU, where int64 is emulated
+# as int32 pairs, the narrow-slice probe moves exactly 40 B/way).
+BYTES_PER_SLOT = N_HOT * 8 + 4 + 4 + 3 * 8  # 72
+PROBE_BYTES_PER_WAY = N_HOT * 8  # 40 (fused: 80)
+
+
+def _split64(v):
+    """int64 -> (lo, hi) int32 halves; exact for every int64 value
+    (astype truncates to the low 32 bits; the arithmetic shift keeps the
+    sign in the high half)."""
+    v = v.astype(I64)
+    return v.astype(I32), (v >> 32).astype(I32)
+
+
+def _join64(lo, hi):
+    """(lo, hi) int32 halves -> the original int64, exactly."""
+    return (hi.astype(I64) << 32) | (lo.astype(I64) & 0xFFFFFFFF)
+
+
+def _pack_limbur(limit, burst):
+    """(limit, burst) -> one word: limit in the low 32 bits, burst in
+    the high. Lossless for values inside the int32 clamp contract
+    (MAX_COUNT = 2^31-1, models/bucket.py) — including negative limits."""
+    return (burst.astype(I64) << 32) | (limit.astype(I64) & 0xFFFFFFFF)
+
+
+def _unpack_limbur(word):
+    """LIMBUR word -> (limit, burst), sign-extending both halves."""
+    limit = word.astype(I32).astype(I64)  # low 32, sign-extended
+    burst = word >> 32  # arithmetic shift keeps burst's sign
+    return limit, burst
+
+
+def _gather_cols(data, ix, ncols: int):
+    """Gather `ncols`-column row PREFIXES of `data` at row indices `ix`
+    (any index shape) — slice_sizes below the operand's column count is
+    what keeps the probe at 40 B/way instead of the full 72-B row.
+    Indices are in-bounds by construction (group ids are table-ranged)."""
+    dn = lax.GatherDimensionNumbers(
+        offset_dims=(ix.ndim,),
+        collapsed_slice_dims=(0,),
+        start_index_map=(0,),
+    )
+    return lax.gather(
+        data, ix[..., None], dn, slice_sizes=(1, ncols),
+        mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+    )
+
+
+class NarrowTable(NamedTuple):
+    """Split-word counter table; a JAX pytree with ONE leaf."""
+
+    data: jnp.ndarray  # (N, 9) int64: KHI KLO META EXP INV LIMBUR DUR REM STM
+
+    @property
+    def num_slots(self) -> int:
+        return self.data.shape[-2]
+
+    # Wide-compatible host views (live_count, key pruning, ici sync
+    # fingerprint/merge seams). `...` indexing so they also work on a
+    # device-stacked (D, N, C) table (parallel/ici.py IciState).
+    @property
+    def used(self) -> jnp.ndarray:
+        return (self.data[..., META] & META_USED) != 0
+
+    @property
+    def key_hi(self) -> jnp.ndarray:
+        return self.data[..., KHI]
+
+    @property
+    def key_lo(self) -> jnp.ndarray:
+        return self.data[..., KLO]
+
+    @property
+    def expire_at(self) -> jnp.ndarray:
+        return self.data[..., EXP]
+
+    @property
+    def remaining(self) -> jnp.ndarray:
+        return self.data[..., REM]
+
+    @staticmethod
+    def create(num_groups: int, ways: int = 8) -> "NarrowTable":
+        return NarrowTable(
+            data=jnp.zeros((num_groups * ways, NCOLS), dtype=I64)
+        )
+
+
+@jax.jit
+def pack_table(wide: SlotTable) -> NarrowTable:
+    """Wide -> narrow conversion (canonical snapshot interop). Lossless
+    within the encode clamp contract: limit/burst must fit int32
+    (MAX_COUNT, the same contract ops/packed.py relies on); every other
+    column round-trips any int64 value exactly."""
+    cols = [None] * NCOLS
+    cols[KHI] = wide.key_hi
+    cols[KLO] = wide.key_lo
+    cols[META] = _pack_meta(wide.used, wide.algo, wide.status, wide.lru)
+    cols[EXP] = wide.expire_at
+    cols[INV] = wide.invalid_at
+    cols[LIMBUR] = _pack_limbur(wide.limit, wide.burst)
+    cols[DUR] = wide.duration
+    cols[REM] = wide.remaining
+    cols[STM] = wide.stamp
+    return NarrowTable(
+        data=jnp.stack([c.astype(I64) for c in cols], axis=-1)
+    )
+
+
+@jax.jit
+def unpack_table(narrow: NarrowTable) -> SlotTable:
+    d = narrow.data
+    meta = d[:, META]
+    limit, burst = _unpack_limbur(d[:, LIMBUR])
+    return SlotTable(
+        key_hi=d[:, KHI],
+        key_lo=d[:, KLO],
+        used=(meta & META_USED) != 0,
+        algo=((meta >> META_ALGO_SHIFT) & 1).astype(jnp.int8),
+        status=((meta >> META_STATUS_SHIFT) & 3).astype(jnp.int8),
+        limit=limit,
+        duration=d[:, DUR],
+        remaining=d[:, REM],
+        stamp=d[:, STM],
+        expire_at=d[:, EXP],
+        invalid_at=d[:, INV],
+        burst=burst,
+        lru=meta >> META_LRU_SHIFT,
+    )
+
+
+def _probe_hot(data, batch, now, ways: int):
+    """Gather each lane's (W, 5) hot-prefix block and run the shared
+    way-selection policy. Returns (grp_base, exists, matched_way,
+    insert_way, cat)."""
+    grp_base = batch.group.astype(I64) * ways
+    way_ix = grp_base[:, None] + jnp.arange(ways, dtype=I64)[None, :]
+    rows = _gather_cols(data, way_ix, N_HOT)  # (B, W, 5) — 40 B/way
+    exists, matched_way, insert_way, cat = probe_ways(
+        rows[..., KHI], rows[..., KLO], rows[..., META],
+        rows[..., EXP], rows[..., INV], batch, now,
+    )
+    return grp_base, exists, matched_way, insert_way, cat
+
+
+def _decide_narrow_impl(table: NarrowTable, batch: RequestBatch, now, *, ways: int):
+    now = jnp.asarray(now, dtype=I64)
+    data = table.data
+    n = data.shape[0]
+
+    grp_base, exists, matched_way, insert_way, cat = _probe_hot(
+        data, batch, now, ways
+    )
+    way = jnp.where(exists, matched_way, insert_way)
+    slot = grp_base + way
+    row = data[slot]  # (B, 9) — the chosen lane's FULL row, per lane only
+
+    pick = jax.vmap(lambda r, w: r[w])
+    sel = pick(cat, insert_way)
+    evicts_live = (~exists) & (sel == 3) & batch.active
+
+    old_used = (row[:, META] & META_USED) != 0
+    displaced = (
+        batch.active
+        & ~exists
+        & old_used
+        & (
+            (row[:, KHI] != batch.key_hi)
+            | (row[:, KLO] != batch.key_lo)
+        )
+    )
+    evicted_hi = jnp.where(displaced, row[:, KHI], 0)
+    evicted_lo = jnp.where(displaced, row[:, KLO], 0)
+
+    meta_sel = row[:, META]
+    limit_sel, burst_sel = _unpack_limbur(row[:, LIMBUR])
+    st = dict(
+        algo=((meta_sel >> META_ALGO_SHIFT) & 1).astype(jnp.int8),
+        status=((meta_sel >> META_STATUS_SHIFT) & 3).astype(jnp.int8),
+        limit=limit_sel,
+        duration=row[:, DUR],
+        remaining=row[:, REM],
+        stamp=row[:, STM],
+        expire_at=row[:, EXP],
+        burst=burst_sel,
+        invalid_at=row[:, INV],
+    )
+    for k in st:
+        st[k] = jnp.where(exists, st[k], jnp.zeros_like(st[k]))
+
+    bhv = batch.behavior
+    b_greg = (bhv & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    b_reset = (bhv & int(Behavior.RESET_REMAINING)) != 0
+    b_drain = (bhv & int(Behavior.DRAIN_OVER_LIMIT)) != 0
+
+    tok_state, tok_resp = _token_paths(batch, st, b_greg, b_reset, b_drain, exists, now)
+    lky_state, lky_resp = _leaky_paths(batch, st, b_greg, b_reset, b_drain, exists, now)
+
+    is_leaky = batch.algo == jnp.int8(Algorithm.LEAKY_BUCKET)
+
+    def both(t, l):
+        return jnp.where(is_leaky, l, t)
+
+    new_state = {k: both(tok_state[k], lky_state[k]) for k in tok_state}
+    resp = {k: both(tok_resp[k], lky_resp[k]) for k in tok_resp}
+
+    freed = ~new_state["used"]
+    cols = [None] * NCOLS
+    cols[KHI] = jnp.where(freed, 0, batch.key_hi)
+    cols[KLO] = jnp.where(freed, 0, batch.key_lo)
+    cols[META] = jnp.where(
+        freed,
+        0,
+        _pack_meta(
+            jnp.ones_like(freed),
+            batch.algo,
+            new_state["status"],
+            jnp.broadcast_to(now, freed.shape),
+        ),
+    )
+    cols[EXP] = new_state["expire_at"]
+    # The store's invalidation mark survives updates on a live entry
+    # (reference: algorithms never touch CacheItem.InvalidAt); fresh
+    # inserts and freed slots clear it.
+    cols[INV] = jnp.where(exists & ~freed, st["invalid_at"], 0)
+    cols[LIMBUR] = _pack_limbur(new_state["limit"], new_state["burst"])
+    cols[DUR] = new_state["duration"]
+    cols[REM] = new_state["remaining"]
+    cols[STM] = new_state["stamp"]
+    new_row = jnp.stack([c.astype(I64) for c in cols], axis=-1)  # (B, 9)
+
+    idx = jnp.where(batch.active, slot, n)
+    new_data = data.at[idx].set(new_row, mode="drop")  # the ONE scatter
+
+    act = batch.active
+    out = DecideOutput(
+        status=jnp.where(act, resp["status"], jnp.int8(0)),
+        limit=jnp.where(act, batch.limit, 0),
+        remaining=jnp.where(act, resp["remaining"], 0),
+        reset_time=jnp.where(act, resp["reset_time"], 0),
+        slot=idx,
+        evicted_hi=evicted_hi,
+        evicted_lo=evicted_lo,
+        freed=act & freed,
+        hits=jnp.sum(act & exists),
+        misses=jnp.sum(act & ~exists),
+        unexpired_evictions=jnp.sum(evicts_live),
+        over_limit=jnp.sum(act & resp["over"]),
+    )
+    return NarrowTable(data=new_data), out
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def decide_narrow(table: NarrowTable, batch: RequestBatch, now, ways: int = 8):
+    return _decide_narrow_impl(table, batch, now, ways=ways)
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def decide_scan_narrow(table: NarrowTable, batches: RequestBatch, nows, ways: int = 8):
+    def step(tbl, xs):
+        b, now = xs
+        tbl, out = _decide_narrow_impl(tbl, b, now, ways=ways)
+        return tbl, out
+
+    return jax.lax.scan(step, table, (batches, nows))
+
+
+@functools.partial(jax.jit, static_argnames=("ways",))
+def probe_exists_narrow(table: NarrowTable, key_hi, key_lo, group, now, ways: int = 8):
+    """Residency probe (store read-through seam): touches ONLY the hot
+    row prefix — 40 B/way, the cheapest probe of any layout."""
+    now = jnp.asarray(now, dtype=I64)
+    grp_base = group.astype(I64) * ways
+    way_ix = grp_base[:, None] + jnp.arange(ways, dtype=I64)[None, :]
+    rows = _gather_cols(table.data, way_ix, N_HOT)
+    w_meta = rows[..., META]
+    w_used = (w_meta & META_USED) != 0
+    w_invalid = rows[..., INV]
+    w_expired = w_used & (
+        (rows[..., EXP] < now) | ((w_invalid != 0) & (w_invalid < now))
+    )
+    live = (
+        w_used
+        & ~w_expired
+        & (rows[..., KHI] == key_hi[:, None])
+        & (rows[..., KLO] == key_lo[:, None])
+    )
+    return jnp.any(live, axis=1)
+
+
+@jax.jit
+def gather_rows_narrow(table: NarrowTable, slots) -> SlotTable:
+    """Post-decide row readback, expanded to the wide row struct so the
+    engine's store write-behind code is layout-agnostic."""
+    n = table.num_slots
+    safe = jnp.clip(slots, 0, n - 1)
+    valid = slots < n
+    d = jnp.where(valid[:, None], table.data[safe], 0)  # (B, 9)
+    meta = d[:, META]
+    limit, burst = _unpack_limbur(d[:, LIMBUR])
+    return SlotTable(
+        key_hi=d[:, KHI],
+        key_lo=d[:, KLO],
+        used=(meta & META_USED) != 0,
+        algo=((meta >> META_ALGO_SHIFT) & 1).astype(jnp.int8),
+        status=((meta >> META_STATUS_SHIFT) & 3).astype(jnp.int8),
+        limit=limit,
+        duration=d[:, DUR],
+        remaining=d[:, REM],
+        stamp=d[:, STM],
+        expire_at=d[:, EXP],
+        invalid_at=d[:, INV],
+        burst=burst,
+        lru=meta >> META_LRU_SHIFT,
+    )
+
+
+def _inject_narrow_impl(table: NarrowTable, items, now, ways: int):
+    now = jnp.asarray(now, dtype=I64)
+    data = table.data
+    n = data.shape[0]
+    batch_like = RequestBatch.zeros(items.key_hi.shape[0])._replace(
+        key_hi=items.key_hi,
+        key_lo=items.key_lo,
+        group=items.group,
+        active=items.active,
+    )
+    grp_base, exists, matched_way, insert_way, _cat = _probe_hot(
+        data, batch_like, now, ways
+    )
+    way = jnp.where(exists, matched_way, insert_way)
+    slot = grp_base + way
+    row = data[slot]
+    old_used = (row[:, META] & META_USED) != 0
+    displaced = (
+        items.active
+        & ~exists
+        & old_used
+        & (
+            (row[:, KHI] != items.key_hi)
+            | (row[:, KLO] != items.key_lo)
+        )
+    )
+    evicted_hi = jnp.where(displaced, row[:, KHI], 0)
+    evicted_lo = jnp.where(displaced, row[:, KLO], 0)
+
+    cols = [None] * NCOLS
+    cols[KHI] = items.key_hi
+    cols[KLO] = items.key_lo
+    cols[META] = _pack_meta(
+        jnp.ones_like(items.active),
+        items.algo,
+        items.status,
+        jnp.broadcast_to(now, items.key_hi.shape),
+    )
+    cols[EXP] = items.expire_at
+    cols[INV] = items.invalid_at
+    cols[LIMBUR] = _pack_limbur(items.limit, items.burst)
+    cols[DUR] = items.duration
+    cols[REM] = items.remaining
+    cols[STM] = items.stamp
+    new_row = jnp.stack([c.astype(I64) for c in cols], axis=-1)
+
+    idx = jnp.where(items.active, slot, n)
+    return (
+        NarrowTable(data=data.at[idx].set(new_row, mode="drop")),
+        evicted_hi,
+        evicted_lo,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def inject_narrow(table: NarrowTable, items, now, ways: int = 8):
+    return _inject_narrow_impl(table, items, now, ways)

@@ -47,6 +47,7 @@ from gubernator_tpu.api.types import Behavior
 from gubernator_tpu.models.bucket import FIXED_SHIFT
 from gubernator_tpu.ops.kernels import get_raw_kernels
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
+from gubernator_tpu.utils.jaxcompat import shard_map
 
 AXIS = "owners"
 I64 = jnp.int64
@@ -162,7 +163,7 @@ def make_replica_decide(
             out,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(AXIS), P(), P(), P()),
@@ -217,7 +218,7 @@ def make_replica_decide_scan(
             outs,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(AXIS), P(), P(), P()),
@@ -264,7 +265,7 @@ def make_inject_replicas(
             table=_unsqueeze(tbl), pending=pending[None], tick=state.tick
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh, in_specs=(P(AXIS), P(), P()), out_specs=P(AXIS)
     )
 
@@ -679,7 +680,7 @@ def make_sync_step(
             diag,
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh, in_specs=(P(AXIS), P()),
         out_specs=(P(AXIS), P(AXIS)),
     )

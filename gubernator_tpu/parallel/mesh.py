@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.ops.kernels import get_raw_kernels
 from gubernator_tpu.ops.layout import DecideOutput, RequestBatch, SlotTable
+from gubernator_tpu.utils.jaxcompat import shard_map
 
 AXIS = "owners"
 
@@ -75,7 +76,7 @@ def make_sharded_decide(
         out = jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
         return table, out
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_decide,
         mesh=mesh,
         in_specs=(P(AXIS), P(), P()),

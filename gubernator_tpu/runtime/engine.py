@@ -73,9 +73,11 @@ class EngineConfig:
     fast_buckets: bool = False
     device: Optional[object] = None  # jax device for the table
     # Table layout: "wide" (one int64 column per field), "packed"
-    # (narrowed columns, 3-gather probe), or "fused" (one (N, C) tensor,
-    # one gather + one scatter — fastest at scale, see ops/fused.py).
-    # All are oracle-exact; Loader snapshots are portable across them.
+    # (narrowed columns, 3-gather probe), "fused" (one (N, C) tensor,
+    # one gather + one scatter, see ops/fused.py), or "narrow" (fused
+    # v2: probe reads a 5-column row prefix, half the probe DMA — see
+    # ops/narrow.py). All are oracle-exact; Loader snapshots are
+    # portable across them (ops/kernels.py LAYOUTS).
     layout: str = "fused"
 
 
@@ -392,6 +394,12 @@ class DeviceEngine(EngineBase):
         warm.join(timeout=timeout_s)
         return not warm.is_alive()
 
+    # Scratch-table budget for the bucket-warm ladder: beyond this the
+    # throwaway compile copy is skipped and only batch_size stays warm —
+    # a single-request flush then pays one batch_size-wide dispatch, a
+    # LATENCY cost, never a JIT stall (tests/test_engine.py pins this).
+    _WARM_TABLE_BUDGET = 512 << 20
+
     def _warm_buckets(self) -> None:
         """Compile decide at each power-of-two width below batch_size
         against a THROWAWAY table of the same shape — never the live one:
@@ -402,9 +410,11 @@ class DeviceEngine(EngineBase):
         cfg = self.cfg
         # A second table is transient compile fodder; skip bucket warming
         # when that copy would be expensive (huge HBM tables) — the
-        # always-warm batch_size shape still serves the fast path.
-        approx_bytes = cfg.num_groups * cfg.ways * 88
-        if approx_bytes > 512 << 20:
+        # always-warm batch_size shape still serves the fast path. Sized
+        # by the LAYOUT's resident bytes/slot (a narrow table crosses
+        # the threshold later than a wide one).
+        approx_bytes = cfg.num_groups * cfg.ways * self.K.bytes_per_slot
+        if approx_bytes > self._WARM_TABLE_BUDGET:
             return
         shapes = []
         b = 128

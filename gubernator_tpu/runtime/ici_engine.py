@@ -72,8 +72,10 @@ class IciEngineConfig:
     max_flush_items: int = 8192
     max_waves: int = 32  # per-flush wave cap; overflow carries over
     sync_wait_s: float = 0.1  # GLOBAL sync cadence (reference 100ms)
-    # Table layout for BOTH the sharded and replica tiers (ops/kernels.py);
-    # fused is the TPU production layout (VERDICT r4 item 2).
+    # Table layout for BOTH the sharded and replica tiers (the
+    # ops/kernels.py LAYOUTS registry; "narrow" halves probe DMA at
+    # large tables); fused is the TPU production layout (VERDICT r4
+    # item 2).
     layout: str = "fused"
     # Per-tick sync work cap (groups). The tick merges only groups whose
     # content diverges across replicas or that hold pending deltas, up

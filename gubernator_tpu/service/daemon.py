@@ -158,9 +158,9 @@ class Daemon:
             app = build_app(self.svc)
             self.http_runner = web.AppRunner(app)
             await self.http_runner.setup()
-            # ":80" binds all interfaces Go-style; "" disables the
-            # listener entirely (GUBER_HTTP_ADDRESS= in the environment
-            # previously crashed spawn with an unpack error).
+            # ":80" binds all interfaces (every family) Go-style; ""
+            # disables the listener entirely (GUBER_HTTP_ADDRESS= in the
+            # environment previously crashed spawn with an unpack error).
             hhost, hport = net.parse_listen_address(conf.http_listen_address)
             ssl_ctx = None
             if conf.tls is not None:
@@ -172,7 +172,9 @@ class Daemon:
             )
             await site.start()
             actual = site._server.sockets[0].getsockname()
-            self.http_address = f"{hhost}:{actual[1]}"
+            # Recorded address must be dialable: wildcard/all-interfaces
+            # binds expand to a concrete interface IP (ADVICE r5).
+            self.http_address = net.recorded_address(hhost, actual[1])
 
         # Optional health-only listener that never requests a client cert
         # (reference daemon.go:305-333): lets load balancers probe
@@ -198,7 +200,7 @@ class Daemon:
             )
             await ssite.start()
             sactual = ssite._server.sockets[0].getsockname()
-            self.status_address = f"{shost}:{sactual[1]}"
+            self.status_address = net.recorded_address(shost, sactual[1])
 
         # Edge-tier listener: gubernator-tpu-edge processes relay client
         # calls here over framed RPC (service/edge.py) — same serving

@@ -198,11 +198,17 @@ class GossipPool:
     liveness or clear its tombstone, and forged suspect/dead gossip can
     evict a live peer until it refutes. Set `secret` (all nodes must
     share it — the memberlist-SecretKey analog) to authenticate every
-    datagram with HMAC-SHA256: sends are prefixed with a 16-byte tag and
-    unauthenticated receives are dropped before parsing. Note HMAC
-    authenticates but does NOT encrypt (memberlist's SecretKey also
-    encrypts); membership views are still readable on the wire. Use the
-    etcd/k8s/DNS backends where the network is not trusted at all.
+    datagram with HMAC-SHA256: sends are prefixed with a 16-byte tag
+    over a signed wall-clock timestamp + payload, and receives that are
+    unauthenticated OR outside the replay window (`replay_window_s`,
+    default a handful of gossip intervals) are dropped before parsing —
+    a captured datagram cannot be replayed later to refresh a dead
+    peer's liveness or resurrect stale suspicion. Authenticated nodes
+    need loosely synchronized clocks (NTP-grade skew is far inside the
+    window). Note HMAC authenticates but does NOT encrypt (memberlist's
+    SecretKey also encrypts); membership views are still readable on the
+    wire. Use the etcd/k8s/DNS backends where the network is not trusted
+    at all.
 
     Each node carries its own PeerInfo in its gossip state and
     periodically sends its full membership view (JSON datagram) to a few
@@ -243,6 +249,7 @@ class GossipPool:
         indirect_probes: int = 3,
         tombstone_intervals: int = 10,
         secret: "str | bytes" = b"",  # shared HMAC key; b"" = unauthenticated
+        replay_window_s: float = 0.0,  # 0 = derive from the gossip interval
     ):
         import json as _json
         import random as _random
@@ -250,6 +257,10 @@ class GossipPool:
         self._json = _json
         self._random = _random
         self._secret = secret.encode() if isinstance(secret, str) else secret
+        # Authenticated datagrams older (or newer) than this are dropped
+        # as replays; sized in gossip intervals so slower cadences keep
+        # proportional tolerance, floored at 10s for clock skew.
+        self.replay_window_s = replay_window_s or max(10.0, 10 * interval_s)
         self.bind = bind
         self.advertise = advertise
         self.info = info
@@ -345,24 +356,35 @@ class GossipPool:
         return self._json.dumps({"from": self.advertise, "peers": peers}).encode()
 
     _TAG_LEN = 16  # truncated HMAC-SHA256, memberlist-style overhead
+    _TS_LEN = 8  # big-endian wall-clock ms INSIDE the signed bytes
 
     def _sign(self, payload: bytes) -> bytes:
         import hmac as _hmac
+        import time as _time
 
-        tag = _hmac.new(self._secret, payload, "sha256").digest()
-        return tag[: self._TAG_LEN] + payload
+        # The timestamp is covered by the tag: an attacker without the
+        # key can neither forge a fresh one nor refresh a captured
+        # datagram's — replays age out of the window.
+        ts = int(_time.time() * 1000).to_bytes(self._TS_LEN, "big")
+        tag = _hmac.new(self._secret, ts + payload, "sha256").digest()
+        return tag[: self._TAG_LEN] + ts + payload
 
     def _authenticate(self, data: bytes) -> "bytes | None":
-        """Strip + verify the tag; None = drop (forged/unauthenticated)."""
+        """Strip + verify tag and freshness; None = drop (forged,
+        unauthenticated, or replayed outside the window)."""
         import hmac as _hmac
+        import time as _time
 
-        if len(data) <= self._TAG_LEN:
+        if len(data) <= self._TAG_LEN + self._TS_LEN:
             return None
-        tag, payload = data[: self._TAG_LEN], data[self._TAG_LEN:]
-        want = _hmac.new(self._secret, payload, "sha256").digest()
+        tag, signed = data[: self._TAG_LEN], data[self._TAG_LEN:]
+        want = _hmac.new(self._secret, signed, "sha256").digest()
         if not _hmac.compare_digest(tag, want[: self._TAG_LEN]):
             return None
-        return payload
+        ts = int.from_bytes(signed[: self._TS_LEN], "big")
+        if abs(_time.time() * 1000 - ts) > self.replay_window_s * 1000:
+            return None  # stale capture (or hopeless clock skew): drop
+        return signed[self._TS_LEN:]
 
     def _sendto(self, payload: bytes, addr: str) -> None:
         try:

@@ -110,6 +110,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         advertise_address=_env("GUBER_ADVERTISE_ADDRESS", ""),
         data_center=_env("GUBER_DATA_CENTER", ""),
         cache_size=_env_int("GUBER_CACHE_SIZE", 50_000),
+        table_layout=_env("GUBER_TABLE_LAYOUT", "fused"),
         behaviors=behaviors,
         global_mode=_env("GUBER_GLOBAL_MODE", "grpc"),
         grpc_max_conn_age_s=float(_env_int("GUBER_GRPC_MAX_CONN_AGE_SEC", 0)),
@@ -126,6 +127,22 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         prewarm_buckets=_env_bool("GUBER_PREWARM_BUCKETS"),
         prewarm_timeout_s=parse_duration_s(_env("GUBER_PREWARM_TIMEOUT"), 600.0),
     )
+
+    # Table layouts validate EARLY against the one registry
+    # (ops/kernels.py) so a typo'd GUBER_TABLE_LAYOUT / GUBER_ICI_LAYOUT
+    # fails at config time, not at first engine construction.
+    from gubernator_tpu.ops.kernels import LAYOUTS
+
+    if conf.table_layout not in LAYOUTS:
+        raise ValueError(
+            f"'GUBER_TABLE_LAYOUT={conf.table_layout}' is invalid; "
+            f"choices are {list(LAYOUTS)}"
+        )
+    if conf.ici is not None and conf.ici.layout not in LAYOUTS:
+        raise ValueError(
+            f"'GUBER_ICI_LAYOUT={conf.ici.layout}' is invalid; "
+            f"choices are {list(LAYOUTS)}"
+        )
 
     # ICI-mode sizing (GUBER_GLOBAL_MODE=ici): the replica table must be
     # sized so live GLOBAL keys per group stay <= replica ways, or keys
@@ -153,7 +170,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             sync_wait_s=behaviors.global_sync_wait_s,
             batch_wait_s=behaviors.batch_wait_s,
             batch_limit=behaviors.batch_limit,
-            layout=_env("GUBER_ICI_LAYOUT", base.layout),
+            layout=_env("GUBER_ICI_LAYOUT", base.layout),  # LAYOUTS-validated below
             # 0 = unbounded (merge the full table every tick)
             max_sync_groups=(
                 _env_int("GUBER_ICI_SYNC_GROUPS", base.max_sync_groups or 0)

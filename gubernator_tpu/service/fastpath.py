@@ -10,9 +10,11 @@ object path (equivalence is fuzz-tested in tests/test_fastpath.py).
 
 Fallback triggers:
 - native library unavailable, malformed/empty/oversized batch;
-- any item carrying metadata (trace context), GLOBAL or
-  DURATION_IS_GREGORIAN behaviors, or failing validation (those need
-  per-item error strings);
+- any item carrying metadata (trace context) or failing validation
+  (those need per-item error strings);
+- DURATION_IS_GREGORIAN items on a peer call or an all-Gregorian batch
+  (V1 mixed batches keep the columnar lanes and splice the Gregorian
+  items through the object path, like GLOBAL's round-5 lane split);
 - a key this node does not own (peer forwarding), checked with the
   vectorized ring mask — GetPeerRateLimits skips this check because
   forwarded items are owned by construction;
@@ -45,7 +47,8 @@ def _committed_error():
     return TableCommittedError
 
 # Gregorian durations need host-side calendar math the columnar decide
-# doesn't carry — the only behavior still pinned to the object path.
+# doesn't carry — those ITEMS are pinned to the object path (via the
+# mixed splice on V1 calls; whole-batch fallback on peer calls).
 _SLOW_BEHAVIOR = int(Behavior.DURATION_IS_GREGORIAN)
 _GLOBAL = int(Behavior.GLOBAL)
 _DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
@@ -102,7 +105,14 @@ def try_serve(svc, data: bytes, peer_call: bool):
         return None
     if cols.slow.any():
         return None
-    if np.any((cols.behavior & _SLOW_BEHAVIOR) != 0):
+    # DURATION_IS_GREGORIAN needs host-side calendar math the columnar
+    # decide doesn't carry — but those ITEMS ride the mixed return's
+    # object-path lane (the same split GLOBAL lanes got in round 5)
+    # instead of demoting the whole batch. Peer calls cannot return
+    # "mixed", and an all-Gregorian batch has no columnar work left.
+    greg = (cols.behavior & _SLOW_BEHAVIOR) != 0
+    has_greg = bool(greg.any())
+    if has_greg and (peer_call or bool(greg.all())):
         return None
     if not peer_call and getattr(svc, "force_global", False):
         # GUBER_FORCE_GLOBAL: every V1 item becomes GLOBAL (the same OR
@@ -153,6 +163,11 @@ def try_serve(svc, data: bytes, peer_call: bool):
                 serve = mask
             if not serve.all():
                 local = serve
+    if has_greg:
+        # Gregorian lanes leave the columnar set and come back spliced
+        # through merge_mixed, decided by the object path.
+        base = local if local is not None else np.ones(cols.n, dtype=bool)
+        local = base & ~greg
     # MULTI_REGION: the in-region owner's apply queues the cross-region
     # leg (server.py observe call sites). V1 owned items qualify (the
     # non-owned forward and observe at their in-region owner); peer-call
@@ -162,6 +177,11 @@ def try_serve(svc, data: bytes, peer_call: bool):
     mr_queue = []
     if bool(mr_mask.any()) and svc.region_mgr is not None:
         mr_owned = mr_mask if ring_mask is None else (mr_mask & ring_mask)
+        if has_greg:
+            # Gregorian lanes decide through svc.get_rate_limits, which
+            # observes its own cross-region leg (server.py) — queueing
+            # here too would double-replicate.
+            mr_owned = mr_owned & ~greg
         q = mr_owned & (
             (cols.hits != 0) | ((cols.behavior & _RESET) != 0)
         )
@@ -185,18 +205,26 @@ def try_serve(svc, data: bytes, peer_call: bool):
         # nothing, matching GlobalManager's own gate). Objects are built
         # up front so a failed construction falls back BEFORE any table
         # commit.
+        # Gregorian GLOBAL lanes replicate through the object path they
+        # decide on (svc.get_rate_limits queues their legs) — queueing
+        # them here too would double-count the hit at the owner.
         g_queue = [
             (bool(g_owned[i]), _req_from_columns(cols, int(i)))
-            for i in np.nonzero(g_mask & (cols.hits != 0))[0]
+            for i in np.nonzero(g_mask & ~greg & (cols.hits != 0))[0]
         ]
         for _, req in g_queue:
             if req.created_at is None:
                 req.created_at = now
         # The standard engine expects GLOBAL stripped (the daemon's
         # global manager owns replication) — same conditional strip the
-        # object path does (server.py).
+        # object path does (server.py). Gregorian lanes keep the bit:
+        # they never reach the columnar engine, and their object-path
+        # request must still carry it.
         if strip_global:
-            cols.behavior = cols.behavior & ~np.int64(_GLOBAL)
+            stripped = cols.behavior & ~np.int64(_GLOBAL)
+            cols.behavior = (
+                np.where(greg, cols.behavior, stripped) if has_greg else stripped
+            )
 
     def queue_legs():
         # try_serve runs on the serving executor; the managers' queues

@@ -1,0 +1,18 @@
+"""Version compatibility shims for the JAX API surface.
+
+The multi-device tier uses shard_map, which graduated from
+jax.experimental.shard_map to the top-level jax namespace in newer
+releases. Resolve it once here so every call site works on both without
+per-module try/except drift.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x: experimental namespace only
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]

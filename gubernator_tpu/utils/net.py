@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import socket
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 
 def split_host_port(address: str) -> Tuple[str, int]:
@@ -11,19 +11,34 @@ def split_host_port(address: str) -> Tuple[str, int]:
     return host, int(port)
 
 
-def parse_listen_address(address: str) -> Tuple[str, int]:
+def parse_listen_address(address: str) -> Tuple[Optional[str], int]:
     """`[host]:port` -> (bind host, port) for a TCP listener.
 
-    Go-style: an empty host (":8080") means all interfaces; bracketed
-    IPv6 hosts are unwrapped. One shared parser so every listener site
-    (daemon HTTP, status HTTP, edge HTTP) agrees on the format instead
-    of hand-rolling rsplit variants that drift."""
+    Go-style: an empty host (":8080") means ALL interfaces — returned as
+    None, the asyncio/aiohttp spelling that binds every address family
+    (the old "0.0.0.0" mapping silently dropped IPv6, contradicting the
+    Go semantics it claimed). Bracketed IPv6 hosts are unwrapped. One
+    shared parser so every listener site (daemon HTTP, status HTTP, edge
+    HTTP) agrees on the format instead of hand-rolling rsplit variants
+    that drift. Pair with recorded_address() for the address a daemon
+    records/advertises for the bound listener."""
     host, _, port_s = address.rpartition(":")
     if not port_s.isdigit():
         raise ValueError(
             f"listen address must be [host]:port, got {address!r}"
         )
-    return (host.strip("[]") or "0.0.0.0"), int(port_s)
+    return (host.strip("[]") or None), int(port_s)
+
+
+def recorded_address(host: Optional[str], port: int) -> str:
+    """Dialable `host:port` to record/advertise for a listener bound at
+    (host, port): the all-interfaces bind (None) and wildcard hosts
+    expand to a concrete interface IP (a recorded "0.0.0.0:81" is not
+    dialable from anywhere); a real hostname/IP is kept verbatim so
+    DNS names survive into the recorded address."""
+    if host in (None, "", "0.0.0.0", "::"):
+        return f"{discover_ip()}:{port}"
+    return f"{host}:{port}"
 
 
 def discover_ip() -> str:

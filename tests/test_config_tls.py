@@ -1,6 +1,7 @@
 """Env config parsing (reference config_test.go style) and TLS clusters
 (reference tls_test.go:73-343 style)."""
 
+import importlib.util
 import os
 
 import pytest
@@ -68,6 +69,10 @@ def shared_tls():
     )
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="cryptography not installed (auto-TLS cert generation)",
+)
 def test_tls_cluster_end_to_end(loop_thread):
     """mTLS daemons: client and peer-to-peer forwarding both ride TLS."""
     tls = shared_tls()

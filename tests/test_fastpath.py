@@ -761,3 +761,172 @@ def test_columns_adversarial_domain(seed):
     finally:
         eng_a.close()
         eng_b.close()
+
+
+def _mk_fast_svc(engine):
+    """Minimal V1Service stand-in for fastpath.try_serve (standalone
+    daemon: no picker/managers — owner of everything)."""
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        engine=engine, picker=None, region_mgr=None, global_mgr=None,
+        fast_edge=True,
+    )
+
+
+def test_gregorian_lane_split_mixed_batch():
+    """DURATION_IS_GREGORIAN items no longer demote the whole batch:
+    plain lanes decide columnar and the Gregorian items come back as
+    object-path requests through the mixed return, splicing in request
+    order (the round-5 GLOBAL lane-split pattern)."""
+    from gubernator_tpu.service import fastpath
+    from gubernator_tpu.utils import gregorian as g
+
+    clock = {"now": NOW}
+    eng_a = mk_engine(clock)
+    eng_b = mk_engine(clock)
+    svc = _mk_fast_svc(eng_a)
+    GREG = int(Behavior.DURATION_IS_GREGORIAN)
+    try:
+        batch = []
+        for i in range(14):
+            if i % 3 == 1:
+                batch.append(
+                    RateLimitReq(
+                        name="greg", unique_key=f"g{i}", behavior=GREG,
+                        duration=g.GREGORIAN_HOURS, limit=50, hits=2,
+                    )
+                )
+            else:
+                batch.append(
+                    RateLimitReq(
+                        name="fp", unique_key=f"k{i % 4}",
+                        duration=60_000, limit=50, hits=1,
+                    )
+                )
+        res = fastpath.try_serve(svc, to_proto_bytes(batch), False)
+        assert isinstance(res, tuple) and res[0] == "mixed"
+        _tag, n, local_pos, local_out, nl_reqs, md = res
+        greg_pos = [i for i, r in enumerate(batch) if r.behavior & GREG]
+        assert sorted(set(range(n)) - set(int(i) for i in local_pos)) == greg_pos
+        # Object-path requests keep their behavior bits intact.
+        assert all(r.behavior & GREG for r in nl_reqs)
+        nl_resps = eng_a.check_batch(nl_reqs)  # the async caller's leg
+        raw = fastpath.merge_mixed(n, local_pos, local_out, nl_resps, md)
+        out = pb.pb.GetRateLimitsResp.FromString(raw)
+        assert len(out.responses) == n
+        want = eng_b.check_batch([dataclasses.replace(r) for r in batch])
+        for i, (got, w) in enumerate(zip(out.responses, want)):
+            assert (got.status, got.limit, got.remaining, got.reset_time) == (
+                int(w.status), w.limit, w.remaining, w.reset_time,
+            ), (i, batch[i])
+    finally:
+        eng_a.close()
+        eng_b.close()
+
+
+def test_gregorian_only_and_peer_batches_fall_back():
+    """All-Gregorian batches have no columnar work; peer calls cannot
+    return 'mixed' — both must take the whole-batch object path."""
+    from gubernator_tpu.service import fastpath
+    from gubernator_tpu.utils import gregorian as g
+
+    clock = {"now": NOW}
+    eng = mk_engine(clock)
+    svc = _mk_fast_svc(eng)
+    GREG = int(Behavior.DURATION_IS_GREGORIAN)
+    try:
+        greg = [
+            RateLimitReq(
+                name="greg", unique_key=f"g{i}", behavior=GREG,
+                duration=g.GREGORIAN_DAYS, limit=5, hits=1,
+            )
+            for i in range(4)
+        ]
+        assert fastpath.try_serve(svc, to_proto_bytes(greg), False) is None
+        mixed = greg + [
+            RateLimitReq(name="fp", unique_key="p", duration=60_000, limit=5)
+        ]
+        assert fastpath.try_serve(svc, to_proto_bytes(mixed), True) is None
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_mixed_gregorian_fuzz(seed):
+    """Fuzz the Gregorian lane split: random batches mixing plain and
+    Gregorian items (distinct key spaces per lane, like real traffic)
+    must decide identically to a pure object-path engine after the
+    mixed-return splice."""
+    from gubernator_tpu.service import fastpath
+    from gubernator_tpu.utils import gregorian as g
+
+    rng = random.Random(seed)
+    clock = {"now": NOW}
+    eng_a = mk_engine(clock)
+    eng_b = mk_engine(clock)
+    svc = _mk_fast_svc(eng_a)
+    GREG = int(Behavior.DURATION_IS_GREGORIAN)
+    try:
+        for step in range(25):
+            if rng.random() < 0.2:
+                clock["now"] += rng.choice([5, 700, 70_000])
+            batch = []
+            for _ in range(rng.randint(2, 24)):
+                if rng.random() < 0.3:
+                    batch.append(
+                        RateLimitReq(
+                            name="greg", unique_key=f"g{rng.randint(0, 5)}",
+                            behavior=GREG,
+                            duration=rng.choice(
+                                [g.GREGORIAN_MINUTES, g.GREGORIAN_HOURS,
+                                 g.GREGORIAN_DAYS]
+                            ),
+                            limit=rng.choice([3, 10, 50]),
+                            hits=rng.choice([0, 1, 2]),
+                        )
+                    )
+                else:
+                    batch.append(
+                        RateLimitReq(
+                            name="fp", unique_key=f"k{rng.randint(0, 7)}",
+                            algorithm=rng.choice(
+                                [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                            ),
+                            duration=rng.choice([100, 60_000]),
+                            limit=rng.choice([3, 10, 50]),
+                            hits=rng.choice([0, 1, 2, 5]),
+                        )
+                    )
+            res = fastpath.try_serve(svc, to_proto_bytes(batch), False)
+            want = eng_b.check_batch([dataclasses.replace(r) for r in batch])
+            if res is None:
+                # all-Gregorian batch: the daemon's object path serves it
+                assert all(r.behavior & GREG for r in batch)
+                got = eng_a.check_batch([dataclasses.replace(r) for r in batch])
+                rows = [
+                    (int(r.status), r.limit, r.remaining, r.reset_time)
+                    for r in got
+                ]
+            else:
+                if isinstance(res, bytes):
+                    assert not any(r.behavior & GREG for r in batch)
+                    raw = res
+                else:
+                    _tag, n, local_pos, local_out, nl_reqs, md = res
+                    nl_resps = eng_a.check_batch(nl_reqs)
+                    raw = fastpath.merge_mixed(
+                        n, local_pos, local_out, nl_resps, md
+                    )
+                out = pb.pb.GetRateLimitsResp.FromString(raw)
+                rows = [
+                    (r.status, r.limit, r.remaining, r.reset_time)
+                    for r in out.responses
+                ]
+            for i, w in enumerate(want):
+                assert rows[i] == (
+                    int(w.status), w.limit, w.remaining, w.reset_time,
+                ), (f"seed {seed} step {step} item {i}: {batch[i]}")
+    finally:
+        eng_a.close()
+        eng_b.close()

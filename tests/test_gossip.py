@@ -311,3 +311,51 @@ def test_swim_suspicion_refuted_by_live_peer(loop_thread):
         return True
 
     assert loop_thread.run(run(), timeout=30)
+
+
+def test_gossip_replay_protection(loop_thread):
+    """The signed payload carries a wall-clock timestamp covered by the
+    HMAC tag: a captured datagram replayed outside the window is dropped
+    pre-parse, and the timestamp cannot be refreshed without the key."""
+    from unittest import mock
+
+    async def run():
+        p = GossipPool(
+            "127.0.0.1:0",
+            PeerInfo(grpc_address="r0:81"),
+            lambda peers: None,
+            interval_s=0.05,
+            secret="swordfish",
+            replay_window_s=5.0,
+        )
+        await p._started
+        try:
+            payload = b'{"from": "x:1", "peers": {}}'
+            fresh = p._sign(payload)
+            assert p._authenticate(fresh) == payload
+
+            # A capture whose signing clock is outside the window — in
+            # either direction — is dropped.
+            for skew in (-60.0, 60.0):
+                real = time.time()
+                with mock.patch("time.time", return_value=real + skew):
+                    stale = p._sign(payload)
+                assert p._authenticate(stale) is None, skew
+
+            # NTP-grade skew stays inside the window.
+            real = time.time()
+            with mock.patch("time.time", return_value=real - 1.0):
+                near = p._sign(payload)
+            assert p._authenticate(near) == payload
+
+            # Refreshing a stale capture's timestamp without the key
+            # breaks the tag: still dropped (as a forgery).
+            with mock.patch("time.time", return_value=time.time() - 60):
+                old = p._sign(payload)
+            now_ts = int(time.time() * 1000).to_bytes(p._TS_LEN, "big")
+            refreshed = old[: p._TAG_LEN] + now_ts + old[p._TAG_LEN + p._TS_LEN:]
+            assert p._authenticate(refreshed) is None
+        finally:
+            p.close()
+
+    loop_thread.run(run(), timeout=30)

@@ -377,3 +377,11 @@ def test_capped_sync_matches_full(seed, ways):
 @pytest.mark.parametrize("seed,ways", [(1, 1), (2, 4)])
 def test_ici_sync_matches_model_wide(seed, ways):
     _run_fuzz(seed, num_slots=NDEV * 8, ways=ways, layout="wide")
+
+
+# The narrow (split-word) layout runs the replica decide layout-native
+# and crosses the to_wide/from_wide seam every sync tick — the packed
+# LIMBUR word must survive the psum merge bit-exactly (ops/narrow.py).
+@pytest.mark.parametrize("seed,ways", [(3, 1), (4, 4)])
+def test_ici_sync_matches_model_narrow(seed, ways):
+    _run_fuzz(seed, num_slots=NDEV * 8, ways=ways, layout="narrow")

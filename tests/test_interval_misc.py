@@ -140,3 +140,33 @@ def test_trace_level_gating():
             assert s is None
     finally:
         tracing.set_trace_level("INFO")
+
+
+def test_parse_listen_address_all_families():
+    """An empty host means ALL interfaces — returned as None (the
+    asyncio/aiohttp spelling that binds every address family; the old
+    "0.0.0.0" mapping silently dropped IPv6)."""
+    from gubernator_tpu.utils.net import parse_listen_address
+
+    assert parse_listen_address("1.2.3.4:80") == ("1.2.3.4", 80)
+    assert parse_listen_address("[::1]:8080") == ("::1", 8080)
+    assert parse_listen_address("myhost.example:81") == ("myhost.example", 81)
+    assert parse_listen_address(":8080") == (None, 8080)
+    with pytest.raises(ValueError):
+        parse_listen_address("noport")
+    with pytest.raises(ValueError):
+        parse_listen_address("host:")
+
+
+def test_recorded_address_is_dialable():
+    """The address a daemon records for a bound listener must be
+    dialable: wildcard/all-interfaces binds expand to a concrete
+    interface IP; real hostnames are kept verbatim (DNS names survive)."""
+    from gubernator_tpu.utils.net import recorded_address
+
+    assert recorded_address("myhost.example", 81) == "myhost.example:81"
+    assert recorded_address("10.1.2.3", 81) == "10.1.2.3:81"
+    for bind in (None, "", "0.0.0.0", "::"):
+        host, port = recorded_address(bind, 82).rsplit(":", 1)
+        assert port == "82"
+        assert host not in ("", "None", "0.0.0.0", "::"), bind

@@ -26,9 +26,11 @@ NOW = 1_753_700_000_000
 NUM_GROUPS = 512
 WAYS = 8
 
-# Every golden/fuzz case runs against BOTH table layouts (see
-# ops/kernels.py); they must be bit-exact twins of the oracle.
-LAYOUTS = ["wide", "packed", "fused"]
+# Every golden/fuzz case runs against ALL table layouts (the
+# ops/kernels.py registry); they must be bit-exact twins of the oracle.
+from gubernator_tpu.ops.kernels import LAYOUTS  # noqa: E402
+
+LAYOUTS = list(LAYOUTS)
 
 
 class KernelHarness:

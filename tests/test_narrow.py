@@ -1,0 +1,198 @@
+"""Narrow (fused v2) layout: lossless narrow<->wide conversion within
+the encode clamp contract, snapshot portability across layouts, engine
+serving on the narrow table, and the bytes/slot registry contract.
+
+Branch semantics are covered by the full differential suite
+(tests/test_kernel_fuzz.py runs every golden/fuzz case per layout);
+this file pins the conversion/interop seams the fuzz doesn't reach.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq, Status
+from gubernator_tpu.models.bucket import MAX_COUNT
+from gubernator_tpu.ops import narrow
+from gubernator_tpu.ops.kernels import BYTES_PER_SLOT, LAYOUTS, get_kernels
+from gubernator_tpu.ops.layout import SlotTable
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+NOW = 1_753_700_000_000
+
+I64_MIN = -(1 << 63)
+I64_MAX = (1 << 63) - 1
+
+
+def test_split64_join64_exact_at_extremes():
+    vals = np.array(
+        [
+            0, 1, -1, 2, -2,
+            I64_MAX, I64_MIN, I64_MIN + 1,
+            0xFFFFFFFF, -0xFFFFFFFF, 1 << 32, -(1 << 32),
+            (1 << 32) + 1, -((1 << 32) + 1),
+            NOW, -NOW, (123 << 20) + 456789,  # leaky Q44.20 shapes
+        ],
+        dtype=np.int64,
+    )
+    lo, hi = narrow._split64(jnp.asarray(vals))
+    assert lo.dtype == jnp.int32 and hi.dtype == jnp.int32
+    back = np.asarray(narrow._join64(lo, hi))
+    np.testing.assert_array_equal(back, vals)
+
+
+def _random_wide(rng, n, *, all_used=False) -> SlotTable:
+    def i64(lo, hi):
+        return jnp.asarray(rng.integers(lo, hi, n, dtype=np.int64))
+
+    return SlotTable(
+        key_hi=i64(I64_MIN, I64_MAX),
+        key_lo=i64(I64_MIN, I64_MAX),
+        used=jnp.asarray(
+            np.ones(n, bool) if all_used else rng.integers(0, 2, n).astype(bool)
+        ),
+        algo=jnp.asarray(rng.integers(0, 2, n, dtype=np.int64).astype(np.int8)),
+        status=jnp.asarray(rng.integers(0, 3, n, dtype=np.int64).astype(np.int8)),
+        # limit/burst carry the documented int32 clamp contract
+        # (MAX_COUNT, models/bucket.py) — the same contract ops/packed.py
+        # already relies on; every other column is arbitrary int64.
+        limit=i64(-MAX_COUNT, MAX_COUNT + 1),
+        duration=i64(I64_MIN, I64_MAX),
+        remaining=i64(I64_MIN, I64_MAX),
+        stamp=i64(I64_MIN, I64_MAX),
+        expire_at=i64(I64_MIN, I64_MAX),
+        invalid_at=i64(I64_MIN, I64_MAX),
+        burst=i64(0, MAX_COUNT + 1),
+        lru=i64(0, 1 << 44),  # epoch-ms domain (meta packs lru << 4)
+    )
+
+
+def test_pack_unpack_round_trips_losslessly():
+    rng = np.random.default_rng(11)
+    wide = _random_wide(rng, 512)
+    back = narrow.unpack_table(narrow.pack_table(wide))
+    for f in SlotTable._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(back, f)), np.asarray(getattr(wide, f)), f
+        )
+
+
+def test_round_trip_through_every_layout():
+    """The wide row format is the canonical interchange: converting the
+    SAME snapshot through each layout's from_wide/to_wide must be the
+    identity, which is what makes Loader files portable."""
+    rng = np.random.default_rng(13)
+    wide = _random_wide(rng, 256, all_used=True)
+    for layout in LAYOUTS:
+        K = get_kernels(layout)
+        back = K.to_wide(K.from_wide(wide))
+        for f in SlotTable._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(back, f)),
+                np.asarray(getattr(wide, f)),
+                f"{layout}.{f}",
+            )
+
+
+def test_bytes_per_slot_registry():
+    # The registry drives engine table-size gates; it must agree with
+    # the layout module's own accounting.
+    assert BYTES_PER_SLOT["narrow"] == narrow.BYTES_PER_SLOT == 72
+    assert narrow.PROBE_BYTES_PER_WAY == 40  # half of fused's 80
+    for layout in LAYOUTS:
+        assert get_kernels(layout).bytes_per_slot == BYTES_PER_SLOT[layout]
+
+
+def test_narrow_table_wide_views():
+    rng = np.random.default_rng(17)
+    wide = _random_wide(rng, 128)
+    t = narrow.pack_table(wide)
+    np.testing.assert_array_equal(np.asarray(t.used), np.asarray(wide.used))
+    np.testing.assert_array_equal(np.asarray(t.key_hi), np.asarray(wide.key_hi))
+    np.testing.assert_array_equal(np.asarray(t.key_lo), np.asarray(wide.key_lo))
+    np.testing.assert_array_equal(
+        np.asarray(t.expire_at), np.asarray(wide.expire_at)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t.remaining), np.asarray(wide.remaining)
+    )
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+def _engine(layout, now_fn=lambda: NOW, **kw):
+    kw.setdefault("num_groups", 1 << 10)
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("batch_wait_s", 0.002)
+    return DeviceEngine(EngineConfig(layout=layout, **kw), now_fn=now_fn)
+
+
+def test_narrow_engine_serves():
+    eng = _engine("narrow")
+    try:
+        rl = eng.check_batch([mk()])[0]
+        assert (rl.status, rl.limit, rl.remaining) == (
+            Status.UNDER_LIMIT, 10, 9,
+        )
+        assert rl.error == ""
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("src,dst", [("fused", "narrow"), ("narrow", "wide")])
+def test_snapshot_portable_across_layouts(src, dst):
+    """Counters survive a snapshot/restore across DIFFERENT table
+    layouts — the Loader interchange stays the wide row format."""
+    a = _engine(src)
+    try:
+        a.check_batch([mk(key="port", hits=7), mk(key="other", hits=3)])
+        snap = a.snapshot()
+    finally:
+        a.close()
+    b = _engine(dst)
+    try:
+        b.restore(snap)
+        out = b.check_batch([mk(key="port", hits=0), mk(key="other", hits=2)])
+        assert out[0].remaining == 3  # 10 - 7, carried across layouts
+        assert out[1].remaining == 5  # 10 - 3 - 2, counter continued
+    finally:
+        b.close()
+
+
+def test_warm_buckets_oversized_table_skips(monkeypatch):
+    """The bucket-warm ladder compiles against a THROWAWAY table copy;
+    beyond the scratch budget it is skipped (runtime/engine.py
+    _warm_buckets) and only batch_size stays warm. Pin the interaction:
+    a single NO_BATCHING request on such an engine is still served —
+    through a batch_size-wide dispatch (a latency cost, ~the wide
+    kernel's per-batch time), never a mid-request JIT stall."""
+    monkeypatch.setattr(DeviceEngine, "_WARM_TABLE_BUDGET", 1)
+    eng = _engine("narrow", batch_size=512, fast_buckets=True)
+    try:
+        # The warmer must exit promptly (it skipped), leaving only the
+        # always-warm batch_size shape.
+        assert eng.wait_warm(timeout_s=60.0)
+        assert eng._warm_shapes == (512,)
+        rl = eng.check_batch([mk(behavior=Behavior.NO_BATCHING)])[0]
+        assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 9)
+    finally:
+        eng.close()
+
+
+def test_warm_buckets_budget_uses_layout_bytes():
+    """The gate is sized by the LAYOUT's resident bytes/slot: a narrow
+    table (72 B/slot) fits a budget the wide layout (83 B/slot) would
+    blow, so the ladder still warms where the bytes actually allow it."""
+    budget = DeviceEngine._WARM_TABLE_BUDGET
+    groups = budget // (8 * BYTES_PER_SLOT["narrow"])  # narrow under, wide over
+    assert groups * 8 * BYTES_PER_SLOT["narrow"] <= budget
+    assert groups * 8 * BYTES_PER_SLOT["wide"] > budget

@@ -1,0 +1,8 @@
+# TIMEOUT: 900
+import sys, json
+sys.path.insert(0, "/root/repo")
+for _m in [k for k in list(sys.modules) if k == "bench" or k.startswith("gubernator_tpu")]:
+    del sys.modules[_m]
+import bench
+r = bench.bench_kernel("kernel", "narrow")
+print("RESULT " + json.dumps(r))

@@ -1,0 +1,12 @@
+# TIMEOUT: 1800
+# Narrow-vs-fused decide A/B at both kernel geometries (2M- and 16M-slot
+# tables). Each per-layout run and each comparison ratio is ledgered
+# (bench_results/results.jsonl) as it lands, so a tunnel death mid-job
+# keeps the completed rows.
+import sys, json
+sys.path.insert(0, "/root/repo")
+for _m in [k for k in list(sys.modules) if k == "bench" or k.startswith("gubernator_tpu")]:
+    del sys.modules[_m]
+import bench
+r = bench.bench_ab()
+print("RESULT " + json.dumps(r))
