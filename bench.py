@@ -26,6 +26,29 @@ import time
 import numpy as np
 
 
+def _engine_telemetry(eng) -> dict:
+    """Distribution-shape summary for the ledger row: flush-latency
+    p50/p99 and the wave-count histogram, pulled from the engine's
+    device-tier telemetry (gubernator_tpu.metrics.Log2Histogram). Means
+    hide bimodality — results.jsonl keeps the shape too."""
+    em = eng.metrics
+    fd = em.flush_duration.summary()
+    wv = em.flush_waves.summary()
+    bw = em.batch_width.summary()
+    return {
+        "flush_us": {
+            "p50": round(fd["p50"] * 1e6, 1),
+            "p99": round(fd["p99"] * 1e6, 1),
+            "count": fd["count"],
+        },
+        "waves": {"p50": round(wv["p50"], 1), "p99": round(wv["p99"], 1)},
+        "batch_width": {
+            "p50": round(bw["p50"], 1), "p99": round(bw["p99"], 1),
+        },
+        "cold_compiles": em.cold_compiles,
+    }
+
+
 def bench_engine() -> dict:
     """End-to-end DeviceEngine throughput: string keys, host hashing and
     wave assembly, kernel, response demux — the serving path minus the
@@ -89,6 +112,7 @@ def bench_engine() -> dict:
         lat.append(time.perf_counter() - t1)
     lat_ms = np.array(lat[50:]) * 1000  # skip warm tail
     p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
+    telemetry = _engine_telemetry(eng)
     eng.close()
     return {
         "metric": (
@@ -98,6 +122,7 @@ def bench_engine() -> dict:
         "value": round(tput, 0),
         "unit": "decisions/s",
         "vs_baseline": round(tput / 4000.0, 1),
+        "telemetry": telemetry,
     }
 
 
@@ -154,11 +179,11 @@ def bench_server() -> dict:
                 dt = time.perf_counter() - t0
                 p50 = float(np.percentile(np.array(lat) * 1000, 50))
                 p99 = float(np.percentile(np.array(lat) * 1000, 99))
-                return total / dt, p50, p99
+                return total / dt, p50, p99, _engine_telemetry(d.engine)
         finally:
             await d.close()
 
-    tput, p50, p99 = asyncio.run(run())
+    tput, p50, p99, telemetry = asyncio.run(run())
     return {
         "metric": (
             f"gRPC server decisions/sec ({platform}, batch=500, 8 streams; "
@@ -167,6 +192,7 @@ def bench_server() -> dict:
         "value": round(tput, 0),
         "unit": "decisions/s",
         "vs_baseline": round(tput / 4000.0, 1),
+        "telemetry": telemetry,
     }
 
 

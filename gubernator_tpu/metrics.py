@@ -18,6 +18,8 @@ registry, daemon.go:91-103) so in-process cluster fixtures don't collide.
 
 from __future__ import annotations
 
+import logging
+import math
 import threading
 
 from prometheus_client import (
@@ -27,6 +29,8 @@ from prometheus_client import (
     generate_latest,
     CONTENT_TYPE_LATEST,
 )
+
+log = logging.getLogger("gubernator_tpu.metrics")
 
 
 def _escape_label(v: str) -> str:
@@ -106,16 +110,203 @@ class _BareCounter:
         return out
 
 
+class _HistChild:
+    __slots__ = ("_parent", "_key")
+
+    def __init__(self, parent: "Log2Histogram", key: tuple):
+        self._parent = parent
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        self._parent._observe(self._key, value)
+
+
+class Log2Histogram:
+    """Fixed-bucket power-of-two histogram, exposed as real Prometheus
+    histogram series (`<name>_bucket{le=...}` / `_sum` / `_count`).
+
+    The reference catalog only ships Summaries; histograms are what the
+    device tier needs — cross-process aggregatable latency/shape
+    distributions for the engine flush path (docs/monitoring.md).
+    Bucket upper bounds are `scale * 2**i` for i in [0, n_buckets);
+    observe() is O(1) (one frexp + one lock hold, no allocation), cheap
+    enough to run per FLUSH / per sync TICK — it is never called per
+    request."""
+
+    def __init__(
+        self,
+        name: str,
+        doc: str,
+        scale: float = 1.0,
+        n_buckets: int = 24,
+        labelnames=(),
+    ):
+        self.name = name
+        self.doc = doc
+        self.scale = float(scale)
+        self.n_buckets = int(n_buckets)
+        self.labelnames = tuple(labelnames)
+        self._les = [self.scale * (1 << i) for i in range(self.n_buckets)]
+        self._lock = threading.Lock()
+        # key -> [bucket counts (n_buckets + 1, last = +Inf), sum]
+        self._series: dict = {}
+        if not self.labelnames:
+            self._series[()] = [[0] * (self.n_buckets + 1), 0.0]
+
+    def sample_names(self) -> list:
+        return [self.name, f"{self.name}_bucket",
+                f"{self.name}_sum", f"{self.name}_count"]
+
+    def labels(self, *values) -> _HistChild:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label values"
+            )
+        return _HistChild(self, tuple(str(v) for v in values))
+
+    def observe(self, value: float) -> None:
+        self._observe((), value)
+
+    def _bucket_index(self, value: float) -> int:
+        if value <= self.scale:
+            return 0
+        m, e = math.frexp(value / self.scale)  # value/scale = m * 2**e
+        i = e - 1 if m == 0.5 else e  # smallest i with value <= scale*2**i
+        return min(i, self.n_buckets)  # n_buckets = the +Inf bucket
+
+    def _observe(self, key: tuple, value: float) -> None:
+        v = float(value)
+        i = self._bucket_index(v)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * (self.n_buckets + 1), 0.0]
+            s[0][i] += 1
+            s[1] += v
+
+    def render_lines(self) -> list:
+        out = [f"# HELP {self.name} {self.doc}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(
+                (k, list(s[0]), s[1]) for k, s in self._series.items()
+            )
+        for key, counts, total in items:
+            lbl = ",".join(
+                f'{n}="{_escape_label(v)}"'
+                for n, v in zip(self.labelnames, key)
+            )
+            sep = "," if lbl else ""
+            cum = 0
+            for le, c in zip(self._les, counts):
+                cum += c
+                out.append(
+                    f'{self.name}_bucket{{{lbl}{sep}le="{le:.12g}"}} {cum}'
+                )
+            cum += counts[-1]
+            out.append(f'{self.name}_bucket{{{lbl}{sep}le="+Inf"}} {cum}')
+            suffix = f"{{{lbl}}}" if lbl else ""
+            out.append(f"{self.name}_sum{suffix} {total}")
+            out.append(f"{self.name}_count{suffix} {cum}")
+        return out
+
+    def summary(self, qs=(0.5, 0.99)) -> dict:
+        """Aggregate distribution summary across all label sets: count,
+        sum, and linearly-interpolated quantiles (bench ledger rows and
+        the /debug/engine snapshot)."""
+        with self._lock:
+            counts = [0] * (self.n_buckets + 1)
+            total = 0.0
+            for buckets, s in self._series.values():
+                total += s
+                for i, c in enumerate(buckets):
+                    counts[i] += c
+        n = sum(counts)
+        out = {"count": n, "sum": total}
+        if n == 0:
+            for q in qs:
+                out[f"p{int(q * 100)}"] = 0.0
+            return out
+        for q in qs:
+            rank = q * n
+            cum = 0
+            val = float(self._les[-1] * 2)  # +Inf estimate: one octave up
+            for i, c in enumerate(counts):
+                if c == 0:
+                    continue
+                if cum + c >= rank:
+                    hi = (
+                        self._les[i]
+                        if i < self.n_buckets
+                        else self._les[-1] * 2
+                    )
+                    lo = 0.0 if i == 0 else self._les[i - 1]
+                    val = lo + (hi - lo) * max(rank - cum, 0.0) / c
+                    break
+                cum += c
+            out[f"p{int(q * 100)}"] = val
+        return out
+
+
+# The device-tier histogram families (single source of truth: the engine
+# tier instantiates exactly these via EngineMetrics, Metrics exposes them
+# through register_renderable, and tools/check_metrics_names.py audits
+# the names against docs/monitoring.md without importing jax).
+def engine_histograms() -> dict:
+    us, cnt = 1e-6, 1.0
+    return {
+        "flush_duration": Log2Histogram(
+            "gubernator_engine_flush_duration",
+            "Engine flush wall time in seconds (host assembly + device "
+            "waves + response demux), by serving path.",
+            scale=us, n_buckets=24, labelnames=("path",),
+        ),
+        "device_sync": Log2Histogram(
+            "gubernator_engine_device_sync_duration",
+            "Device wave execution + host materialization time per flush "
+            "in seconds, by serving path.",
+            scale=us, n_buckets=24, labelnames=("path",),
+        ),
+        "queue_wait": Log2Histogram(
+            "gubernator_engine_queue_wait_duration",
+            "Time queue entries waited before a pump flush picked them "
+            "up, in seconds.",
+            scale=us, n_buckets=24,
+        ),
+        "flush_waves": Log2Histogram(
+            "gubernator_engine_flush_waves",
+            "Sequential decide() waves per engine flush.",
+            scale=cnt, n_buckets=12,
+        ),
+        "batch_width": Log2Histogram(
+            "gubernator_engine_batch_width",
+            "Requests served per engine flush, by serving path.",
+            scale=cnt, n_buckets=16, labelnames=("path",),
+        ),
+        "ici_tick_duration": Log2Histogram(
+            "gubernator_ici_tick_duration",
+            "ICI GLOBAL sync tick wall time in seconds (collective "
+            "dispatch + device sync).",
+            scale=us, n_buckets=24,
+        ),
+        "ici_tick_groups": Log2Histogram(
+            "gubernator_ici_tick_groups",
+            "Groups merged per ICI GLOBAL sync tick.",
+            scale=cnt, n_buckets=26,
+        ),
+    }
+
+
 class Metrics:
     def __init__(self, registry: CollectorRegistry | None = None):
         self.registry = registry or CollectorRegistry()
         r = self.registry
         self._bare: list[_BareCounter] = []
+        self._renderables: list = []  # Log2Histogram-shaped (render_lines)
+        self._claimed: set = set()  # sample names owned outside the registry
+        self._sync_fail_counts: dict = {}
 
-        def counter(name, doc, labels=()):
-            c = _BareCounter(name, doc, labels)
-            self._bare.append(c)
-            return c
+        counter = self.bare_counter
 
         # Core serving metrics (reference gubernator.go:60-111)
         self.getratelimit_counter = counter(
@@ -290,7 +481,86 @@ class Metrics:
             registry=r,
         )
 
+        # Device-tier telemetry (docs/monitoring.md; no reference analog:
+        # the engine below the Go-shaped service tier is this port's
+        # addition, and its invariants need first-class observability).
+        self.engine_cold_compiles = counter(
+            "gubernator_engine_cold_compile_count",
+            "Serving-path kernel dispatches that triggered an XLA "
+            "compile. The serving path is warmed at startup and must "
+            "never compile; nonzero means the invariant broke.",
+        )
+        self.engine_table_occupancy = Gauge(
+            "gubernator_engine_table_occupancy",
+            "Fraction of device slot-table slots occupied (0-1), "
+            "sampled at scrape time.",
+            registry=r,
+        )
+        self.engine_full_group_ratio = Gauge(
+            "gubernator_engine_full_group_ratio",
+            "Probe pressure: fraction of slot-table groups with every "
+            "way occupied (an insert into a full group must evict).",
+            registry=r,
+        )
+        self.global_broadcast_keys = Log2Histogram(
+            "gubernator_global_broadcast_keys",
+            "Keys per GLOBAL authoritative broadcast flush.",
+            scale=1.0, n_buckets=16,
+        )
+        self.register_renderable(self.global_broadcast_keys)
+        self.global_send_keys = Log2Histogram(
+            "gubernator_global_send_keys",
+            "Keys per GLOBAL hit-update flush to owners.",
+            scale=1.0, n_buckets=16,
+        )
+        self.register_renderable(self.global_send_keys)
+
         self._syncs = []
+
+    # -- registration --------------------------------------------------------
+
+    def _claim_names(self, names) -> None:
+        """Reject sample names that collide with the registry or with
+        already-registered bare counters / renderables: duplicate sample
+        names corrupt the scrape (two families with the same name parse
+        as one), so collision is a registration-time error, never a
+        runtime surprise."""
+        existing = set(self._claimed)
+        try:
+            existing |= set(self.registry._names_to_collectors)
+        except Exception:  # pragma: no cover - private API drift
+            pass
+        for n in names:
+            if n in existing:
+                raise ValueError(
+                    f"duplicate metric sample name {n!r}: already "
+                    "registered with this Metrics registry"
+                )
+        self._claimed.update(names)
+
+    def bare_counter(self, name, doc, labels=()) -> _BareCounter:
+        """A counter exposed under its bare Go name (see _BareCounter);
+        name-guarded against the whole registry."""
+        self._claim_names([name])
+        c = _BareCounter(name, doc, labels)
+        self._bare.append(c)
+        return c
+
+    def register_renderable(self, h) -> None:
+        """Register an externally-owned series (engine Log2Histograms)
+        for exposition through render(); name-guarded like bare
+        counters."""
+        self._claim_names(h.sample_names())
+        self._renderables.append(h)
+
+    def sample_family_names(self) -> set:
+        """Every sample FAMILY this Metrics instance exposes — the audit
+        surface for tools/check_metrics_names.py."""
+        names = {c.name for c in self._bare}
+        names |= {h.name for h in self._renderables}
+        for fam in self.registry.collect():
+            names.add(fam.name)
+        return names
 
     def add_sync(self, fn) -> None:
         """Register a callback run before each exposition (bridges engine
@@ -298,17 +568,28 @@ class Metrics:
         self._syncs.append(fn)
 
     def sync(self) -> None:
-        for fn in self._syncs:
+        for i, fn in enumerate(self._syncs):
             try:
                 fn(self)
             except Exception:
-                pass
+                # A broken bridge must be diagnosable, not a silent
+                # flatline — log the first failure per callback (and
+                # every 1000th, in case the cause changes later).
+                n = self._sync_fail_counts.get(i, 0) + 1
+                self._sync_fail_counts[i] = n
+                if n == 1 or n % 1000 == 0:
+                    log.exception(
+                        "metrics sync callback %r failed (failure %d; "
+                        "its series are stale until it recovers)", fn, n,
+                    )
 
     def render(self) -> bytes:
         self.sync()
         lines = []
         for c in self._bare:
             lines.extend(c.render_lines())
+        for h in self._renderables:
+            lines.extend(h.render_lines())
         text = ("\n".join(lines) + "\n").encode() if lines else b""
         return text + generate_latest(self.registry)
 
@@ -318,7 +599,8 @@ class Metrics:
 def engine_sync(engine):
     """Sync callback exporting DeviceEngine counters under the reference's
     cache/worker metric names (reference lrucache.go:48-59,
-    gubernator.go:86-93)."""
+    gubernator.go:86-93), plus the device-tier gauges this port adds
+    (occupancy / probe pressure / cold compiles)."""
 
     def _sync(m: "Metrics") -> None:
         em = engine.metrics
@@ -328,10 +610,40 @@ def engine_sync(engine):
         m.over_limit_counter.set(em.over_limit)
         m.command_counter.set(em.requests)
         m.worker_queue_length.set(engine.queue_depth())
-        m.cache_size.set(engine.live_count())
+        m.engine_cold_compiles.set(getattr(em, "cold_compiles", 0))
+        if hasattr(engine, "occupancy_stats"):
+            # One set of device-scalar reductions per scrape — table
+            # residency defines these, not host bookkeeping.
+            stats = engine.occupancy_stats()
+            m.cache_size.set(stats["live"])
+            m.engine_table_occupancy.set(stats["occupancy"])
+            m.engine_full_group_ratio.set(stats["full_group_ratio"])
+        else:
+            m.cache_size.set(engine.live_count())
         if hasattr(engine, "overflow_keys"):  # ici-mode engines only
             m.global_overflow_keys.set(engine.overflow_keys)
             m.global_overflow_drops.set(engine.overflow_drops)
             m.global_sync_backlog.set(getattr(engine, "sync_backlog", 0))
 
     return _sync
+
+
+def wire_engine_telemetry(metrics: "Metrics", engine) -> None:
+    """Attach an engine to a Metrics instance: register its device-tier
+    histogram series for exposition and add the scalar sync bridge.
+    The daemon's composition root calls this once per engine."""
+    em = engine.metrics
+    for h in getattr(em, "histograms", lambda: ())():
+        metrics.register_renderable(h)
+    metrics.add_sync(engine_sync(engine))
+
+
+def catalog_names() -> set:
+    """Every sample family a default-configured daemon can expose at
+    /metrics (optional GUBER_METRIC_FLAGS process/runtime collectors
+    excluded). tools/check_metrics_names.py pins docs/monitoring.md to
+    this set. Deliberately jax-free: only prometheus_client is
+    imported."""
+    names = Metrics().sample_family_names()
+    names |= {h.name for h in engine_histograms().values()}
+    return names

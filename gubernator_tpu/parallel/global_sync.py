@@ -38,6 +38,7 @@ from gubernator_tpu.api.types import (
     has_behavior,
 )
 from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import tracing
 
 
 class BatchQueue:
@@ -114,8 +115,6 @@ class GlobalManager:
         def hits_error(take, e):
             log.exception("GLOBAL hit-update flush failed")
             m.global_send_errors.inc()
-            from gubernator_tpu.utils import tracing
-
             with tracing.span(
                 "globalManager.sendHits.error", level="ERROR", error=str(e)
             ):
@@ -124,8 +123,6 @@ class GlobalManager:
         def upd_error(take, e):
             log.exception("GLOBAL broadcast flush failed")
             m.global_broadcast_errors.inc()
-            from gubernator_tpu.utils import tracing
-
             with tracing.span(
                 "globalManager.broadcast.error", level="ERROR", error=str(e)
             ):
@@ -195,6 +192,7 @@ class GlobalManager:
 
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
         t0 = time.perf_counter()
+        self.svc.metrics.global_send_keys.observe(len(hits))
         try:
             by_peer: Dict[str, tuple] = {}
             for key, r in hits.items():
@@ -240,6 +238,7 @@ class GlobalManager:
             # status re-reads (and the forced sync below) entirely.
             return
         t0 = time.perf_counter()
+        self.svc.metrics.global_broadcast_keys.observe(len(updates))
         try:
             # Two-tier GLOBAL ("ici" mode): the pod's authoritative value
             # is spread across device replicas until the collective sync

@@ -597,7 +597,8 @@ def make_sync_step(
                 dev, RK.to_wide(native), pending, gids, valid, now, psum
             )
             diag = jnp.stack(
-                [kept_total, dropped_total, jnp.zeros((), I64)]
+                [kept_total, dropped_total, jnp.zeros((), I64),
+                 jnp.full((), G, I64)]
             )[None, :]
             return (
                 IciState(
@@ -670,8 +671,9 @@ def make_sync_step(
         # over from the previous tick's table unchanged; the gauges
         # reflect blocks actually merged this tick, plus the backlog of
         # active groups the cap pushed to the next tick.
-        backlog = jnp.sum(g_act.astype(I64)) - jnp.sum(valid.astype(I64))
-        diag = jnp.stack([kept_c, dropped_c, backlog])[None, :]
+        merged = jnp.sum(valid.astype(I64))
+        backlog = jnp.sum(g_act.astype(I64)) - merged
+        diag = jnp.stack([kept_c, dropped_c, backlog, merged])[None, :]
         return (
             IciState(
                 table=_unsqueeze(new_native), pending=new_pending[None],
@@ -687,12 +689,13 @@ def make_sync_step(
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def sync_fn(state: IciState, now):
-        """Returns (new_state, diag) where diag is (n_dev, 3) int64:
+        """Returns (new_state, diag) where diag is (n_dev, 4) int64:
         diag[d] = [overflow entries kept replica-local on device d (among
                    groups merged this tick), overflow survivors dropped
                    on device d this tick, active groups beyond the cap
                    left for the next tick (identical on every device; 0
-                   when unbounded)]."""
+                   when unbounded), groups merged this tick (identical
+                   on every device; G when unbounded)]."""
         return sharded(state, jnp.asarray(now, I64))
 
     return sync_fn

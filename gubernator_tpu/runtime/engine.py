@@ -42,7 +42,9 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
 from gubernator_tpu.ops.kernels import get_kernels
+from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
+from gubernator_tpu.utils import tracing
 
 
 class TableCommittedError(RuntimeError):
@@ -82,10 +84,20 @@ class EngineConfig:
 
 
 class EngineMetrics:
-    """Counters the observability layer exports (names map to the
-    reference's Prometheus catalog, docs/prometheus.md)."""
+    """Counters + device-tier distributions the observability layer
+    exports (scalar names map to the reference's Prometheus catalog,
+    docs/prometheus.md; the histogram families, flight recorder, and
+    cold-compile counter are this port's device-tier additions —
+    docs/monitoring.md). Wired into a daemon's Metrics registry by
+    metrics.wire_engine_telemetry()."""
 
     def __init__(self):
+        from gubernator_tpu.metrics import engine_histograms
+        from gubernator_tpu.runtime.telemetry import (
+            FlightRecorder,
+            install_compile_listener,
+        )
+
         self.lock = threading.Lock()
         self.cache_hits = 0
         self.cache_misses = 0
@@ -95,6 +107,22 @@ class EngineMetrics:
         self.waves = 0
         self.requests = 0
         self.batch_duration_sum = 0.0
+        self.cold_compiles = 0
+        # Device-tier histograms (families defined once in metrics.py so
+        # the exposition catalog and this class cannot drift).
+        hists = engine_histograms()
+        for attr, h in hists.items():
+            setattr(self, attr, h)
+        self._histograms = tuple(hists.values())
+        self.recorder = FlightRecorder()
+        install_compile_listener()
+
+    def histograms(self) -> tuple:
+        return self._histograms
+
+    def note_cold_compile(self) -> None:
+        with self.lock:
+            self.cold_compiles += 1
 
     def observe(self, hits, misses, evic, over, waves, n, dur):
         with self.lock:
@@ -106,6 +134,15 @@ class EngineMetrics:
             self.waves += waves
             self.requests += n
             self.batch_duration_sum += dur
+
+    def observe_flush(self, path: str, n: int, waves: int, dur: float,
+                      dev: float) -> None:
+        """One flush's distribution samples (per FLUSH, not per
+        request)."""
+        self.flush_duration.labels(path).observe(dur)
+        self.device_sync.labels(path).observe(dev)
+        self.batch_width.labels(path).observe(n)
+        self.flush_waves.observe(waves)
 
 
 class _Slot:
@@ -195,7 +232,7 @@ class EngineBase:
             return fut
         if req.created_at is None:
             req.created_at = self.now_fn()
-        self._queue.put((req, fut))
+        self._queue.put((req, fut, time.perf_counter()))
         return fut
 
     def check_bulk(self, reqs: Sequence[RateLimitReq]) -> "Future[List[RateLimitResp]]":
@@ -246,6 +283,39 @@ class EngineBase:
         if warm is not None and warm.is_alive():
             warm.join(timeout=60)
 
+    # -- introspection (shared) ----------------------------------------------
+
+    def debug_snapshot(self) -> dict:
+        """Telemetry + flight-recorder snapshot served as JSON by the
+        /debug/engine endpoint (service/gateway.py). Host-side state
+        plus one occupancy readback; safe at poll cadence."""
+        em = self.metrics
+        cfg = self.cfg
+        with em.lock:
+            counters = {
+                "requests": em.requests,
+                "batches": em.batches,
+                "waves": em.waves,
+                "cache_hits": em.cache_hits,
+                "cache_misses": em.cache_misses,
+                "unexpired_evictions": em.unexpired_evictions,
+                "over_limit": em.over_limit,
+                "cold_compiles": em.cold_compiles,
+            }
+        snap = {
+            "engine": type(self).__name__,
+            "layout": getattr(cfg, "layout", ""),
+            "batch_size": cfg.batch_size,
+            "max_waves": cfg.max_waves,
+            "queue_depth": self.queue_depth(),
+            "counters": counters,
+            "histograms": {h.name: h.summary() for h in em.histograms()},
+            "flight_recorder": em.recorder.snapshot(),
+        }
+        if hasattr(self, "occupancy_stats"):
+            snap["occupancy"] = self.occupancy_stats()
+        return snap
+
     # -- pump ----------------------------------------------------------------
 
     def _pump(self) -> None:
@@ -272,14 +342,20 @@ class EngineBase:
             carry = []
 
             def _extend(entry) -> bool:
-                """Add a queue entry (single pair or bulk); True if it asks
-                for an immediate flush."""
+                """Add a queue entry (single triple or bulk); True if it
+                asks for an immediate flush. Queue wait (enqueue ->
+                pump pickup) feeds the queue_wait histogram: sustained
+                growth means the pump is falling behind intake."""
+                qw = self.metrics.queue_wait
                 if type(entry) is _Bulk:
+                    qw.observe(time.perf_counter() - entry.t_enq)
                     batch.extend(entry.work)
                     pending_bulks.append(entry)
                     return any(r.behavior & NB for r, _ in entry.work)
-                batch.append(entry)
-                return bool(entry[0].behavior & NB)
+                req, fut, t_enq = entry
+                qw.observe(time.perf_counter() - t_enq)
+                batch.append((req, fut))
+                return bool(req.behavior & NB)
 
             flush = item is _FLUSH
             if not flush:
@@ -507,6 +583,23 @@ class DeviceEngine(EngineBase):
         with self._lock:
             return int(jax.numpy.sum(self.table.used))
 
+    def occupancy_stats(self) -> dict:
+        """Table occupancy + probe pressure as device-scalar reductions
+        (two tiny cached programs, scalars only to host). Scrape-time
+        cost — metrics.engine_sync samples this per exposition."""
+        jnp = jax.numpy
+        G, W = self.cfg.num_groups, self.cfg.ways
+        with self._lock:
+            used = self.table.used
+            live = int(jnp.sum(used))
+            full = int(jnp.sum(jnp.all(used.reshape(G, W), axis=1)))
+        return {
+            "live": live,
+            "slots": G * W,
+            "occupancy": live / float(G * W),
+            "full_group_ratio": full / float(G),
+        }
+
     # ---- wave assembly + kernel dispatch -----------------------------------
 
     def _process(
@@ -633,32 +726,44 @@ class DeviceEngine(EngineBase):
                     wave_lane_req[place[0]][place[1]] = (
                         items[i][0], place[2], place[3],
                     )
-        outs, wave_rows_host, events = self._execute_waves(
-            waves, wave_lane_req, now, prefetched
-        )
-
-        # Materialize results (one host sync per wave) and demux.
-        host = [
-            (
-                np.asarray(o.status),
-                np.asarray(o.remaining),
-                np.asarray(o.reset_time),
-                np.asarray(o.limit),
-                int(o.hits),
-                int(o.misses),
-                int(o.unexpired_evictions),
-                int(o.over_limit),
+        t_dev = time.perf_counter()
+        with _telemetry.serving_scope(self.metrics), tracing.span(
+            "engine.flush", level="DEBUG", path="object",
+            items=len(items), waves=len(waves),
+        ):
+            outs, wave_rows_host, events = self._execute_waves(
+                waves, wave_lane_req, now, prefetched
             )
-            for o in outs
-        ]
+
+            # Materialize results (one host sync per wave) and demux.
+            host = [
+                (
+                    np.asarray(o.status),
+                    np.asarray(o.remaining),
+                    np.asarray(o.reset_time),
+                    np.asarray(o.limit),
+                    int(o.hits),
+                    int(o.misses),
+                    int(o.unexpired_evictions),
+                    int(o.over_limit),
+                )
+                for o in outs
+            ]
+        dev_s = time.perf_counter() - t_dev
 
         if keep:
             self._drop_displaced_strings(events)
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
-        self.metrics.observe(
-            tot[0], tot[1], tot[2], tot[3], len(waves),
-            len(items) - len(carry),  # carried items count when served
-            time.perf_counter() - t0,
+        served = len(items) - len(carry)  # carried items count when served
+        dur = time.perf_counter() - t0
+        em = self.metrics
+        em.observe(tot[0], tot[1], tot[2], tot[3], len(waves), served, dur)
+        em.observe_flush("object", served, len(waves), dur, dev_s)
+        em.recorder.record(
+            path="object", layout=cfg.layout, n=served, waves=len(waves),
+            carry=len(carry),
+            widths=[int(w.active.shape[0]) for w in waves],
+            dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
         )
 
         # Write-behind BEFORE resolving futures, so a caller that observed
@@ -838,11 +943,17 @@ class DeviceEngine(EngineBase):
                 lane_reqs[w] = {
                     lane_l[j]: (j, hi_l[j], lo_l[j]) for j in by_wave[w]
                 }
-        outs, wave_rows_host, events = self._execute_waves(
-            wave_slices, lane_reqs, now, prefetched, req_resolver=resolver
-        )
+        t_dev = time.perf_counter()
+        with _telemetry.serving_scope(self.metrics), tracing.span(
+            "engine.flush", level="DEBUG", path="columnar", items=n, waves=W,
+        ):
+            outs, wave_rows_host, events = self._execute_waves(
+                wave_slices, lane_reqs, now, prefetched,
+                req_resolver=resolver,
+            )
 
-        status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
+            status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
+        dev_s = time.perf_counter() - t_dev
 
         if store is not None:
             # Write-behind from the per-wave gathered rows (last-op-wins
@@ -856,9 +967,13 @@ class DeviceEngine(EngineBase):
                 self._drop_displaced_strings(events)
 
         tot_hits, tot_miss, tot_evic, tot_over = _wave_totals(outs)
-        self.metrics.observe(
-            tot_hits, tot_miss, tot_evic, tot_over, W, n,
-            time.perf_counter() - t_start,
+        dur = time.perf_counter() - t_start
+        em = self.metrics
+        em.observe(tot_hits, tot_miss, tot_evic, tot_over, W, n, dur)
+        em.observe_flush("columnar", n, W, dur, dev_s)
+        em.recorder.record(
+            path="columnar", layout=cfg.layout, n=n, waves=W, carry=0,
+            widths=[B] * W, dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
         )
         return (status[ix], r_limit[ix], remaining[ix], reset_time[ix])
 
@@ -1407,12 +1522,13 @@ def _select_columns(cols, select: np.ndarray):
 class _Bulk:
     """A bulk queue entry: N (req, _Slot) pairs resolved by one Future."""
 
-    __slots__ = ("work", "slots", "future")
+    __slots__ = ("work", "slots", "future", "t_enq")
 
     def __init__(self, work, slots, future):
         self.work = work
         self.slots = slots
         self.future = future
+        self.t_enq = time.perf_counter()
 
     def resolve(self) -> None:
         if not self.future.done():

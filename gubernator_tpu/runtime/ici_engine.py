@@ -54,7 +54,9 @@ from gubernator_tpu.runtime.engine import (
     _stack_wave_outputs,
     _wave_totals,
 )
+from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
+from gubernator_tpu.utils import tracing
 
 log = logging.getLogger("gubernator_tpu.ici")
 
@@ -154,11 +156,19 @@ class IciEngine(EngineBase):
     # -- public additions over EngineBase ------------------------------------
 
     def sync_now(self) -> None:
-        """Run one GLOBAL sync tick immediately (tests/benchmarks)."""
+        """Run one GLOBAL sync tick immediately (tests/benchmarks; the
+        background sync thread's tick body)."""
         now = self.now_fn()
+        t0 = time.perf_counter()
         with self._lock:
-            self.ici_state, diag = self._sync(self.ici_state, now)
-            d = np.asarray(diag)
+            # The tick is warmed in _warmup and must stay compile-free on
+            # the 100ms cadence — a cold tick stalls GLOBAL convergence,
+            # so it counts against the cold-compile invariant too.
+            with _telemetry.serving_scope(self.metrics), tracing.span(
+                "ici.sync_tick", level="DEBUG"
+            ):
+                self.ici_state, diag = self._sync(self.ici_state, now)
+                d = np.asarray(diag)
             # kept/dropped cover groups merged THIS tick; under a capped
             # backlog, retained keys in unmerged groups surface when
             # their group's turn comes. The backlog gauge (identical on
@@ -167,6 +177,16 @@ class IciEngine(EngineBase):
             self.overflow_keys = int(d[:, 0].sum())
             self.overflow_drops += int(d[:, 1].sum())
             self.sync_backlog = int(d[:, 2].max())
+        dur = time.perf_counter() - t0
+        groups = int(d[:, 3].max())
+        em = self.metrics
+        em.ici_tick_duration.observe(dur)
+        em.ici_tick_groups.observe(groups)
+        em.recorder.record(
+            path="ici-sync", layout=self.cfg.layout, groups=groups,
+            backlog=self.sync_backlog, overflow_keys=self.overflow_keys,
+            dur_us=int(dur * 1e6),
+        )
 
     def inject_globals(self, globals_) -> None:
         """Apply an authoritative UpdatePeerGlobals push to every replica
@@ -287,7 +307,10 @@ class IciEngine(EngineBase):
             homes_wb[r_ix] = homes
 
         s_outs, r_outs = [], []
-        with self._lock:
+        t_dev = time.perf_counter()
+        with self._lock, _telemetry.serving_scope(self.metrics), tracing.span(
+            "engine.flush", level="DEBUG", path="columnar", items=n,
+        ):
             table = self.table
             state = self.ici_state
             try:
@@ -339,9 +362,15 @@ class IciEngine(EngineBase):
             waves_total += asm[4]
             for j, v in enumerate(_wave_totals(outs)):
                 tots[j] += v
-        self.metrics.observe(
-            tots[0], tots[1], tots[2], tots[3], waves_total, n,
-            time.perf_counter() - t_start,
+        dev_s = time.perf_counter() - t_dev
+        dur = time.perf_counter() - t_start
+        em = self.metrics
+        em.observe(tots[0], tots[1], tots[2], tots[3], waves_total, n, dur)
+        em.observe_flush("columnar", n, waves_total, dur, dev_s)
+        em.recorder.record(
+            path="columnar", layout=cfg.layout, n=n, waves=waves_total,
+            carry=0, widths=[cfg.batch_size] * waves_total,
+            dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
         )
         return (status, r_limit, remaining, reset_time)
 
@@ -386,6 +415,31 @@ class IciEngine(EngineBase):
             sharded = int(jax.numpy.sum(self.table.used))
             replica = int(jax.numpy.sum(self.ici_state.table.used)) // max(self.n_dev, 1)
         return sharded + replica
+
+    def occupancy_stats(self) -> dict:
+        """Occupancy + probe pressure across BOTH tiers: the sharded
+        authoritative table plus one replica's worth of the GLOBAL tier
+        (replicas mirror each other post-sync). Probe pressure is
+        reported for the sharded tier, where a full group forces an
+        eviction on insert. Device-scalar reductions only (scrape
+        cadence; see metrics.engine_sync)."""
+        jnp = jax.numpy
+        cfg = self.cfg
+        G, W = cfg.num_groups, cfg.ways
+        with self._lock:
+            s_used = self.table.used
+            live_s = int(jnp.sum(s_used))
+            full_s = int(jnp.sum(jnp.all(s_used.reshape(G, W), axis=1)))
+            live_r = int(jnp.sum(self.ici_state.table.used)) // max(
+                self.n_dev, 1
+            )
+        slots = G * W + cfg.num_slots
+        return {
+            "live": live_s + live_r,
+            "slots": slots,
+            "occupancy": (live_s + live_r) / float(slots),
+            "full_group_ratio": full_s / float(G),
+        }
 
     def close(self) -> None:
         self._stop_sync.set()
@@ -479,7 +533,12 @@ class IciEngine(EngineBase):
         # surviving intermediates and rebuild any consumed donated table
         # (the futures resolve with errors; nothing replays this flush).
         s_out, r_out = [], []
-        with self._lock:
+        waves_total = len(sharded_asm.waves) + len(replica_asm.waves)
+        t_dev = time.perf_counter()
+        with self._lock, _telemetry.serving_scope(self.metrics), tracing.span(
+            "engine.flush", level="DEBUG", path="object",
+            items=len(items), waves=waves_total,
+        ):
             table = self.table
             state = self.ici_state
             try:
@@ -507,16 +566,21 @@ class IciEngine(EngineBase):
             ]
 
         host = {"s": host_rows(s_out), "r": host_rows(r_out)}
+        dev_s = time.perf_counter() - t_dev
         tots = [0, 0, 0, 0]
         for path in host.values():
             for h in path:
                 for j in range(4):
                     tots[j] += h[4 + j]
-        self.metrics.observe(
-            tots[0], tots[1], tots[2], tots[3],
-            len(sharded_asm.waves) + len(replica_asm.waves),
-            len(items) - len(carry),  # carried items count when served
-            time.perf_counter() - t0,
+        served = len(items) - len(carry)  # carried items count when served
+        dur = time.perf_counter() - t0
+        em = self.metrics
+        em.observe(tots[0], tots[1], tots[2], tots[3], waves_total, served, dur)
+        em.observe_flush("object", served, waves_total, dur, dev_s)
+        em.recorder.record(
+            path="object", layout=cfg.layout, n=served, waves=waves_total,
+            carry=len(carry), widths=[B] * waves_total,
+            dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
         )
 
         for (req, fut), place in zip(items, placements):

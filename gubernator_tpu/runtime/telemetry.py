@@ -1,0 +1,117 @@
+"""Device-tier telemetry plumbing: flight recorder + cold-compile
+detection.
+
+Two observability gaps the service-tier Prometheus catalog cannot cover
+(the reference stops at the Go tier, docs/prometheus.md; the engine under
+it is this port's addition):
+
+- FlightRecorder: a fixed-size ring of the last K flush/tick records
+  (width, waves, carry, duration, layout). When a latency spike is
+  already minutes old, the histograms say *that* it happened; the
+  recorder says *what the engine was doing* — the black-box data an
+  operator reads first. Served as JSON at /debug/engine
+  (service/gateway.py).
+
+- Cold-compile detection: the serving path must NEVER trigger an XLA
+  compile (engine warmup pins every servable shape; a mid-request
+  compile blows through forwarding timeouts — see
+  DeviceEngine._warmup/_warm_buckets). jax.monitoring emits
+  `/jax/core/compile/backend_compile_duration` on the DISPATCHING
+  thread exactly when a backend compile runs, so the engines mark their
+  serving-path dispatch regions with serving_scope(); a compile event
+  landing inside a marked region increments that engine's cold-compile
+  counter (exposed as gubernator_engine_cold_compile_count). Warmup,
+  the bucket-warmer thread, and scrape-time reductions never enter a
+  scope, so their compiles are expected and uncounted.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Optional
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_tls = threading.local()
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    # Hot only on compile/cache events (never per dispatch); attribute
+    # to whichever engine marked this thread as serving, if any.
+    if event != _COMPILE_EVENT:
+        return
+    owner = getattr(_tls, "owner", None)
+    if owner is not None:
+        owner.note_cold_compile()
+
+
+def install_compile_listener() -> bool:
+    """Idempotently register the process-global jax.monitoring listener.
+    Returns False when jax (or its monitoring API) is unavailable —
+    cold-compile detection then degrades to a permanent 0, never an
+    import error."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return True
+        try:
+            import jax
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+        except Exception:
+            return False
+        _installed = True
+        return True
+
+
+@contextlib.contextmanager
+def serving_scope(owner):
+    """Mark this thread as executing serving-path device dispatch for
+    `owner` (an EngineMetrics). Compiles observed while the scope is
+    active count as cold compiles against that engine. Scopes nest;
+    the innermost owner wins (re-entrancy from engine-in-engine setups
+    attributes to the engine actually dispatching)."""
+    prev = getattr(_tls, "owner", None)
+    _tls.owner = owner
+    try:
+        yield
+    finally:
+        _tls.owner = prev
+
+
+class FlightRecorder:
+    """Fixed-size ring buffer of the last K flush/tick records.
+
+    record() is one lock hold + one deque append per FLUSH (never per
+    request); snapshot() returns newest-last copies for /debug/engine.
+    `seq` is a monotonic record id so a poller can detect how many
+    records it missed between reads."""
+
+    def __init__(self, capacity: int = 128):
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen or 0
+
+    def record(self, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            self._buf.append({"seq": self._seq, "ts": time.time(), **fields})
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._buf)
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._buf[-1] if self._buf else None

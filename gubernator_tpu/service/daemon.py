@@ -89,9 +89,12 @@ class Daemon:
             )
 
         metrics = Metrics()
-        from gubernator_tpu.metrics import engine_sync
+        from gubernator_tpu.metrics import wire_engine_telemetry
 
-        metrics.add_sync(engine_sync(self.engine))
+        # Scalar bridge + device-tier histogram exposition (flush
+        # latency/width/waves, queue wait, ICI tick series, occupancy
+        # gauges — docs/monitoring.md).
+        wire_engine_telemetry(metrics, self.engine)
 
         # Optional OS/runtime collectors (reference daemon.go:276-287)
         flags = getattr(conf, "metric_flags", [])

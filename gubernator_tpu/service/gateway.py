@@ -1,19 +1,98 @@
-"""HTTP/JSON gateway + /metrics + /healthz.
+"""HTTP/JSON gateway + /metrics + /healthz + /debug.
 
 Mirrors the reference's grpc-gateway mux (reference daemon.go:251-299):
 POST /v1/GetRateLimits and GET /v1/HealthCheck speak snake_case JSON
 (pinned by the reference's TestGRPCGateway), /metrics serves Prometheus
 text, /healthz is the liveness probe.
+
+Device-tier debug surface (docs/monitoring.md; no reference analog):
+
+- GET /debug/engine — the engine's flight recorder (last K flush
+  records), histogram summaries, counters, and table occupancy as JSON.
+- GET /debug/profile?seconds=N — on-demand jax.profiler capture to a
+  temp dir (one capture at a time process-wide; 503 when busy or when
+  the profiler is unavailable). Works on CPU too — the XLA profiler is
+  backend-agnostic.
+
+Both are served by the main gateway AND the status listener
+(daemon.go:305-333 analog), so an mTLS deployment can reach them
+without client certs.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import os
+import tempfile
+import threading
+import time
 
 from aiohttp import web
 
 from gubernator_tpu.service import pb
 from gubernator_tpu.service.server import ApiError, V1Service
+
+# jax.profiler state is process-global: exactly one capture at a time,
+# regardless of how many daemons/listeners share the process.
+_PROFILE_GUARD = threading.Lock()
+_PROFILE_MAX_SECONDS = 30.0
+
+
+def _capture_profile(seconds: float) -> dict:
+    """Blocking profiler capture (runs in an executor thread)."""
+    import jax
+
+    trace_dir = tempfile.mkdtemp(prefix="gubernator_profile_")
+    jax.profiler.start_trace(trace_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(trace_dir)
+        for f in fs
+    ]
+    return {"trace_dir": trace_dir, "seconds": seconds, "files": len(files)}
+
+
+def add_debug_routes(app: web.Application, svc: V1Service) -> None:
+    async def debug_engine(request: web.Request) -> web.Response:
+        # debug_snapshot takes the engine lock for an occupancy readback;
+        # keep it off the event loop.
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.engine.debug_snapshot
+        )
+        return web.json_response(snap)
+
+    async def debug_profile(request: web.Request) -> web.Response:
+        try:
+            seconds = float(request.query.get("seconds", "1"))
+        except ValueError:
+            return web.json_response(
+                {"error": "seconds must be a number"}, status=400
+            )
+        seconds = min(max(seconds, 0.05), _PROFILE_MAX_SECONDS)
+        if not _PROFILE_GUARD.acquire(blocking=False):
+            return web.json_response(
+                {"error": "a profile capture is already running"},
+                status=503,
+            )
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, _capture_profile, seconds
+            )
+        except Exception as e:
+            return web.json_response(
+                {"error": f"profiler unavailable: {e}"}, status=503
+            )
+        finally:
+            _PROFILE_GUARD.release()
+        return web.json_response(out)
+
+    app.router.add_get("/debug/engine", debug_engine)
+    app.router.add_get("/debug/profile", debug_profile)
 
 
 async def read_json_requests(request: web.Request):
@@ -81,12 +160,15 @@ def build_app(svc: V1Service) -> web.Application:
     app.router.add_get("/v1/HealthCheck", health_check)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    add_debug_routes(app, svc)
     return app
 
 
 def build_status_app(svc: V1Service) -> web.Application:
-    """Health-only app for the no-mTLS status listener (reference
-    daemon.go:305-333 serves ONLY /v1/HealthCheck there)."""
+    """Health + debug app for the no-mTLS status listener (reference
+    daemon.go:305-333 serves /v1/HealthCheck there; the device-tier
+    debug surface rides the same listener so operators can reach the
+    flight recorder and profiler without client certs)."""
     app = web.Application()
 
     async def health_check(request: web.Request) -> web.Response:
@@ -94,4 +176,5 @@ def build_status_app(svc: V1Service) -> web.Application:
         return web.json_response(pb.health_to_json(h))
 
     app.router.add_get("/v1/HealthCheck", health_check)
+    add_debug_routes(app, svc)
     return app

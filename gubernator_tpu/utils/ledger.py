@@ -12,8 +12,11 @@ The ledger is an append-only JSONL file kept in two places:
   - <repo>/bench_results/results.jsonl  (committed, survives the machine)
 
 Records: {ts, iso, job, mode, layout, platform, metric, value, unit,
-vs_baseline}. `mode`/`layout` mirror bench.py's CLI so a fallback lookup
-can match the requested benchmark exactly.
+vs_baseline[, telemetry]}. `mode`/`layout` mirror bench.py's CLI so a
+fallback lookup can match the requested benchmark exactly; `telemetry`
+(when the bench ran an engine) carries flush-latency p50/p99 and the
+wave-count histogram summary so the ledger tracks distribution shape,
+not just means.
 
 The reference's analog is its benchmark workflow artifact: a run that
 doesn't produce a comparable artifact doesn't exist
@@ -67,6 +70,10 @@ def append(
         "platform": platform or infer_platform(str(result.get("metric", ""))),
         **{k: result.get(k) for k in ("metric", "value", "unit", "vs_baseline")},
     }
+    if "telemetry" in result:
+        # Distribution shape (flush p50/p99, wave-count histogram) rides
+        # along so results.jsonl tracks shape, not just means.
+        rec["telemetry"] = result["telemetry"]
     line = json.dumps(rec) + "\n"
     for path in (RUNTIME_LEDGER, REPO_LEDGER):
         try:

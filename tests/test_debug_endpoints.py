@@ -1,0 +1,110 @@
+"""The daemon's device-tier debug surface: /debug/engine (flight
+recorder JSON) and /debug/profile (on-demand jax.profiler capture) on
+both the main gateway and the status listener, plus the histogram
+series on /metrics end-to-end."""
+
+import os
+
+import pytest
+import requests
+
+from gubernator_tpu.service import gateway
+from gubernator_tpu.service.config import DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+
+
+@pytest.fixture(scope="module")
+def daemon(loop_thread):
+    d = loop_thread.run(
+        Daemon.spawn(
+            DaemonConfig(
+                cache_size=2048,
+                status_http_listen_address="127.0.0.1:0",
+            )
+        ),
+        timeout=120,
+    )
+    # put some traffic through so the recorder/histograms are non-empty
+    body = {
+        "requests": [
+            {"name": "dbg", "unique_key": f"k{i}", "duration": 60000,
+             "limit": 100, "hits": 1}
+            for i in range(20)
+        ]
+    }
+    requests.post(
+        f"http://{d.http_address}/v1/GetRateLimits", json=body, timeout=10
+    ).raise_for_status()
+    yield d
+    loop_thread.run(d.close())
+
+
+def test_debug_engine_returns_flight_records(daemon):
+    r = requests.get(
+        f"http://{daemon.http_address}/debug/engine", timeout=10
+    )
+    assert r.status_code == 200
+    snap = r.json()
+    assert snap["engine"] == "DeviceEngine"
+    recs = snap["flight_recorder"]
+    assert recs and recs[-1]["n"] >= 1
+    assert {"seq", "ts", "path", "waves", "widths", "dur_us"} <= set(
+        recs[-1]
+    )
+    assert snap["counters"]["requests"] >= 20
+    assert snap["counters"]["cold_compiles"] == 0
+    assert 0 < snap["occupancy"]["occupancy"] <= 1
+
+
+def test_debug_engine_on_status_listener(daemon):
+    r = requests.get(
+        f"http://{daemon.status_address}/debug/engine", timeout=10
+    )
+    assert r.status_code == 200
+    assert r.json()["engine"] == "DeviceEngine"
+
+
+def test_metrics_exposes_histogram_series(daemon):
+    text = requests.get(
+        f"http://{daemon.http_address}/metrics", timeout=10
+    ).text
+    assert "gubernator_engine_flush_duration_bucket" in text
+    assert "gubernator_engine_batch_width_bucket" in text
+    assert "gubernator_engine_queue_wait_duration_bucket" in text
+    assert "gubernator_engine_table_occupancy" in text
+
+
+def test_debug_profile_captures_trace(daemon):
+    r = requests.get(
+        f"http://{daemon.status_address}/debug/profile",
+        params={"seconds": "0.1"},
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+    out = r.json()
+    assert out["seconds"] == 0.1
+    assert out["files"] >= 1  # non-empty trace dir
+    assert os.path.isdir(out["trace_dir"])
+
+
+def test_debug_profile_rejects_concurrent_capture(daemon):
+    assert gateway._PROFILE_GUARD.acquire(blocking=False)
+    try:
+        r = requests.get(
+            f"http://{daemon.http_address}/debug/profile",
+            params={"seconds": "0.1"},
+            timeout=10,
+        )
+        assert r.status_code == 503
+        assert "already running" in r.json()["error"]
+    finally:
+        gateway._PROFILE_GUARD.release()
+
+
+def test_debug_profile_rejects_junk_seconds(daemon):
+    r = requests.get(
+        f"http://{daemon.http_address}/debug/profile",
+        params={"seconds": "nope"},
+        timeout=10,
+    )
+    assert r.status_code == 400

@@ -1,0 +1,236 @@
+"""Metrics exposition: the Log2Histogram primitive, parser-validated
+/metrics output with reference-compatible sample names pinned, the
+registration-time name-collision guard, and sync-callback failure
+logging."""
+
+import logging
+
+import pytest
+from prometheus_client import parser
+
+from gubernator_tpu.metrics import (
+    Log2Histogram,
+    Metrics,
+    engine_histograms,
+    wire_engine_telemetry,
+)
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.api.types import RateLimitReq
+
+NOW = 1_753_700_000_000
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+# ---- Log2Histogram primitive -----------------------------------------------
+
+
+def test_histogram_buckets_cumulative_and_counts():
+    h = Log2Histogram("h_test", "doc", scale=1e-6, n_buckets=8)
+    for v in (5e-7, 1e-6, 3e-6, 1e-4, 10.0):  # last lands in +Inf
+        h.observe(v)
+    lines = h.render_lines()
+    assert lines[0] == "# HELP h_test doc"
+    assert lines[1] == "# TYPE h_test histogram"
+    bucket_vals = [
+        int(ln.rsplit(" ", 1)[1]) for ln in lines if "_bucket" in ln
+    ]
+    assert bucket_vals == sorted(bucket_vals), "buckets must be cumulative"
+    assert bucket_vals[-1] == 5  # +Inf == count
+    assert any(ln.startswith("h_test_count 5") for ln in lines)
+    s = h.summary()
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(5e-7 + 1e-6 + 3e-6 + 1e-4 + 10.0)
+    assert 0 < s["p50"] <= 4e-6
+
+
+def test_histogram_bucket_boundaries():
+    h = Log2Histogram("h_b", "d", scale=1.0, n_buckets=4)
+    # value <= scale*2**i picks bucket i; above range -> +Inf
+    for v, want in ((0.5, 0), (1.0, 0), (1.5, 1), (2.0, 1), (3.0, 2),
+                    (8.0, 3), (9.0, 4), (1e9, 4)):
+        assert h._bucket_index(v) == want, v
+
+
+def test_histogram_labels_render_separately():
+    h = Log2Histogram("h_l", "d", scale=1.0, n_buckets=4,
+                      labelnames=("path",))
+    h.labels("object").observe(1)
+    h.labels("columnar").observe(2)
+    h.labels("columnar").observe(2)
+    text = "\n".join(h.render_lines()) + "\n"
+    fams = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    samples = fams["h_l"].samples
+    counts = {
+        s.labels["path"]: s.value
+        for s in samples
+        if s.name == "h_l_count"
+    }
+    assert counts == {"object": 1.0, "columnar": 2.0}
+    # summary aggregates across label children
+    assert h.summary()["count"] == 3
+
+
+# ---- /metrics exposition ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rendered():
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.002),
+        now_fn=lambda: NOW,
+    )
+    try:
+        m = Metrics()
+        wire_engine_telemetry(m, eng)
+        m.getratelimit_counter.labels("local").inc(3)
+        eng.check_batch([mk("a"), mk("a"), mk("b")])
+        text = m.render().decode()
+    finally:
+        eng.close()
+    return text
+
+
+def test_render_parses_and_pins_reference_names(rendered):
+    fams = {
+        f.name: f for f in parser.text_string_to_metric_families(rendered)
+    }
+    # Reference-compatible names (reference docs/prometheus.md) — the
+    # functional tests poll these, so they are wire contract.
+    assert fams["gubernator_getratelimit_counter"].type == "counter"
+    assert fams["gubernator_cache_access_count"].type == "counter"
+    assert fams["gubernator_over_limit_counter"].type == "counter"
+    assert fams["gubernator_command_counter"].type == "counter"
+    assert fams["gubernator_cache_size"].type == "gauge"
+    # Summaries expose _count/_sum like Go's
+    bd = fams["gubernator_broadcast_duration"]
+    assert bd.type == "summary"
+    assert {s.name for s in bd.samples} >= {
+        "gubernator_broadcast_duration_count",
+        "gubernator_broadcast_duration_sum",
+    }
+    # the parser may normalize counter samples to <name>_total; the raw
+    # TEXT keeps the bare Go name (what the reference's pollers read)
+    assert "\ngubernator_command_counter 3" in rendered
+    cmd = [
+        s for s in fams["gubernator_command_counter"].samples
+        if s.name in ("gubernator_command_counter",
+                      "gubernator_command_counter_total")
+    ]
+    assert cmd[0].value == 3.0  # the engine served 3 requests
+
+
+def test_render_exposes_device_tier_histograms(rendered):
+    fams = {
+        f.name: f for f in parser.text_string_to_metric_families(rendered)
+    }
+    for name in (
+        "gubernator_engine_flush_duration",
+        "gubernator_engine_batch_width",
+        "gubernator_engine_queue_wait_duration",
+        "gubernator_engine_flush_waves",
+        "gubernator_engine_device_sync_duration",
+    ):
+        fam = fams[name]
+        assert fam.type == "histogram", name
+        buckets = [s for s in fam.samples if s.name == f"{name}_bucket"]
+        count = [s for s in fam.samples if s.name == f"{name}_count"]
+        assert buckets and count, name
+        # monotone cumulative per label set, ending at +Inf == count
+        by_labels = {}
+        for s in buckets:
+            key = tuple(sorted(
+                (k, v) for k, v in s.labels.items() if k != "le"
+            ))
+            by_labels.setdefault(key, []).append(s)
+        for key, bs in by_labels.items():
+            vals = [b.value for b in bs]
+            assert vals == sorted(vals), (name, key)
+            assert bs[-1].labels["le"] == "+Inf"
+        # the engine actually observed something
+        total = sum(s.value for s in count)
+        assert total >= 1, name
+    # occupancy gauges present and sane
+    occ = [
+        s for s in fams["gubernator_engine_table_occupancy"].samples
+    ][0]
+    assert 0.0 < occ.value <= 1.0
+    cold = [
+        s for s in fams["gubernator_engine_cold_compile_count"].samples
+        if s.name.startswith("gubernator_engine_cold_compile_count")
+    ][0]
+    assert cold.value == 0.0
+
+
+# ---- registration guard -----------------------------------------------------
+
+
+def test_bare_counter_collision_with_registry_raises():
+    m = Metrics()
+    with pytest.raises(ValueError, match="duplicate metric sample name"):
+        m.bare_counter("gubernator_cache_size", "collides with Gauge")
+
+
+def test_bare_counter_collision_with_bare_raises():
+    m = Metrics()
+    with pytest.raises(ValueError, match="duplicate"):
+        m.bare_counter("gubernator_command_counter", "collides with bare")
+
+
+def test_renderable_collision_raises():
+    m = Metrics()
+    with pytest.raises(ValueError, match="duplicate"):
+        m.register_renderable(
+            Log2Histogram("gubernator_global_broadcast_keys", "dup")
+        )
+    # and a histogram whose derived sample name collides
+    m2 = Metrics()
+    m2.register_renderable(Log2Histogram("fresh_name", "ok"))
+    with pytest.raises(ValueError, match="duplicate"):
+        m2.register_renderable(Log2Histogram("fresh_name", "again"))
+
+
+def test_engine_histograms_have_unique_names():
+    names = [h.name for h in engine_histograms().values()]
+    assert len(names) == len(set(names))
+    m = Metrics()
+    for h in engine_histograms().values():
+        m.register_renderable(h)  # none may collide with the catalog
+
+
+# ---- sync-callback failure logging ------------------------------------------
+
+
+def test_sync_callback_failure_logged_once(caplog):
+    m = Metrics()
+    calls = {"n": 0}
+
+    def bad(metrics):
+        calls["n"] += 1
+        raise RuntimeError("broken bridge")
+
+    m.add_sync(bad)
+    with caplog.at_level(logging.ERROR, logger="gubernator_tpu.metrics"):
+        for _ in range(5):
+            m.sync()
+    assert calls["n"] == 5  # the callback keeps being attempted
+    records = [
+        r for r in caplog.records if "sync callback" in r.getMessage()
+    ]
+    assert len(records) == 1  # ... but logs once, not per scrape
+    assert records[0].exc_info is not None  # with the traceback
+
+
+def test_sync_failure_does_not_block_other_callbacks():
+    m = Metrics()
+    seen = []
+    m.add_sync(lambda _m: (_ for _ in ()).throw(RuntimeError("x")))
+    m.add_sync(lambda _m: seen.append(1))
+    m.render()
+    assert seen == [1]

@@ -1,0 +1,28 @@
+"""docs/monitoring.md must stay in lockstep with the code's metric
+catalog — tools/check_metrics_names.py as a tier-1 test."""
+
+import importlib.util
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TOOL = os.path.join(HERE, os.pardir, "tools", "check_metrics_names.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_metrics_names", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_docs_match_code_catalog():
+    tool = _load_tool()
+    errors = tool.check()
+    assert errors == [], "\n".join(errors)
+
+
+def test_doc_parser_actually_finds_names():
+    # guard against the checker silently parsing nothing (e.g. a doc
+    # reformat away from tables) and vacuously passing
+    tool = _load_tool()
+    assert len(tool.doc_names()) >= 40
