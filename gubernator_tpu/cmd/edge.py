@@ -64,14 +64,26 @@ def main() -> None:
     async def run() -> None:
         import grpc
 
+        from gubernator_tpu.metrics import Metrics
         from gubernator_tpu.service.edge import (
             EdgeClient,
             EdgeV1Servicer,
             build_edge_app,
             edge_v1_handler,
         )
+        from gubernator_tpu.service.envconfig import parse_duration_s
+        from gubernator_tpu.utils import faults
 
-        client = EdgeClient(upstream, connections=n_conns)
+        faults.configure_from_env()
+        metrics = Metrics()
+        client = EdgeClient(
+            upstream,
+            connections=n_conns,
+            timeout_s=parse_duration_s(
+                os.environ.get("GUBER_EDGE_TIMEOUT", ""), 30.0
+            ),
+            timeout_counter=metrics.edge_call_timeouts,
+        )
         server = grpc.aio.server()
         server.add_generic_rpc_handlers(
             (edge_v1_handler(EdgeV1Servicer(client)),)
@@ -82,7 +94,7 @@ def main() -> None:
         if http_listen:
             from aiohttp import web
 
-            http_runner = web.AppRunner(build_edge_app(client))
+            http_runner = web.AppRunner(build_edge_app(client, metrics=metrics))
             await http_runner.setup()
             site = web.TCPSite(http_runner, hhost, hport)
             await site.start()

@@ -1,6 +1,16 @@
 """Container healthcheck probe: `python -m gubernator_tpu.cmd.healthcheck`
 (reference cmd/healthcheck/main.go): GET /v1/HealthCheck, exit 0 iff
-healthy."""
+healthy.
+
+Address resolution (first match wins):
+    --url                              explicit probe URL
+    GUBER_STATUS_HTTP_ADDRESS          the no-mTLS status listener exists
+    (alias GUBER_STATUS_LISTEN_ADDRESS) precisely for probes — an mTLS
+                                       deployment's main gateway would
+                                       reject a certless probe
+    GUBER_HTTP_ADDRESS                 main HTTP gateway
+    127.0.0.1:80                       reference default
+"""
 
 from __future__ import annotations
 
@@ -11,15 +21,28 @@ import sys
 import urllib.request
 
 
-def main() -> int:
-    p = argparse.ArgumentParser()
-    p.add_argument(
-        "--url",
-        default=f"http://{os.environ.get('GUBER_HTTP_ADDRESS', '127.0.0.1:80')}/v1/HealthCheck",
+def default_url() -> str:
+    addr = (
+        os.environ.get("GUBER_STATUS_HTTP_ADDRESS")
+        or os.environ.get("GUBER_STATUS_LISTEN_ADDRESS")
+        or os.environ.get("GUBER_HTTP_ADDRESS")
+        or "127.0.0.1:80"
     )
-    args = p.parse_args()
+    return f"http://{addr}/v1/HealthCheck"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default=default_url())
+    p.add_argument(
+        "--timeout",
+        type=float,
+        default=5.0,
+        help="probe timeout in seconds (default 5)",
+    )
+    args = p.parse_args(argv)
     try:
-        with urllib.request.urlopen(args.url, timeout=5) as resp:
+        with urllib.request.urlopen(args.url, timeout=args.timeout) as resp:
             body = json.loads(resp.read())
     except Exception as e:
         print(f"unhealthy: {e}", file=sys.stderr)

@@ -378,6 +378,36 @@ class Metrics:
             "Retries while forwarding requests to another peer.",
         )
 
+        # Fault domain (docs/robustness.md; no reference analog — the
+        # reference burns 5 serial timeouts per request on a dead owner)
+        self.circuit_state = Gauge(
+            "gubernator_circuit_state",
+            "Per-peer circuit breaker state: 0 closed, 1 half-open, "
+            "2 open.",
+            ["peer"],
+            registry=r,
+        )
+        self.circuit_transitions = counter(
+            "gubernator_circuit_transitions",
+            "Circuit breaker state transitions, by peer and target state.",
+            ["peer", "to"],
+        )
+        self.degraded_local_answers = counter(
+            "gubernator_degraded_local_answers",
+            "Forwarded checks answered from local state because the "
+            "owner's circuit was open (GUBER_OWNER_UNREACHABLE=local).",
+        )
+        self.forward_deadline_exceeded = counter(
+            "gubernator_forward_deadline_exceeded",
+            "Forwarded checks that exhausted their deadline budget "
+            "before any peer answered.",
+        )
+        self.edge_call_timeouts = counter(
+            "gubernator_edge_call_timeouts",
+            "Edge-tier frame calls that timed out waiting on the device "
+            "daemon (edge processes expose this on their own /metrics).",
+        )
+
         # GLOBAL behavior (reference global.go:50-67)
         self.broadcast_duration = Summary(
             "gubernator_broadcast_duration",
@@ -414,6 +444,19 @@ class Metrics:
         self.global_broadcast_errors = counter(
             "gubernator_global_broadcast_errors",
             "Failed GLOBAL broadcast pushes to peers.",
+        )
+        self.global_send_dropped = counter(
+            "gubernator_global_send_dropped",
+            "Aggregated GLOBAL hits dropped from the hit-update queue, "
+            "by reason: no_peer (picker raised) or requeue_cap (aged "
+            "past the redelivery bound).",
+            ["reason"],
+        )
+        self.global_requeued_hits = counter(
+            "gubernator_global_requeued_hits",
+            "Aggregated GLOBAL hits merged back into the hit-update "
+            "queue after a failed flush leg (redelivered once the "
+            "owner recovers).",
         )
         # ICI replica-tier overflow (no reference analog: its owner cache
         # is LRU-unbounded-by-group, lrucache.go; a W-way replica table

@@ -110,11 +110,22 @@ class GlobalManager:
         # state and asyncio events are loop-affine — off-loop producers
         # must enter via queue_from_thread.
         self._loop = asyncio.get_running_loop()
+        # Redelivery bookkeeping: failed hit-update legs merge back into
+        # the hit queue with bounded aging — key -> failed send attempts
+        # (circuit-open skips do not age; docs/robustness.md).
+        self._requeue_counts: Dict[str, int] = {}
+        self._requeue_limit = getattr(behaviors, "global_requeue_limit", 10)
+        self._requeue_max_keys = getattr(
+            behaviors, "global_requeue_max_keys", 10_000
+        )
         m = svc.metrics
 
         def hits_error(take, e):
+            # Whole-flush failure (the per-leg path catches its own
+            # errors, so this is the backstop): requeue, never drop.
             log.exception("GLOBAL hit-update flush failed")
             m.global_send_errors.inc()
+            self._requeue_hits(list(take.values()), aged=True)
             with tracing.span(
                 "globalManager.sendHits.error", level="ERROR", error=str(e)
             ):
@@ -190,26 +201,79 @@ class GlobalManager:
 
     # -- send hits to owners (reference global.go:144-187) -------------------
 
+    def _requeue_hits(self, reqs, aged: bool = True) -> None:
+        """Merge failed hit-update legs back into the hit queue.
+        Bounded aging: a key survives at most `global_requeue_limit`
+        failed send ATTEMPTS (aged=False circuit-open skips are free —
+        no send happened), and at most `global_requeue_max_keys` keys
+        are held; past either cap the hits drop with a counter instead
+        of silently (the pre-redelivery behavior lost them always)."""
+        m = self.svc.metrics
+        items = self._hits_q.items
+        requeued = 0
+        for r in reqs:
+            key = r.hash_key()
+            attempts = self._requeue_counts.get(key, 0) + (1 if aged else 0)
+            existing = items.get(key)
+            if attempts > self._requeue_limit or (
+                existing is None and len(items) >= self._requeue_max_keys
+            ):
+                m.global_send_dropped.labels("requeue_cap").inc(max(r.hits, 1))
+                self._requeue_counts.pop(key, None)
+                continue
+            if existing is not None:
+                existing.hits += r.hits
+            else:
+                items[key] = r
+            self._requeue_counts[key] = attempts
+            requeued += r.hits
+        if requeued:
+            m.global_requeued_hits.inc(requeued)
+            self._hits_q.notify()
+
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
         t0 = time.perf_counter()
         self.svc.metrics.global_send_keys.observe(len(hits))
+        failed = []  # (reqs, aged) legs to merge back into the queue
+        dropped_no_peer = 0
         try:
             by_peer: Dict[str, tuple] = {}
             for key, r in hits.items():
                 try:
                     peer = self.svc.picker.get(key)
                 except Exception:
+                    # These hits used to vanish with no trace; count
+                    # them and log once per flush below.
+                    dropped_no_peer += max(r.hits, 1)
+                    self.svc.metrics.global_send_dropped.labels(
+                        "no_peer"
+                    ).inc(max(r.hits, 1))
+                    self._requeue_counts.pop(key, None)
                     continue
                 addr = peer.info.grpc_address
                 if addr in by_peer:
                     by_peer[addr][1].append(r)
                 else:
                     by_peer[addr] = (peer, [r])
+            if dropped_no_peer:
+                log.warning(
+                    "GLOBAL hit-update flush dropped %d hit(s): peer "
+                    "picker has no owner (empty ring or lookup failure)",
+                    dropped_no_peer,
+                )
 
             sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
 
             async def send(peer, reqs):
                 async with sem:
+                    breaker = getattr(peer, "breaker", None)
+                    if breaker is not None and not breaker.allow():
+                        # Known-dead owner: requeue without burning a
+                        # timeout. The skip does not age the keys, so
+                        # hits survive an outage as long as the breaker
+                        # holds the circuit open.
+                        failed.append((reqs, False))
+                        return
                     try:
                         await peer.get_peer_rate_limits(
                             reqs, timeout=self.b.global_timeout_s
@@ -224,9 +288,15 @@ class GlobalManager:
                             self.svc.forwarder.record_error(
                                 f"global send to {peer.info.grpc_address}: {e}"
                             )
+                        failed.append((reqs, True))
+                        return
+                    for r in reqs:
+                        self._requeue_counts.pop(r.hash_key(), None)
 
             await asyncio.gather(*(send(p, rs) for p, rs in by_peer.values()))
         finally:
+            for reqs, aged in failed:
+                self._requeue_hits(reqs, aged=aged)
             self.svc.metrics.global_send_duration.observe(time.perf_counter() - t0)
 
     # -- broadcast to replicas (reference global.go:234-283) -----------------
@@ -277,6 +347,15 @@ class GlobalManager:
 
             async def push(peer):
                 async with sem:
+                    breaker = getattr(peer, "breaker", None)
+                    if breaker is not None and not breaker.allow():
+                        # Dead replica: skip the push instead of burning
+                        # a timeout; the leg still counts as failed so a
+                        # shedding fan-out stays observable. The replica
+                        # reconverges from the first broadcast after its
+                        # circuit closes.
+                        self.svc.metrics.global_broadcast_errors.inc()
+                        return
                     try:
                         await peer.update_peer_globals(
                             globals_, timeout=self.b.global_timeout_s

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import dataclasses
+import random
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -38,9 +40,15 @@ from gubernator_tpu.parallel.region import RegionPicker
 from gubernator_tpu.service import pb
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.rpc import PeersV1Stub
-from gubernator_tpu.utils import tracing
+from gubernator_tpu.utils import clock as _clock
+from gubernator_tpu.utils import faults, tracing
+from gubernator_tpu.utils.breaker import STATE_NAMES, CircuitBreaker
 
 _ERROR_TTL_S = 300.0  # reference: 5-minute TTL error cache
+
+
+class CircuitOpenError(RuntimeError):
+    """The owner's circuit breaker is open and degraded mode is off."""
 
 
 class Peer:
@@ -62,6 +70,26 @@ class Peer:
         self._queue: Optional[asyncio.Queue] = None
         self._pump_task: Optional[asyncio.Task] = None
         self._closed = False
+        # Per-peer circuit breaker: every transport outcome (RPC or
+        # injected fault) is recorded here; forward() and the GLOBAL
+        # legs gate on allow() so a dead peer costs one failure burst,
+        # not a timeout per request (docs/robustness.md).
+        self.breaker = CircuitBreaker(
+            failure_threshold=getattr(behaviors, "circuit_failure_threshold", 5),
+            open_base_s=getattr(behaviors, "circuit_open_base_s", 0.5),
+            open_max_s=getattr(behaviors, "circuit_open_max_s", 30.0),
+            half_open_probes=getattr(behaviors, "circuit_half_open_probes", 1),
+            rng=random.random,
+            on_transition=self._on_breaker_transition,
+        )
+
+    def _on_breaker_transition(self, old: int, new: int) -> None:
+        m = self.metrics
+        if m is None or not hasattr(m, "circuit_transitions"):
+            return
+        addr = self.info.grpc_address
+        m.circuit_transitions.labels(addr, STATE_NAMES[new]).inc()
+        m.circuit_state.labels(addr).set(new)
 
     # -- transport -----------------------------------------------------------
 
@@ -85,16 +113,20 @@ class Peer:
 
     # -- API -----------------------------------------------------------------
 
-    async def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
+    async def get_peer_rate_limit(
+        self, req: RateLimitReq, timeout: Optional[float] = None
+    ) -> RateLimitResp:
         """Single check via the peer's batch queue (reference
-        peer_client.go:125-162); NO_BATCHING bypasses the queue."""
+        peer_client.go:125-162); NO_BATCHING bypasses the queue.
+        `timeout` is the caller's remaining deadline budget — the wait
+        on the batch future never exceeds it."""
         if has_behavior(req.behavior, Behavior.NO_BATCHING) or getattr(
             self.behaviors, "disable_batching", False
         ):
             # Per-request NO_BATCHING, or the daemon-wide kill switch
             # (reference Behaviors.DisableBatching / GUBER_DISABLE_BATCHING,
             # peer_client.go:128-133).
-            out = await self.get_peer_rate_limits([req])
+            out = await self.get_peer_rate_limits([req], timeout=timeout)
             return out[0]
         if self._closed:
             # Peer was removed by a membership change; the caller's retry
@@ -104,11 +136,32 @@ class Peer:
         fut = asyncio.get_running_loop().create_future()
         await q.put((req, fut))
         # Upper bound so a request can never hang if the pump dies between
-        # the _closed check and the put (shutdown race).
-        return await asyncio.wait_for(fut, self.behaviors.batch_timeout_s * 2 + 1.0)
+        # the _closed check and the put (shutdown race); a tighter caller
+        # deadline wins.
+        bound = self.behaviors.batch_timeout_s * 2 + 1.0
+        if timeout is not None:
+            bound = min(bound, max(timeout, 1e-3))
+        return await asyncio.wait_for(fut, bound)
 
     async def get_peer_rate_limits(
         self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
+    ) -> List[RateLimitResp]:
+        # Breaker + fault hook wrap the raw RPC so every transport
+        # outcome (real or injected) is recorded exactly once, from
+        # every caller: the batch pump, forward()'s NO_BATCHING path,
+        # and the GLOBAL/region flush legs.
+        try:
+            if faults.active():
+                await faults.inject(self.info.grpc_address, faults.OP_PEER_CHECK)
+            out = await self._rpc_get_peer_rate_limits(reqs, timeout)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return out
+
+    async def _rpc_get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq], timeout: Optional[float]
     ) -> List[RateLimitResp]:
         stub = self._ensure_stub()
         msg = pb.peers_pb.GetPeerRateLimitsReq()
@@ -128,6 +181,18 @@ class Peer:
 
     async def update_peer_globals(
         self, globals_: Sequence[UpdatePeerGlobal], timeout: Optional[float] = None
+    ) -> None:
+        try:
+            if faults.active():
+                await faults.inject(self.info.grpc_address, faults.OP_PEER_GLOBALS)
+            await self._rpc_update_peer_globals(globals_, timeout)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+
+    async def _rpc_update_peer_globals(
+        self, globals_: Sequence[UpdatePeerGlobal], timeout: Optional[float]
     ) -> None:
         stub = self._ensure_stub()
         msg = pb.peers_pb.UpdatePeerGlobalsReq()
@@ -306,16 +371,70 @@ class PeerMesh:
 
     # -- forwarder interface (reference gubernator.go:311-391) ---------------
 
+    def _deadline_budget_s(self, req: RateLimitReq) -> float:
+        """Per-call deadline budget: an upstream-propagated absolute
+        deadline ("deadline_ms" metadata, epoch ms) wins when tighter
+        than our own forward_deadline_s — a re-forwarded item must honor
+        the original caller's remaining time, not restart the clock."""
+        budget = getattr(self.behaviors, "forward_deadline_s", 2.0)
+        raw = (req.metadata or {}).get("deadline_ms")
+        if raw:
+            try:
+                remaining = (int(raw) - _clock.now_ms()) / 1000.0
+            except ValueError:
+                return budget
+            return max(0.0, min(remaining, budget))
+        return budget
+
     async def forward(self, peer: Peer, req: RateLimitReq) -> RateLimitResp:
+        """Retry loop with owner re-resolution, bounded by a deadline
+        budget shared across retries (not multiplied per leg) and by the
+        target peer's circuit breaker. When the owner's circuit is open,
+        either fail fast or answer from local state per
+        GUBER_OWNER_UNREACHABLE (docs/robustness.md)."""
         key = req.hash_key()
+        loop = asyncio.get_running_loop()
+        budget_s = self._deadline_budget_s(req)
+        deadline = loop.time() + budget_s
+        # Wire propagation is lazy: items carrying metadata are demoted
+        # off the owner's columnar fast path (fastpath.py), so the
+        # deadline only rides the wire when it is load-bearing — the
+        # caller already propagated one, or a retry leg below has
+        # partially burned the budget.
+        if "deadline_ms" in req.metadata:
+            req.metadata["deadline_ms"] = str(
+                _clock.now_ms() + int(budget_s * 1000)
+            )
         attempts = 0
         while True:
             if peer.info.is_owner:
                 # Ownership migrated to us mid-flight: serve locally.
                 resp = await asyncio.wrap_future(self.svc.engine.check_async(req))
                 return resp
+            if not peer.breaker.allow():
+                # Circuit open: re-resolve once — the ring may have
+                # swapped the owner under us. The loop's own gate above
+                # decides admission for the re-resolved peer (calling
+                # allow() here would consume a half-open probe slot the
+                # next iteration could not re-admit). Same dead peer:
+                # degrade/fail without burning a timeout.
+                repeer = self.get(key)
+                if repeer is not peer:
+                    peer = repeer
+                    continue
+                return await self._owner_unreachable(peer, req)
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                self.svc.metrics.forward_deadline_exceeded.inc()
+                self.record_error(
+                    f"{peer.info.grpc_address}: forward deadline exhausted"
+                )
+                raise TimeoutError(
+                    f"forward deadline ({budget_s:.3f}s) exhausted for "
+                    f"key {key!r}"
+                )
             try:
-                resp = await peer.get_peer_rate_limit(req)
+                resp = await peer.get_peer_rate_limit(req, timeout=remaining)
                 resp.metadata = dict(resp.metadata or {})
                 resp.metadata["owner"] = peer.info.grpc_address
                 return resp
@@ -328,7 +447,50 @@ class PeerMesh:
                     raise
                 attempts += 1
                 self.svc.metrics.batch_send_retries.inc()
+                # Retry legs carry the REMAINING budget on the wire so a
+                # re-forwarding peer cannot restart the clock.
+                req.metadata["deadline_ms"] = str(
+                    _clock.now_ms()
+                    + max(0, int((deadline - loop.time()) * 1000))
+                )
                 peer = self.get(key)
+
+    async def _owner_unreachable(self, peer: Peer, req: RateLimitReq) -> RateLimitResp:
+        """The owner's circuit is open. mode=local answers from local
+        engine state (the degraded-replica argument of "Rethinking HTTP
+        API Rate Limiting") and queues the hits for reconciliation with
+        the owner once its circuit closes; mode=error fails fast."""
+        addr = peer.info.grpc_address
+        mode = getattr(self.behaviors, "owner_unreachable", "error")
+        if mode != "local":
+            self.svc.metrics.check_error_counter.labels(
+                "Owner circuit open"
+            ).inc()
+            raise CircuitOpenError(
+                f"owner {addr} unreachable (circuit open, next probe in "
+                f"{peer.breaker.open_remaining_s():.2f}s)"
+            )
+        resp = await asyncio.wrap_future(self.svc.engine.check_async(req))
+        resp.metadata = dict(resp.metadata or {})
+        resp.metadata["owner"] = addr
+        resp.metadata["degraded"] = "owner-unreachable"
+        self.svc.metrics.degraded_local_answers.inc()
+        if self.svc.global_mgr is not None and req.hits:
+            # Redelivery path: the hit-update queue retries with bounded
+            # aging until the owner's circuit closes (global_sync.py).
+            self.svc.global_mgr.queue_hit(
+                dataclasses.replace(req, metadata=dict(req.metadata))
+            )
+        return resp
+
+    def breaker_summary(self) -> Dict[str, str]:
+        """{peer address -> breaker state name} for every remote peer
+        (HealthCheck message + the /readyz readiness probe)."""
+        return {
+            addr: p.breaker.state_name
+            for addr, p in self._all.items()
+            if not p.info.is_owner
+        }
 
     def queued_batch_items(self) -> int:
         """Total rate checks sitting in per-peer batch queues (the
@@ -386,6 +548,16 @@ def wire_peers(daemon, global_mode: str = "grpc") -> None:
     svc.metrics.add_sync(
         lambda m, mesh=mesh: m.batch_queue_length.set(mesh.queued_batch_items())
     )
+
+    def _sync_breakers(m, mesh=mesh):
+        # Transition callbacks keep the gauge fresh on change; this
+        # scrape-time pass covers peers added by a ring swap before
+        # their first transition.
+        for addr, p in list(mesh._all.items()):
+            if not p.info.is_owner:
+                m.circuit_state.labels(addr).set(p.breaker.state)
+
+    svc.metrics.add_sync(_sync_breakers)
     # Two-tier GLOBAL: the gRPC global manager always runs the HOST tier
     # (pod-to-pod hit aggregation + broadcast); in "ici" mode the engine's
     # collective sync thread additionally runs the device tier within the

@@ -33,6 +33,46 @@ class BehaviorConfig:
     # peer_client.go:128-133).
     disable_batching: bool = False
 
+    # -- fault-domain knobs (docs/robustness.md; no reference analog: the
+    # reference retries a dead owner 5x back-to-back with no backoff) ----
+
+    # Per-call deadline budget for the forwarding path: retries share
+    # this budget instead of multiplying per-leg timeouts. Propagated to
+    # the owning peer via request metadata ("deadline_ms", absolute epoch
+    # ms) so a re-forwarded item honors the original caller's remaining
+    # time.
+    forward_deadline_s: float = 2.0
+
+    # Per-peer circuit breaker (utils/breaker.py): trip after this many
+    # consecutive transport failures, hold open for an exponential
+    # backoff (base doubling per consecutive trip, capped, ±10% jitter),
+    # then admit `circuit_half_open_probes` trial calls.
+    circuit_failure_threshold: int = 5
+    circuit_open_base_s: float = 0.5
+    circuit_open_max_s: float = 30.0
+    circuit_half_open_probes: int = 1
+
+    # What the forwarding path does when the owner's circuit is open
+    # (GUBER_OWNER_UNREACHABLE): "error" fails fast; "local" answers
+    # from local engine state (eventual-consistency caveats in
+    # docs/robustness.md) and queues the hits for reconciliation with
+    # the owner once its circuit closes.
+    owner_unreachable: str = "error"
+
+    # GLOBAL hit-update redelivery: a failed flush leg is merged back
+    # into the hit queue instead of dropped. Each key survives at most
+    # `global_requeue_limit` failed *send attempts* (circuit-open skips
+    # do not age a key — no send was attempted), and at most
+    # `global_requeue_max_keys` keys are held for redelivery; past
+    # either cap, hits drop with the gubernator_global_send_dropped
+    # counter.
+    global_requeue_limit: int = 10
+    global_requeue_max_keys: int = 10_000
+
+    # Edge-tier frame-call timeout (GUBER_EDGE_TIMEOUT): was a
+    # hard-coded 30.0 in EdgeClient.call.
+    edge_timeout_s: float = 30.0
+
 
 @dataclasses.dataclass
 class EtcdConfig:

@@ -56,6 +56,10 @@ class Daemon:
         # it); the CLI entry point applies conf.trace_level. A library
         # Daemon must not clobber other in-process daemons' tracing.
         conf = self.conf
+        # Chaos-testing fault rules (GUBER_FAULTS); no-op when unset.
+        from gubernator_tpu.utils import faults
+
+        faults.configure_from_env()
         if conf.global_mode == "ici":
             from gubernator_tpu.runtime.ici_engine import IciEngine, IciEngineConfig
 

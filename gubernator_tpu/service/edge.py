@@ -200,10 +200,24 @@ class EdgeListener:
 
 class EdgeClient:
     """Multiplexed client: N connections to the device daemon, calls
-    matched to responses by call_id. Reconnects lazily on failure."""
+    matched to responses by call_id. Reconnects lazily on failure.
 
-    def __init__(self, address: str, connections: int = 2):
+    `timeout_s` is the default per-call deadline (sourced from
+    BehaviorConfig.edge_timeout_s / GUBER_EDGE_TIMEOUT by the edge
+    entry point; it was a hard-coded 30.0). `timeout_counter` is any
+    .inc()-able — timed-out calls bump it so edge-tier stalls are
+    observable at the edge's /metrics."""
+
+    def __init__(
+        self,
+        address: str,
+        connections: int = 2,
+        timeout_s: float = 30.0,
+        timeout_counter=None,
+    ):
         self.address = address
+        self.timeout_s = timeout_s
+        self.timeout_counter = timeout_counter
         self._n = max(1, connections)
         self._conns: list = [None] * self._n
         self._locks = [asyncio.Lock() for _ in range(self._n)]
@@ -244,7 +258,18 @@ class EdgeClient:
                         EdgeError("UNAVAILABLE", "device daemon connection lost")
                     )
 
-    async def call(self, method: int, payload: bytes, timeout: float = 30.0) -> bytes:
+    async def call(
+        self, method: int, payload: bytes, timeout: Optional[float] = None
+    ) -> bytes:
+        from gubernator_tpu.utils import faults
+
+        if timeout is None:
+            timeout = self.timeout_s
+        if faults.active():
+            try:
+                await faults.inject(faults.EDGE_TARGET, faults.OP_EDGE_CALL)
+            except faults.FaultInjected as e:
+                raise EdgeError("UNAVAILABLE", str(e))
         i = next(self._rr) % self._n
         async with self._locks[i]:
             conn = self._conns[i]
@@ -273,6 +298,8 @@ class EdgeClient:
             conn["dead"] = True
             raise EdgeError("UNAVAILABLE", f"device daemon connection lost: {e}")
         except asyncio.TimeoutError:
+            if self.timeout_counter is not None:
+                self.timeout_counter.inc()
             raise EdgeError("DEADLINE_EXCEEDED", "device daemon call timed out")
         finally:
             # no-op on the happy path (the pump pops before resolving);
@@ -335,10 +362,13 @@ _EDGE_JSON_CODES = {  # gRPC status numbers for the JSON error body
 }
 
 
-def build_edge_app(client: EdgeClient):
+def build_edge_app(client: EdgeClient, metrics=None):
     """aiohttp app mirroring the daemon's HTTP/JSON gateway
     (service/gateway.py) over the framed upstream — the edge presents
-    the daemon's full client-facing surface (gRPC + JSON + /healthz)."""
+    the daemon's full client-facing surface (gRPC + JSON + /healthz).
+    With `metrics` (a gubernator_tpu.metrics.Metrics), the edge also
+    serves its own /metrics — edge-local series like
+    gubernator_edge_call_timeouts live here, not on the daemon."""
     from aiohttp import web
 
     from gubernator_tpu.service import pb
@@ -402,6 +432,14 @@ def build_edge_app(client: EdgeClient):
     app.router.add_post("/v1/GetRateLimits", get_rate_limits)
     app.router.add_get("/v1/HealthCheck", health_check)
     app.router.add_get("/healthz", healthz)
+    if metrics is not None:
+
+        async def metrics_route(request: web.Request) -> web.Response:
+            return web.Response(
+                body=metrics.render(), content_type="text/plain", charset="utf-8"
+            )
+
+        app.router.add_get("/metrics", metrics_route)
     return app
 
 

@@ -99,7 +99,22 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         ),
         force_global=_env_bool("GUBER_FORCE_GLOBAL"),
         disable_batching=_env_bool("GUBER_DISABLE_BATCHING"),
+        # Fault-domain knobs (docs/robustness.md)
+        forward_deadline_s=parse_duration_s(_env("GUBER_FORWARD_DEADLINE"), 2.0),
+        circuit_failure_threshold=_env_int("GUBER_CIRCUIT_FAILURE_THRESHOLD", 5),
+        circuit_open_base_s=parse_duration_s(_env("GUBER_CIRCUIT_OPEN_BASE"), 0.5),
+        circuit_open_max_s=parse_duration_s(_env("GUBER_CIRCUIT_OPEN_MAX"), 30.0),
+        circuit_half_open_probes=_env_int("GUBER_CIRCUIT_HALF_OPEN_PROBES", 1),
+        owner_unreachable=_env("GUBER_OWNER_UNREACHABLE", "error").lower(),
+        global_requeue_limit=_env_int("GUBER_GLOBAL_REQUEUE_LIMIT", 10),
+        global_requeue_max_keys=_env_int("GUBER_GLOBAL_REQUEUE_MAX_KEYS", 10_000),
+        edge_timeout_s=parse_duration_s(_env("GUBER_EDGE_TIMEOUT"), 30.0),
     )
+    if behaviors.owner_unreachable not in ("error", "local"):
+        raise ValueError(
+            f"'GUBER_OWNER_UNREACHABLE={behaviors.owner_unreachable}' is "
+            "invalid; choices are [error, local]"
+        )
 
     conf = DaemonConfig(
         instance_id=_env("GUBER_INSTANCE_ID", ""),

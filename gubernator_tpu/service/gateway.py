@@ -95,6 +95,33 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/profile", debug_profile)
 
 
+def add_probe_routes(app: web.Application, svc: V1Service) -> None:
+    """/livez + /readyz (docs/robustness.md). /healthz keeps the
+    reference's TTL'd-error semantics for back-compat, but it conflates
+    liveness with mesh health: one flapping peer 503s the node for the
+    full 5-minute error TTL, so a restart-on-liveness orchestrator
+    would bounce a healthy process. The split:
+
+    - /livez: process liveness only — 200 while the event loop serves.
+    - /readyz: breaker-derived readiness — 200 "ready" (all circuits
+      closed), 200 "degraded" (some open; surviving keys still serve),
+      503 "unready" (every peer circuit open). Flips degraded -> ready
+      without a restart the moment a returning peer's circuit closes.
+    """
+
+    async def livez(request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def readyz(request: web.Request) -> web.Response:
+        r = svc.readiness()
+        return web.json_response(
+            r, status=503 if r["status"] == "unready" else 200
+        )
+
+    app.router.add_get("/livez", livez)
+    app.router.add_get("/readyz", readyz)
+
+
 async def read_json_requests(request: web.Request):
     """Parse + validate a /v1/GetRateLimits JSON body.
 
@@ -160,6 +187,7 @@ def build_app(svc: V1Service) -> web.Application:
     app.router.add_get("/v1/HealthCheck", health_check)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    add_probe_routes(app, svc)
     add_debug_routes(app, svc)
     return app
 
@@ -176,5 +204,6 @@ def build_status_app(svc: V1Service) -> web.Application:
         return web.json_response(pb.health_to_json(h))
 
     app.router.add_get("/v1/HealthCheck", health_check)
+    add_probe_routes(app, svc)
     add_debug_routes(app, svc)
     return app

@@ -271,17 +271,59 @@ class V1Service:
     async def health_check(self) -> HealthCheckResp:
         errors: List[str] = []
         peer_count = 0
+        open_circuits: List[str] = []
         if self.picker is not None:
             peer_count = len(self.picker.peers())
             if hasattr(self.picker, "region_peers"):
                 peer_count += len(self.picker.region_peers())
             if self.forwarder is not None:
                 errors = self.forwarder.recent_errors()
+                if hasattr(self.forwarder, "breaker_summary"):
+                    open_circuits = sorted(
+                        a
+                        for a, s in self.forwarder.breaker_summary().items()
+                        if s != "closed"
+                    )
         if errors:
+            msg = "; ".join(errors[:3])
+            if open_circuits:
+                # Breaker summary rides the reference-shaped message so
+                # probes see WHICH fault domain is dark, not just that
+                # errors happened in the last 5 minutes.
+                msg = f"circuits open: {', '.join(open_circuits)}; {msg}"
             return HealthCheckResp(
-                status="unhealthy", message="; ".join(errors[:3]), peer_count=peer_count
+                status="unhealthy", message=msg, peer_count=peer_count
             )
         return HealthCheckResp(status="healthy", peer_count=peer_count)
+
+    def readiness(self) -> dict:
+        """Readiness for the /readyz probe (docs/robustness.md): unlike
+        the TTL'd error log feeding health_check — where one flapping
+        peer marks the node unhealthy for a full 5 minutes — readiness
+        derives from CURRENT breaker state, so it flips back the moment
+        a dead peer's circuit closes.
+
+        ready    — every peer circuit closed (or no mesh at all)
+        degraded — some circuits open; keys owned by surviving peers
+                   still serve within SLO
+        unready  — every remote peer's circuit is open (the node cannot
+                   reach any fault domain but its own)
+        """
+        summary = {}
+        if self.forwarder is not None and hasattr(self.forwarder, "breaker_summary"):
+            summary = self.forwarder.breaker_summary()
+        open_circuits = sorted(a for a, s in summary.items() if s == "open")
+        if summary and len(open_circuits) == len(summary):
+            status = "unready"
+        elif open_circuits:
+            status = "degraded"
+        else:
+            status = "ready"
+        return {
+            "status": status,
+            "peers": len(summary),
+            "open_circuits": open_circuits,
+        }
 
     # ---- peer membership (reference gubernator.go:616-711) -----------------
 

@@ -1,0 +1,123 @@
+"""Per-peer circuit breaker: closed -> open -> half-open.
+
+The forwarding path's failure mode without this is serial timeout burn:
+a dead owner eats `batch_timeout_s` per retry per request until the
+discovery ring swaps (parallel/peers.py history; "Designing Scalable
+Rate Limiting Systems" calls this the owner-unavailability pivot). The
+breaker sheds a dead peer after `failure_threshold` consecutive
+transport failures, then probes it on an exponential-backoff schedule
+with jitter so a rejoining peer is readmitted without a thundering herd
+of probes.
+
+States (gauge encoding in metrics.py):
+    0 CLOSED     normal traffic; consecutive failures counted.
+    2 OPEN       all calls rejected until the backoff deadline passes.
+    1 HALF_OPEN  up to `half_open_probes` trial calls admitted; one
+                 success closes the breaker, one failure re-opens it
+                 with a doubled backoff.
+
+Time and RNG are injectable for deterministic tests. Single event-loop
+discipline: the breaker is mutated only from the owning daemon's loop
+(same affinity rule as the batch queues), so there is no lock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+CLOSED = 0
+HALF_OPEN = 1
+OPEN = 2
+
+STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        open_base_s: float = 0.5,
+        open_max_s: float = 30.0,
+        half_open_probes: int = 1,
+        jitter: float = 0.1,
+        time_fn: Callable[[], float] = time.monotonic,
+        rng: Optional[Callable[[], float]] = None,
+        on_transition: Optional[Callable[[int, int], None]] = None,
+    ):
+        self.failure_threshold = max(1, failure_threshold)
+        self.open_base_s = open_base_s
+        self.open_max_s = open_max_s
+        self.half_open_probes = max(1, half_open_probes)
+        self.jitter = jitter
+        self._time = time_fn
+        self._rng = rng  # () -> [0,1); None = no jitter randomness source
+        self._on_transition = on_transition
+        self.state = CLOSED
+        self._failures = 0  # consecutive failures while CLOSED
+        self._trips = 0  # consecutive OPEN trips (backoff exponent)
+        self._open_until = 0.0
+        self._probes_used = 0
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def state_name(self) -> str:
+        return STATE_NAMES[self.state]
+
+    def open_remaining_s(self) -> float:
+        if self.state != OPEN:
+            return 0.0
+        return max(0.0, self._open_until - self._time())
+
+    # -- state machine -------------------------------------------------------
+
+    def _transition(self, new_state: int) -> None:
+        old, self.state = self.state, new_state
+        if old != new_state and self._on_transition is not None:
+            self._on_transition(old, new_state)
+
+    def _backoff_s(self) -> float:
+        base = min(self.open_max_s, self.open_base_s * (2 ** max(0, self._trips - 1)))
+        if self.jitter and self._rng is not None:
+            base *= 1.0 + self.jitter * (2.0 * self._rng() - 1.0)
+        return base
+
+    def allow(self) -> bool:
+        """May a call be attempted now? OPEN past its backoff deadline
+        admits a half-open probe; HALF_OPEN admits up to the probe
+        budget (in-flight probes count until they resolve)."""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            if self._time() < self._open_until:
+                return False
+            self._probes_used = 0
+            self._transition(HALF_OPEN)
+        # HALF_OPEN
+        if self._probes_used >= self.half_open_probes:
+            return False
+        self._probes_used += 1
+        return True
+
+    def record_success(self) -> None:
+        self._failures = 0
+        if self.state != CLOSED:
+            self._trips = 0
+            self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        if self.state == HALF_OPEN:
+            # Probe failed: back off harder.
+            self._trips += 1
+            self._open_until = self._time() + self._backoff_s()
+            self._transition(OPEN)
+            return
+        if self.state == OPEN:
+            return  # stray failure from a call admitted before the trip
+        self._failures += 1
+        if self._failures >= self.failure_threshold:
+            self._failures = 0
+            self._trips += 1
+            self._open_until = self._time() + self._backoff_s()
+            self._transition(OPEN)

@@ -1,0 +1,194 @@
+"""Deterministic fault-injection harness for the peer mesh and edge tier.
+
+Chaos testing needs faults that are *reproducible*: a seeded RNG decides
+probabilistic drops, rules carry explicit injection budgets, and the
+latency sleep function is injectable so unit tests can count delays
+without real waits. Production pays one `active()` branch per hook when
+no rules are loaded (docs/robustness.md).
+
+Rules match on (target, op):
+
+- target: a peer gRPC address (Peer RPC hooks), the literal "edge"
+  (EdgeClient frame calls), or "*".
+- op: "get_peer_rate_limits" | "update_peer_globals" | "edge_call" | "*".
+
+Effects per matched rule, applied in order:
+
+- latency_s: await an injected sleep before the call proceeds.
+- error_rate: probability (seeded RNG; 1.0 = full partition) of raising
+  FaultInjected instead of performing the call.
+- max_injections: stop firing after N injections (latency or error),
+  for flap/brownout scripts that must end deterministically.
+
+Env configuration (read once by Daemon.start via configure_from_env):
+
+    GUBER_FAULTS=target=127.0.0.1:81,op=*,error=1.0;target=edge,latency=50ms
+    GUBER_FAULTS_SEED=42
+
+Each ';'-separated clause is one rule of ','-separated k=v pairs
+(keys: target, op, error, latency, count, message). Durations accept
+Go-style suffixes via envconfig.parse_duration_s.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import os
+import random
+from typing import Callable, List, Optional
+
+log = logging.getLogger("gubernator_tpu.faults")
+
+OP_PEER_CHECK = "get_peer_rate_limits"
+OP_PEER_GLOBALS = "update_peer_globals"
+OP_EDGE_CALL = "edge_call"
+EDGE_TARGET = "edge"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the harness in place of a real transport failure."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    target: str = "*"
+    op: str = "*"
+    latency_s: float = 0.0
+    error_rate: float = 0.0
+    max_injections: Optional[int] = None
+    message: str = "injected fault"
+    injected: int = 0  # mutated by the injector
+
+    def matches(self, target: str, op: str) -> bool:
+        if self.max_injections is not None and self.injected >= self.max_injections:
+            return False
+        return self.target in ("*", target) and self.op in ("*", op)
+
+
+class FaultInjector:
+    """Rule store + application point. One module-level instance is
+    shared process-wide (the in-process cluster fixture relies on that:
+    one injector partitions one daemon from every other daemon's Peer
+    clients)."""
+
+    def __init__(self, seed: int = 0, sleep: Optional[Callable] = None):
+        self._rules: List[FaultRule] = []
+        self._rng = random.Random(seed)
+        self._sleep = sleep or asyncio.sleep
+
+    # -- configuration -------------------------------------------------------
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        self._rules.append(rule)
+        return rule
+
+    def partition(self, target: str, op: str = "*") -> FaultRule:
+        """Convenience: full partition of one target (every matched call
+        fails)."""
+        return self.add_rule(FaultRule(target=target, op=op, error_rate=1.0,
+                                       message=f"partition: {target}"))
+
+    def clear(self) -> None:
+        self._rules.clear()
+
+    def reseed(self, seed: int) -> None:
+        self._rng = random.Random(seed)
+
+    @property
+    def rules(self) -> List[FaultRule]:
+        return list(self._rules)
+
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    # -- application ---------------------------------------------------------
+
+    async def inject(self, target: str, op: str) -> None:
+        """Apply every matching rule: latency first, then the error
+        decision. Raises FaultInjected when a rule fires an error."""
+        for rule in self._rules:
+            if not rule.matches(target, op):
+                continue
+            fired = False
+            if rule.latency_s > 0:
+                fired = True
+                await self._sleep(rule.latency_s)
+            if rule.error_rate > 0 and (
+                rule.error_rate >= 1.0 or self._rng.random() < rule.error_rate
+            ):
+                rule.injected += 1
+                raise FaultInjected(f"{rule.message} ({target}/{op})")
+            if fired:
+                rule.injected += 1
+
+
+# Process-wide injector: hooks call faults.active()/faults.inject(); the
+# chaos suite and GUBER_FAULTS both configure this instance.
+INJECTOR = FaultInjector()
+
+
+def active() -> bool:
+    return INJECTOR.active()
+
+
+async def inject(target: str, op: str) -> None:
+    await INJECTOR.inject(target, op)
+
+
+def parse_rules(spec: str) -> List[FaultRule]:
+    """Parse a GUBER_FAULTS spec string into rules (see module doc)."""
+    from gubernator_tpu.service.envconfig import parse_duration_s
+
+    rules: List[FaultRule] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        rule = FaultRule()
+        for pair in clause.split(","):
+            if "=" not in pair:
+                raise ValueError(f"bad GUBER_FAULTS clause {clause!r}: "
+                                 f"{pair!r} is not k=v")
+            k, v = (s.strip() for s in pair.split("=", 1))
+            if k == "target":
+                rule.target = v
+            elif k == "op":
+                rule.op = v
+            elif k == "error":
+                rule.error_rate = float(v)
+            elif k == "latency":
+                rule.latency_s = parse_duration_s(v, 0.0)
+            elif k == "count":
+                rule.max_injections = int(v)
+            elif k == "message":
+                rule.message = v
+            else:
+                raise ValueError(f"unknown GUBER_FAULTS key {k!r}")
+        rules.append(rule)
+    return rules
+
+
+_env_loaded = False
+
+
+def configure_from_env() -> None:
+    """Load GUBER_FAULTS / GUBER_FAULTS_SEED into the process injector
+    (idempotent; no-op when the env var is unset)."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get("GUBER_FAULTS", "")
+    if not spec:
+        return
+    seed = int(os.environ.get("GUBER_FAULTS_SEED", "0"))
+    INJECTOR.reseed(seed)
+    for rule in parse_rules(spec):
+        INJECTOR.add_rule(rule)
+    log.warning(
+        "fault injection ACTIVE from GUBER_FAULTS (%d rule(s), seed=%d) — "
+        "chaos-testing configuration, never production",
+        len(INJECTOR.rules), seed,
+    )

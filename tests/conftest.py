@@ -38,6 +38,27 @@ import threading  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection suite (fast deterministic subset runs "
+        "in tier-1; soak variants are also marked slow)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_rules():
+    """The fault injector is process-global (one instance partitions a
+    whole in-process cluster); rules must never leak across tests."""
+    yield
+    from gubernator_tpu.utils import faults
+
+    faults.INJECTOR.clear()
+
+
 @pytest.fixture
 def frozen_clock():
     from gubernator_tpu.utils import clock

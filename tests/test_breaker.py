@@ -1,0 +1,137 @@
+"""CircuitBreaker state machine (utils/breaker.py): deterministic via
+injected time and RNG — no sleeps, no wall clock."""
+
+import pytest
+
+from gubernator_tpu.utils.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeTime:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def make(clk, **kw):
+    transitions = []
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("open_base_s", 1.0)
+    kw.setdefault("open_max_s", 8.0)
+    kw.setdefault("jitter", 0.0)
+    b = CircuitBreaker(
+        time_fn=clk, on_transition=lambda o, n: transitions.append((o, n)), **kw
+    )
+    return b, transitions
+
+
+def test_trips_after_threshold_consecutive_failures():
+    clk = FakeTime()
+    b, transitions = make(clk)
+    assert b.allow() and b.state == CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == OPEN
+    assert not b.allow()
+    assert transitions == [(CLOSED, OPEN)]
+
+
+def test_success_resets_consecutive_count():
+    clk = FakeTime()
+    b, _ = make(clk)
+    for _ in range(10):  # interleaved successes never trip
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+    assert b.state == CLOSED
+
+
+def test_half_open_probe_budget_and_close():
+    clk = FakeTime()
+    b, transitions = make(clk, half_open_probes=2)
+    for _ in range(3):
+        b.record_failure()
+    assert not b.allow()
+    clk.advance(1.01)  # past the base backoff
+    assert b.allow() and b.state == HALF_OPEN
+    assert b.allow()  # second probe within budget
+    assert not b.allow(), "probe budget must bound half-open traffic"
+    b.record_success()
+    assert b.state == CLOSED and b.allow()
+    assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)]
+
+
+def test_failed_probe_reopens_with_doubled_backoff():
+    clk = FakeTime()
+    b, _ = make(clk)
+    for _ in range(3):
+        b.record_failure()
+    r1 = b.open_remaining_s()
+    assert r1 == pytest.approx(1.0)
+    clk.advance(1.01)
+    assert b.allow()  # half-open probe
+    b.record_failure()
+    assert b.state == OPEN
+    assert b.open_remaining_s() == pytest.approx(2.0)  # doubled
+    # Backoff caps at open_max_s.
+    for _ in range(6):
+        clk.advance(b.open_remaining_s() + 0.01)
+        assert b.allow()
+        b.record_failure()
+    assert b.open_remaining_s() <= 8.0 + 1e-9
+
+
+def test_success_after_reclose_resets_backoff():
+    clk = FakeTime()
+    b, _ = make(clk)
+    for _ in range(3):
+        b.record_failure()
+    clk.advance(1.01)
+    assert b.allow()
+    b.record_success()  # closed again, trip count reset
+    for _ in range(3):
+        b.record_failure()
+    assert b.open_remaining_s() == pytest.approx(1.0), "backoff must reset"
+
+
+def test_jitter_bounds():
+    clk = FakeTime()
+    seq = iter([0.0, 1.0, 0.5])  # rng outputs: min, max, center
+    b = CircuitBreaker(
+        failure_threshold=1,
+        open_base_s=1.0,
+        open_max_s=100.0,
+        jitter=0.1,
+        time_fn=clk,
+        rng=lambda: next(seq),
+    )
+    b.record_failure()
+    assert b.open_remaining_s() == pytest.approx(0.9)  # 1.0 * (1 - 0.1)
+    clk.advance(1.0)
+    assert b.allow()
+    b.record_failure()
+    assert b.open_remaining_s() == pytest.approx(2.0 * 1.1)
+
+
+def test_stray_failure_while_open_is_ignored():
+    clk = FakeTime()
+    b, transitions = make(clk)
+    for _ in range(3):
+        b.record_failure()
+    b.record_failure()  # in-flight call from before the trip resolves late
+    assert b.state == OPEN
+    assert b.open_remaining_s() == pytest.approx(1.0), "no extra backoff"
+    assert transitions == [(CLOSED, OPEN)]

@@ -2,21 +2,30 @@
 surviving cluster, and the failure must be OBSERVABLE (VERDICT r1 item 6;
 the reference logs every failed broadcast leg, global.go:278-281, but has
 no chaos coverage of its own — SURVEY.md §4 gaps).
+
+The deterministic subset (fault-injection harness, utils/faults.py: no
+real process kills, short breaker backoffs) runs in tier-1 under the
+`chaos` marker; soak variants are additionally marked `slow`.
 """
 
 import time
 
 import pytest
+import requests
 
 from gubernator_tpu.api.types import Behavior
 from gubernator_tpu.cluster import Cluster
 from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import faults
 
 from tests.test_global import (
+    LIMIT,
     metric_value,
     send_hit,
     wait_until,
 )
+
+pytestmark = pytest.mark.chaos
 
 NAME = "chaos_global"
 KEY = "ck1"
@@ -30,6 +39,35 @@ def cluster(loop_thread):
     )
     yield c
     loop_thread.run(c.stop())
+
+
+# Fast breaker schedule for the deterministic fault-injection tests:
+# trips after 2 failures, probes every 0.2-0.4s, so recovery fits a
+# test-scale wait without real 30s backoffs.
+FAST_BREAKERS = dict(
+    global_sync_wait_s=0.05,
+    circuit_failure_threshold=2,
+    circuit_open_base_s=0.2,
+    circuit_open_max_s=0.4,
+)
+
+
+@pytest.fixture()
+def fi_cluster(loop_thread):
+    c = loop_thread.run(
+        Cluster.start(3, behaviors=BehaviorConfig(**FAST_BREAKERS)),
+        timeout=120,
+    )
+    yield c
+    faults.INJECTOR.clear()  # heal before teardown so close() is clean
+    loop_thread.run(c.stop())
+
+
+def readyz(daemon) -> dict:
+    r = requests.get(f"http://{daemon.http_address}/readyz", timeout=5)
+    body = r.json()
+    body["_http"] = r.status_code
+    return body
 
 
 def test_daemon_crash_mid_broadcast(cluster, loop_thread):
@@ -84,3 +122,191 @@ def test_daemon_crash_mid_broadcast(cluster, loop_thread):
         return h.get("status") == "unhealthy"
 
     assert wait_until(unhealthy, timeout=5), "owner health missed the dead peer"
+
+    # Liveness is NOT poisoned by the dead peer: /livez on the owner
+    # stays 200 even while /healthz would 503 for the full error TTL.
+    r = requests.get(f"http://{owner.http_address}/livez", timeout=5)
+    assert r.status_code == 200
+
+
+def test_owner_partition_global_hits_requeue_and_reconcile(fi_cluster, loop_thread):
+    """Acceptance: no aggregated GLOBAL hits are lost across a transient
+    (< requeue-cap) owner outage — counter totals reconcile after
+    recovery — and /readyz flips degraded -> ready without a restart."""
+    name, key = "chaos_requeue", "rk1"
+    owner = fi_cluster.find_owning_daemon(name, key)
+    hitter = fi_cluster.list_non_owning_daemons(name, key)[0]
+
+    # Healthy flow first: the initial hits land at the owner.
+    r = send_hit(loop_thread, hitter, name, key, 5)
+    assert r.error == ""
+    assert wait_until(
+        lambda: send_hit(loop_thread, owner, name, key, 0).remaining == LIMIT - 5,
+        timeout=5,
+    ), "healthy hit-update did not reach the owner"
+
+    # Asymmetric partition: every peer's RPCs TOWARD the owner fail;
+    # the owner's own outbound legs (broadcasts) are untouched.
+    faults.INJECTOR.partition(owner.grpc_address)
+
+    sent = 5
+    for _ in range(10):
+        r = send_hit(loop_thread, hitter, name, key, 3)
+        assert r.error == "", "GLOBAL must keep answering from local state"
+        sent += 3
+
+    # The failed flush legs requeue (bounded aging) instead of dropping.
+    assert wait_until(
+        lambda: metric_value(hitter, "gubernator_global_requeued_hits") > 0,
+        timeout=5,
+    ), "failed hit-update flush was not requeued"
+    assert (
+        metric_value(
+            hitter, 'gubernator_global_send_dropped{reason="requeue_cap"}'
+        )
+        == 0
+    ), "hits dropped during a shorter-than-cap outage"
+
+    # The hitter's circuit to the owner opens and /readyz degrades
+    # (but keeps serving: HTTP 200).
+    assert wait_until(
+        lambda: metric_value(
+            hitter, f'gubernator_circuit_state{{peer="{owner.grpc_address}"}}'
+        )
+        == 2,
+        timeout=5,
+    ), "breaker did not open for the partitioned owner"
+    rz = readyz(hitter)
+    assert rz["status"] == "degraded" and rz["_http"] == 200
+    assert owner.grpc_address in rz["open_circuits"]
+
+    # Heal. The next half-open probe closes the circuit and the
+    # requeued hits flush: the owner's counter reconciles to the full
+    # total — nothing lost.
+    faults.INJECTOR.clear()
+    assert wait_until(
+        lambda: send_hit(loop_thread, owner, name, key, 0).remaining
+        == LIMIT - sent,
+        timeout=10,
+    ), "aggregated GLOBAL hits were lost across the outage"
+    assert wait_until(
+        lambda: readyz(hitter)["status"] == "ready", timeout=10
+    ), "/readyz did not flip degraded -> ready after recovery"
+
+
+def test_owner_partition_forward_sheds_fast(fi_cluster, loop_thread):
+    """Owner death mid-forward: after the breaker trips, forwarded
+    checks for the dead owner's keys fail fast (no serial timeout burn)
+    while keys owned by surviving peers keep serving."""
+    name, key = "chaos_fwd", "fk1"
+    owner = fi_cluster.find_owning_daemon(name, key)
+    others = fi_cluster.list_non_owning_daemons(name, key)
+    hitter = others[0]
+
+    # Healthy forward first (non-GLOBAL -> forwarded to the owner).
+    r = send_hit(loop_thread, hitter, name, key, 1, behavior=0)
+    assert r.error == ""
+
+    faults.INJECTOR.partition(owner.grpc_address)
+
+    # Burn the breaker threshold, then expect fast shedding.
+    def circuit_open():
+        r = send_hit(loop_thread, hitter, name, key, 1, behavior=0)
+        return "circuit open" in r.error
+    assert wait_until(circuit_open, timeout=5), "breaker never tripped"
+
+    t0 = time.monotonic()
+    r = send_hit(loop_thread, hitter, name, key, 1, behavior=0)
+    assert "circuit open" in r.error
+    assert time.monotonic() - t0 < 0.5, "open circuit must shed instantly"
+
+    # Keys owned by a SURVIVING peer still serve normally through the
+    # same hitter (forwarded to the third daemon, not the dead owner).
+    survivor = others[1]
+    for i in range(200):
+        k = f"sv{i}"
+        if (
+            fi_cluster.find_owning_daemon(name, k).grpc_address
+            == survivor.grpc_address
+        ):
+            r = send_hit(loop_thread, hitter, name, k, 1, behavior=0)
+            assert r.error == "", "surviving peer's keys must be unaffected"
+            break
+    else:
+        pytest.fail("no key owned by the surviving peer found")
+
+    # Recovery: circuit closes after a successful probe; forwards resume.
+    faults.INJECTOR.clear()
+
+    def recovered():
+        r = send_hit(loop_thread, hitter, name, key, 1, behavior=0)
+        return r.error == ""
+    assert wait_until(recovered, timeout=10), "forwards did not resume"
+
+
+def test_slow_peer_brownout_within_deadline(fi_cluster, loop_thread):
+    """Slow-peer brownout: injected latency below the deadline budget
+    must not error — the deadline bounds the tail instead of the
+    brownout bounding the caller."""
+    name, key = "chaos_slow", "sk1"
+    owner = fi_cluster.find_owning_daemon(name, key)
+    hitter = fi_cluster.list_non_owning_daemons(name, key)[0]
+
+    faults.INJECTOR.add_rule(
+        faults.FaultRule(
+            target=owner.grpc_address,
+            op=faults.OP_PEER_CHECK,
+            latency_s=0.05,
+        )
+    )
+    t0 = time.monotonic()
+    r = send_hit(loop_thread, hitter, name, key, 1, behavior=0)
+    assert r.error == ""
+    assert 0.05 <= time.monotonic() - t0 < 2.0
+    assert metric_value(hitter, "gubernator_forward_deadline_exceeded") == 0
+
+
+@pytest.mark.slow
+def test_flapping_peer_soak(loop_thread):
+    """Soak: a peer flapping through several partition/heal cycles.
+    Hits must survive every transient outage (requeue) and the breaker
+    must re-close after each heal — no wedged state, no lost hits."""
+    c = loop_thread.run(
+        Cluster.start(3, behaviors=BehaviorConfig(**FAST_BREAKERS)),
+        timeout=120,
+    )
+    try:
+        name, key = "chaos_flap", "fl1"
+        owner = c.find_owning_daemon(name, key)
+        hitter = c.list_non_owning_daemons(name, key)[0]
+        sent = 0
+        for cycle in range(4):
+            faults.INJECTOR.partition(owner.grpc_address)
+            for _ in range(5):
+                r = send_hit(loop_thread, hitter, name, key, 2)
+                assert r.error == ""
+                sent += 2
+                time.sleep(0.05)
+            faults.INJECTOR.clear()
+            assert wait_until(
+                lambda: send_hit(loop_thread, owner, name, key, 0).remaining
+                == LIMIT - sent,
+                timeout=10,
+            ), f"hits lost in flap cycle {cycle}"
+        assert wait_until(
+            lambda: metric_value(
+                hitter,
+                f'gubernator_circuit_state{{peer="{owner.grpc_address}"}}',
+            )
+            == 0,
+            timeout=10,
+        ), "breaker wedged open after the last heal"
+        assert (
+            metric_value(
+                hitter, 'gubernator_global_send_dropped{reason="requeue_cap"}'
+            )
+            == 0
+        )
+    finally:
+        faults.INJECTOR.clear()
+        loop_thread.run(c.stop())

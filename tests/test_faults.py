@@ -1,0 +1,133 @@
+"""Fault-injection harness (utils/faults.py): deterministic rule
+matching, seeded probabilistic errors, injected sleep (no real waits),
+and the GUBER_FAULTS env grammar."""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.utils import faults
+from gubernator_tpu.utils.faults import (
+    FaultInjected,
+    FaultInjector,
+    FaultRule,
+    parse_rules,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_inactive_injector_is_noop():
+    inj = FaultInjector()
+    assert not inj.active()
+    run(inj.inject("anything", "get_peer_rate_limits"))  # no raise
+
+
+def test_partition_matches_target_and_op():
+    inj = FaultInjector()
+    inj.partition("10.0.0.1:81", op="get_peer_rate_limits")
+    with pytest.raises(FaultInjected):
+        run(inj.inject("10.0.0.1:81", "get_peer_rate_limits"))
+    # different target / op untouched
+    run(inj.inject("10.0.0.2:81", "get_peer_rate_limits"))
+    run(inj.inject("10.0.0.1:81", "update_peer_globals"))
+
+
+def test_wildcards_match_everything():
+    inj = FaultInjector()
+    inj.add_rule(FaultRule(error_rate=1.0))
+    for target, op in (("a", "x"), ("edge", "edge_call")):
+        with pytest.raises(FaultInjected):
+            run(inj.inject(target, op))
+
+
+def test_seeded_error_rate_is_reproducible():
+    def sequence(seed):
+        inj = FaultInjector(seed=seed)
+        inj.add_rule(FaultRule(error_rate=0.5))
+        out = []
+        for _ in range(64):
+            try:
+                run(inj.inject("t", "op"))
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    a, b = sequence(42), sequence(42)
+    assert a == b, "same seed must give the same fault sequence"
+    assert sequence(7) != a, "different seed should diverge"
+    assert 0 < sum(a) < 64, "rate 0.5 must fire sometimes, not always"
+
+
+def test_injection_budget_exhausts():
+    inj = FaultInjector()
+    inj.add_rule(FaultRule(error_rate=1.0, max_injections=3))
+    for _ in range(3):
+        with pytest.raises(FaultInjected):
+            run(inj.inject("t", "op"))
+    run(inj.inject("t", "op"))  # budget spent: rule no longer matches
+
+
+def test_latency_uses_injected_sleep():
+    sleeps = []
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    inj = FaultInjector(sleep=fake_sleep)
+    inj.add_rule(FaultRule(latency_s=0.25, max_injections=2))
+    run(inj.inject("t", "op"))
+    run(inj.inject("t", "op"))
+    run(inj.inject("t", "op"))  # budget spent
+    assert sleeps == [0.25, 0.25]
+
+
+def test_latency_then_error_same_rule():
+    sleeps = []
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    inj = FaultInjector(sleep=fake_sleep)
+    inj.add_rule(FaultRule(latency_s=0.1, error_rate=1.0))
+    with pytest.raises(FaultInjected):
+        run(inj.inject("t", "op"))
+    assert sleeps == [0.1], "latency applies before the error decision"
+
+
+def test_parse_rules_grammar():
+    rules = parse_rules(
+        "target=127.0.0.1:81,op=get_peer_rate_limits,error=1.0;"
+        "target=edge,latency=50ms,count=10,message=brownout"
+    )
+    assert len(rules) == 2
+    assert rules[0].target == "127.0.0.1:81"
+    assert rules[0].op == "get_peer_rate_limits"
+    assert rules[0].error_rate == 1.0
+    assert rules[1].target == "edge"
+    assert rules[1].latency_s == pytest.approx(0.05)
+    assert rules[1].max_injections == 10
+    assert rules[1].message == "brownout"
+
+
+def test_parse_rules_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_rules("target=x,bogus=1")
+    with pytest.raises(ValueError):
+        parse_rules("notakv")
+
+
+def test_module_level_hooks():
+    assert not faults.active()
+    rule = faults.INJECTOR.partition("dead:81")
+    assert faults.active()
+    with pytest.raises(FaultInjected):
+        run(faults.inject("dead:81", "get_peer_rate_limits"))
+    assert rule.injected == 1
+    faults.INJECTOR.clear()
+    assert not faults.active()

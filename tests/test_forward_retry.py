@@ -1,0 +1,297 @@
+"""Forwarding-path fault semantics (parallel/peers.py), unit-level with
+stubbed peer RPCs — no real gRPC, no real daemons:
+
+- ring-swap retry: forward() re-resolves to the NEW owner after a
+  set_peers mid-retry (previously covered only indirectly);
+- orphaned peers fail their queued futures fast after a ring swap;
+- deadline budget bounds retries (shared, not multiplied per leg) and
+  honors an upstream-propagated deadline;
+- circuit breaker sheds a dead owner: fail-fast (mode=error) or local
+  degraded answers with reconciliation queueing (mode=local).
+"""
+
+import asyncio
+import concurrent.futures
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, PeerInfo, RateLimitReq, RateLimitResp
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.parallel.peers import CircuitOpenError, PeerMesh
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import clock as _clock
+
+pytestmark = pytest.mark.chaos
+
+A = "10.0.0.1:81"
+B = "10.0.0.2:81"
+LOCAL = PeerInfo(grpc_address="10.0.0.99:81", is_owner=True)
+
+
+class FakeEngine:
+    """Local-state stand-in: answers every check immediately."""
+
+    def __init__(self):
+        self.calls = []
+
+    def check_async(self, req):
+        self.calls.append(req)
+        fut = concurrent.futures.Future()
+        fut.set_result(
+            RateLimitResp(limit=req.limit, remaining=req.limit - req.hits)
+        )
+        return fut
+
+
+class FakeGlobalMgr:
+    def __init__(self):
+        self.hits = []
+
+    def queue_hit(self, req):
+        self.hits.append(req)
+
+
+class FakeSvc:
+    def __init__(self):
+        self.metrics = Metrics()
+        self.engine = FakeEngine()
+        self.global_mgr = None
+
+
+def make_mesh(behaviors=None, peers=(A, B)):
+    svc = FakeSvc()
+    mesh = PeerMesh(svc, behaviors or BehaviorConfig())
+    mesh.set_peers([PeerInfo(grpc_address=p) for p in peers], LOCAL)
+    return svc, mesh
+
+
+def owned_key(mesh, addr: str) -> RateLimitReq:
+    """A request whose ring owner is `addr`."""
+    for i in range(10_000):
+        r = RateLimitReq(
+            name="fwd", unique_key=f"k{i}", limit=100, duration=60_000, hits=1,
+            behavior=int(Behavior.NO_BATCHING),
+        )
+        if mesh.get(r.hash_key()).info.grpc_address == addr:
+            return r
+    raise AssertionError(f"no key owned by {addr}")
+
+
+def stub_rpc(peer, fn):
+    """Replace the raw transport under the breaker/fault wrapper."""
+
+    async def _rpc(reqs, timeout):
+        return await fn(reqs, timeout)
+
+    peer._rpc_get_peer_rate_limits = _rpc
+
+
+async def ok_rpc(reqs, timeout):
+    return [RateLimitResp(limit=r.limit, remaining=r.limit - r.hits) for r in reqs]
+
+
+def test_forward_reresolves_new_owner_after_ring_swap_mid_retry():
+    async def main():
+        svc, mesh = make_mesh()
+        req = owned_key(mesh, A)
+        peer_a, peer_b = mesh._all[A], mesh._all[B]
+
+        async def a_fails_then_ring_swaps(reqs, timeout):
+            # The owner dies AND discovery removes it before the retry.
+            mesh.set_peers([PeerInfo(grpc_address=B)], LOCAL)
+            raise RuntimeError("connection refused")
+
+        stub_rpc(peer_a, a_fails_then_ring_swaps)
+        stub_rpc(peer_b, ok_rpc)
+
+        resp = await mesh.forward(peer_a, req)
+        assert resp.metadata["owner"] == B, "retry must land on the NEW owner"
+        assert resp.error == ""
+        assert svc.metrics.batch_send_retries.labels().get() == 1
+
+    asyncio.run(main())
+
+
+def test_orphaned_peer_queued_futures_fail_fast():
+    async def main():
+        svc, mesh = make_mesh(
+            behaviors=BehaviorConfig(batch_timeout_s=30.0, batch_wait_s=0.001)
+        )
+        peer_a = mesh._all[A]
+        hang = asyncio.Event()
+
+        async def hung_rpc(reqs, timeout):
+            await hang.wait()
+
+        stub_rpc(peer_a, hung_rpc)
+        # Batched request (no NO_BATCHING): rides the pump queue.
+        req = RateLimitReq(name="fwd", unique_key="orphan", limit=10,
+                           duration=60_000, hits=1)
+        task = asyncio.ensure_future(peer_a.get_peer_rate_limit(req))
+        await asyncio.sleep(0.05)  # pump picks it up and hangs in the RPC
+
+        t0 = time.monotonic()
+        mesh.set_peers([PeerInfo(grpc_address=B)], LOCAL)  # A orphaned
+        with pytest.raises(RuntimeError, match="peer client shutdown"):
+            await asyncio.wait_for(task, timeout=5)
+        # Must beat the 30s batch timeout by far (shutdown grace is ~1s).
+        assert time.monotonic() - t0 < 3.0
+        hang.set()
+
+    asyncio.run(main())
+
+
+def test_deadline_budget_bounds_retries():
+    async def main():
+        svc, mesh = make_mesh(
+            behaviors=BehaviorConfig(
+                forward_deadline_s=0.15, circuit_failure_threshold=100
+            )
+        )
+        req = owned_key(mesh, A)
+
+        calls = []
+
+        async def slow_failure(reqs, timeout):
+            calls.append(timeout)
+            await asyncio.sleep(0.05)
+            raise RuntimeError("owner dark")
+
+        stub_rpc(mesh._all[A], slow_failure)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="deadline"):
+            await mesh.forward(mesh._all[A], req)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, "retries must share the budget, not multiply it"
+        assert 1 <= len(calls) <= 4
+        # Per-leg timeouts shrink as the budget drains.
+        assert all(t <= 0.15 + 1e-6 for t in calls)
+        assert calls == sorted(calls, reverse=True)
+        assert svc.metrics.forward_deadline_exceeded.labels().get() == 1
+        # The budget was propagated on the wire as an absolute deadline.
+        assert "deadline_ms" in req.metadata
+
+    asyncio.run(main())
+
+
+def test_upstream_deadline_metadata_wins_when_tighter():
+    async def main():
+        svc, mesh = make_mesh(
+            behaviors=BehaviorConfig(
+                forward_deadline_s=10.0, circuit_failure_threshold=100
+            )
+        )
+        req = owned_key(mesh, A)
+        req.metadata["deadline_ms"] = str(_clock.now_ms() + 100)
+
+        async def slow_failure(reqs, timeout):
+            await asyncio.sleep(0.05)
+            raise RuntimeError("owner dark")
+
+        stub_rpc(mesh._all[A], slow_failure)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="deadline"):
+            await mesh.forward(mesh._all[A], req)
+        assert time.monotonic() - t0 < 2.0, "upstream 100ms budget must win"
+
+    asyncio.run(main())
+
+
+def test_breaker_sheds_dead_owner_fail_fast():
+    async def main():
+        svc, mesh = make_mesh(
+            behaviors=BehaviorConfig(
+                circuit_failure_threshold=3,
+                circuit_open_base_s=60.0,  # stays open for the whole test
+                forward_deadline_s=5.0,
+            )
+        )
+        req = owned_key(mesh, A)
+        calls = []
+
+        async def dead(reqs, timeout):
+            calls.append(1)
+            raise RuntimeError("connection refused")
+
+        stub_rpc(mesh._all[A], dead)
+        # First forward: fails transport calls until the breaker trips,
+        # then surfaces the open circuit.
+        with pytest.raises(CircuitOpenError):
+            await mesh.forward(mesh._all[A], req)
+        assert len(calls) == 3, "breaker must trip at the threshold"
+        assert mesh.breaker_summary()[A] == "open"
+
+        # Subsequent forwards shed instantly: no transport call at all.
+        t0 = time.monotonic()
+        with pytest.raises(CircuitOpenError):
+            await mesh.forward(mesh._all[A], owned_key(mesh, A))
+        assert len(calls) == 3
+        assert time.monotonic() - t0 < 0.05
+        assert (
+            svc.metrics.check_error_counter.labels("Owner circuit open").get()
+            == 2
+        )
+
+    asyncio.run(main())
+
+
+def test_owner_unreachable_local_mode_serves_degraded():
+    async def main():
+        svc, mesh = make_mesh(
+            behaviors=BehaviorConfig(
+                circuit_failure_threshold=1,
+                circuit_open_base_s=60.0,
+                owner_unreachable="local",
+            )
+        )
+        svc.global_mgr = FakeGlobalMgr()
+        req = owned_key(mesh, A)
+
+        async def dead(reqs, timeout):
+            raise RuntimeError("connection refused")
+
+        stub_rpc(mesh._all[A], dead)
+        resp = await mesh.forward(mesh._all[A], req)
+        assert resp.error == ""
+        assert resp.metadata["degraded"] == "owner-unreachable"
+        assert resp.metadata["owner"] == A
+        assert svc.engine.calls, "answer must come from local state"
+        assert svc.metrics.degraded_local_answers.labels().get() == 1
+        # Hits queued for reconciliation once the owner's circuit closes.
+        assert len(svc.global_mgr.hits) == 1
+        assert svc.global_mgr.hits[0].hash_key() == req.hash_key()
+
+    asyncio.run(main())
+
+
+def test_half_open_probe_recovers_the_owner():
+    async def main():
+        svc, mesh = make_mesh(
+            behaviors=BehaviorConfig(
+                circuit_failure_threshold=1, circuit_open_base_s=0.05
+            )
+        )
+        req = owned_key(mesh, A)
+        healthy = False
+
+        async def flapping(reqs, timeout):
+            if not healthy:
+                raise RuntimeError("connection refused")
+            return [
+                RateLimitResp(limit=r.limit, remaining=r.limit - r.hits)
+                for r in reqs
+            ]
+
+        stub_rpc(mesh._all[A], flapping)
+        with pytest.raises(Exception):
+            await mesh.forward(mesh._all[A], req)
+        assert mesh.breaker_summary()[A] == "open"
+
+        healthy = True
+        await asyncio.sleep(0.08)  # past the open backoff
+        resp = await mesh.forward(mesh._all[A], owned_key(mesh, A))
+        assert resp.error == "" and resp.metadata["owner"] == A
+        assert mesh.breaker_summary()[A] == "closed", "probe success closes"
+
+    asyncio.run(main())
