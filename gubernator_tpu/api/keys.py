@@ -32,19 +32,43 @@ def _to_unsigned(v: int) -> int:
 
 # The in-process identity hash is swappable: the native C++ batch hasher
 # (gubernator_tpu.native, MurmurHash3 x64-128) when it builds, else
-# Python xxh3. Static per process, so hashes stay self-consistent.
+# Python xxh3. The GUBER_DISABLE_NATIVE_HASH toggle is read on FIRST
+# USE, not at import (guberlint GL004: the daemon's --config file is
+# injected into os.environ after import) — then latched for the life of
+# the process, because the two hashers produce different digests and a
+# mid-process flip would split every live key's slot-table identity.
 _native = None
-if os.environ.get("GUBER_DISABLE_NATIVE_HASH", "") not in ("1", "true"):
-    try:
-        from gubernator_tpu import native as _native_mod
+_native_decided = False
 
-        _native = _native_mod if _native_mod.available() else None
-    except Exception:
+
+def _native_mod():
+    global _native, _native_decided
+    if not _native_decided:
         _native = None
+        if os.environ.get("GUBER_DISABLE_NATIVE_HASH", "") not in (
+            "1",
+            "true",
+        ):
+            try:
+                from gubernator_tpu import native as mod
+
+                _native = mod if mod.available() else None
+            except Exception:
+                _native = None
+        _native_decided = True
+    return _native
+
+
+def _reset_native_for_tests() -> None:
+    """Unlatch the first-use decision (tests only: production must never
+    flip hashers mid-process)."""
+    global _native, _native_decided
+    _native = None
+    _native_decided = False
 
 
 def native_enabled() -> bool:
-    return _native is not None
+    return _native_mod() is not None
 
 
 def key_hash128(hash_key: str) -> Tuple[int, int]:
@@ -53,8 +77,9 @@ def key_hash128(hash_key: str) -> Tuple[int, int]:
     (0, 0) is reserved as the empty-slot sentinel; the astronomically
     unlikely all-zero digest is nudged.
     """
-    if _native is not None:
-        return _native.hash128(hash_key)
+    native = _native_mod()
+    if native is not None:
+        return native.hash128(hash_key)
     d = xxhash.xxh3_128_intdigest(hash_key.encode("utf-8"))
     hi = (d >> 64) & _M64
     lo = d & _M64
@@ -68,8 +93,9 @@ def key_hash128_batch(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Batch form: (hi int64[n], lo int64[n], group int32[n]). One native
     call when available; the assembler hot loop uses this."""
-    if _native is not None:
-        return _native.hash128_batch(keys, num_groups)
+    native = _native_mod()
+    if native is not None:
+        return native.hash128_batch(keys, num_groups)
     n = len(keys)
     hi = np.empty(n, dtype=np.int64)
     lo = np.empty(n, dtype=np.int64)

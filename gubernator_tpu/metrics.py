@@ -30,6 +30,8 @@ from prometheus_client import (
     CONTENT_TYPE_LATEST,
 )
 
+from gubernator_tpu.utils import lockorder
+
 log = logging.getLogger("gubernator_tpu.metrics")
 
 
@@ -76,7 +78,7 @@ class _BareCounter:
         self.doc = doc
         self.labelnames = tuple(labelnames)
         self._values: dict = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("metrics.counter")
         if not self.labelnames:
             self._values[()] = 0.0
 
@@ -147,7 +149,7 @@ class Log2Histogram:
         self.n_buckets = int(n_buckets)
         self.labelnames = tuple(labelnames)
         self._les = [self.scale * (1 << i) for i in range(self.n_buckets)]
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("metrics.histogram")
         # key -> [bucket counts (n_buckets + 1, last = +Inf), sum]
         self._series: dict = {}
         if not self.labelnames:

@@ -19,11 +19,13 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from gubernator_tpu.utils import lockorder
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "guberhash.cc")
 _SO = os.path.join(_NATIVE_DIR, "_guberhash.so")
 
-_lock = threading.Lock()
+_lock = lockorder.make_lock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

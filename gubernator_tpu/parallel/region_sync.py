@@ -226,6 +226,7 @@ class RegionManager:
                     continue
                 try:
                     peer = self._region_peer(home, key)
+                # guberlint: allow-swallow -- pick failure is counted via region_send_errors and the hit requeued just below
                 except Exception:
                     peer = None
                 if peer is None:
@@ -320,6 +321,7 @@ class RegionManager:
                 for region in other_regions:
                     try:
                         peer = self._region_peer(region, g.key)
+                    # guberlint: allow-swallow -- pick failure is counted via region_broadcast_errors just below
                     except Exception:
                         peer = None
                     if peer is None:

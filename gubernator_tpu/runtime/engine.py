@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from gubernator_tpu.utils import lockorder
 from gubernator_tpu.api.keys import group_of, key_hash128, key_hash128_batch
 from gubernator_tpu.api.types import (
     Behavior,
@@ -98,7 +99,7 @@ class EngineMetrics:
             install_compile_listener,
         )
 
-        self.lock = threading.Lock()
+        self.lock = lockorder.make_lock("engine.metrics")
         self.cache_hits = 0
         self.cache_misses = 0
         self.unexpired_evictions = 0
@@ -422,9 +423,9 @@ class DeviceEngine(EngineBase):
         self.metrics = EngineMetrics()
         self.store = None  # optional Store plugin (gubernator_tpu.store)
         self._key_strings: Dict[Tuple[int, int], str] = {}
-        self._lock = threading.Lock()  # guards table swap (load/restore)
+        self._lock = lockorder.make_lock("engine.table")  # guards table swap (load/restore)
         # guards the host key dictionaries (pump + executor threads)
-        self._keys_lock = threading.Lock()
+        self._keys_lock = lockorder.make_lock("engine.keys")
 
         if config.max_waves < 1:
             raise ValueError("max_waves must be >= 1")

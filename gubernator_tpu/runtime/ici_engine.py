@@ -38,6 +38,7 @@ from typing import List, Optional, Tuple
 import jax
 import numpy as np
 
+from gubernator_tpu.utils import lockorder
 from gubernator_tpu.api.keys import group_of, key_hash128_batch
 from gubernator_tpu.api.types import Behavior, RateLimitResp
 from gubernator_tpu.ops.encode import EncodeError, encode_one
@@ -135,7 +136,7 @@ class IciEngine(EngineBase):
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
         )
 
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("ici_engine.state")
         self._home_rr = 0
         self._sync_errors = 0
         # Overflow observability (VERDICT r3 item 5): keys degraded to

@@ -33,10 +33,12 @@ import threading
 import time
 from typing import Optional
 
+from gubernator_tpu.utils import lockorder
+
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _tls = threading.local()
-_install_lock = threading.Lock()
+_install_lock = lockorder.make_lock("telemetry.install")
 _installed = False
 
 
@@ -96,7 +98,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 128):
         self._buf: collections.deque = collections.deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("telemetry.flight_recorder")
         self._seq = 0
 
     @property

@@ -25,10 +25,13 @@ The JAX device mesh is static per process, so discovery governs the
 from __future__ import annotations
 
 import asyncio
+import logging
 import socket
 from typing import Callable, List, Optional, Sequence
 
 from gubernator_tpu.api.types import PeerInfo
+
+log = logging.getLogger("gubernator.discovery")
 
 OnUpdate = Callable[[List[PeerInfo]], None]
 
@@ -161,6 +164,7 @@ class DnsPool:
 
     async def _poll(self) -> None:
         loop = asyncio.get_running_loop()
+        failing = False
         while self._running:
             try:
                 ips = await loop.run_in_executor(None, self._resolver, self.fqdn)
@@ -176,8 +180,17 @@ class DnsPool:
                 ]
                 if peers:
                     self.on_update(peers)
-            except Exception:
-                pass  # transient resolver failures: keep the old peer set
+                failing = False
+            except Exception as e:
+                # Keep the old peer set, but never silently: one warning
+                # per outage (not per poll — a dead resolver at a 300s
+                # interval must not fill the log), cleared on recovery.
+                if not failing:
+                    log.warning(
+                        "dns peer poll for %s failed (keeping previous "
+                        "peer set): %s", self.fqdn, e,
+                    )
+                    failing = True
             await asyncio.sleep(self.interval_s)
 
     def close(self) -> None:
@@ -392,6 +405,7 @@ class GossipPool:
                 payload = self._sign(payload)
             host, port = addr.rsplit(":", 1)
             self._transport.sendto(payload, (host, int(port)))
+        # guberlint: allow-swallow -- best-effort UDP gossip send: a down peer is routine and surfaces via its own liveness timeout
         except Exception:
             pass
 
@@ -521,8 +535,9 @@ class GossipPool:
                         changed = True
             if changed:
                 self._push()
+        # guberlint: allow-swallow -- malformed/hostile datagrams must never escape OR spam logs (unauthenticated UDP is attacker-controlled input)
         except Exception:
-            return  # malformed/hostile datagrams must never escape
+            return
 
     def _receive_probe(self, t: str, msg: dict, now: float) -> None:
         """SWIM probe traffic: ping / ping-req / ack."""

@@ -173,6 +173,7 @@ class EdgeListener:
             )
         except asyncio.CancelledError:
             raise
+        # guberlint: allow-swallow -- the failure is serialized back to the edge client as an INTERNAL error frame
         except Exception as e:
             msg = f"edge serve failure: {e}".encode()
             resp = _pack(1, call_id, bytes([8]) + b"INTERNAL" + msg)

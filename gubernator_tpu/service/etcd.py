@@ -267,6 +267,7 @@ class EtcdPool:
                 while self._running:
                     await call.write(epb.LeaseKeepAliveRequest(ID=self._lease_id))
                     await asyncio.sleep(interval)
+            # guberlint: allow-swallow -- a dead keepalive sender surfaces as a read timeout in the outer loop, which re-registers
             except Exception:
                 pass
 
@@ -286,6 +287,7 @@ class EtcdPool:
             send_task.cancel()
             try:
                 call.cancel()
+            # guberlint: allow-swallow -- cancel of an already-torn stream raises in some grpc versions; teardown is the goal
             except Exception:
                 pass
 
@@ -356,6 +358,7 @@ class EtcdPool:
                 finally:
                     try:
                         call.cancel()
+                    # guberlint: allow-swallow -- cancel of an already-torn stream raises in some grpc versions; teardown is the goal
                     except Exception:
                         pass
             except asyncio.CancelledError:

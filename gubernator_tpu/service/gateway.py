@@ -30,12 +30,13 @@ import time
 
 from aiohttp import web
 
+from gubernator_tpu.utils import lockorder
 from gubernator_tpu.service import pb
 from gubernator_tpu.service.server import ApiError, V1Service
 
 # jax.profiler state is process-global: exactly one capture at a time,
 # regardless of how many daemons/listeners share the process.
-_PROFILE_GUARD = threading.Lock()
+_PROFILE_GUARD = lockorder.make_lock("gateway.profile_guard")
 _PROFILE_MAX_SECONDS = 30.0
 
 

@@ -247,8 +247,12 @@ class K8sPool:
         self._task.cancel()
         try:
             await self._task
-        except (asyncio.CancelledError, Exception):
-            pass
+        except asyncio.CancelledError:
+            pass  # the cancel above; expected teardown
+        except Exception as e:
+            # The watch task died on its own before the cancel — that
+            # failure was about to vanish with the pool.
+            log.warning("k8s watch task died before close: %s", e)
         if self._session is not None:
             await self._session.close()
             self._session = None

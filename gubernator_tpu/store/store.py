@@ -19,6 +19,7 @@ import dataclasses
 import threading
 from typing import Dict, Iterable, List, Optional, Protocol
 
+from gubernator_tpu.utils import lockorder
 from gubernator_tpu.api.types import Algorithm, RateLimitReq
 
 
@@ -64,7 +65,7 @@ class MemoryStore:
 
     def __init__(self):
         self.data: Dict[str, ItemSnapshot] = {}
-        self.lock = threading.Lock()
+        self.lock = lockorder.make_lock("store.memory")
         self.get_calls = 0
         self.change_calls = 0
 

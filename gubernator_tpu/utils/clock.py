@@ -12,7 +12,9 @@ import threading
 import time
 from typing import Optional
 
-_lock = threading.Lock()
+from gubernator_tpu.utils import lockorder
+
+_lock = lockorder.make_lock("clock.freeze")
 _frozen_ms: Optional[int] = None
 
 

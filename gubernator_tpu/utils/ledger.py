@@ -31,8 +31,12 @@ import re
 import time
 from typing import Any
 
-JOBS_DIR = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")
+# Bench/jobs tooling paths, not daemon config: these are set in the
+# runner's shell, never via --config file, so import-time binding is
+# the intended behavior.
+JOBS_DIR = os.environ.get("TPU_JOBS_DIR", "/tmp/tpu_jobs")  # guberlint: allow-import-env -- bench runner shell var, not daemon --config
 RUNTIME_LEDGER = os.path.join(JOBS_DIR, "results.jsonl")
+# guberlint: allow-import-env -- bench ledger path is process-constant tooling, not daemon --config
 REPO_LEDGER = os.environ.get("GUBER_REPO_LEDGER") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "bench_results",

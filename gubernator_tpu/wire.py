@@ -27,13 +27,15 @@ from typing import Optional
 
 import numpy as np
 
+from gubernator_tpu.utils import lockorder
+
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
 )
 _SRC = os.path.join(_NATIVE_DIR, "wirepath.cc")
 _SO = os.path.join(_NATIVE_DIR, "_wirepath.so")
 
-_lock = threading.Lock()
+_lock = lockorder.make_lock("wire.load")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

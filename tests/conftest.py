@@ -21,6 +21,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Lock-order sanitizer ON for the whole suite (must be set before any
+# gubernator_tpu module creates its locks): every named internal lock
+# tracks held-sets and the global acquisition-order graph, so the
+# engine/peer/gateway concurrency tests double as deadlock-order
+# probes. The autouse fixture below fails the offending test on any
+# cycle or double-acquire. See gubernator_tpu/utils/lockorder.py.
+os.environ.setdefault("GUBER_LOCK_SANITIZER", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -47,6 +55,23 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
     )
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_clean():
+    """Fail the test that introduced a lock-order violation. Deliberate
+    inversion tests (test_lockorder.py) use their own LockOrderGraph, so
+    the session-default graph must stay violation-free."""
+    from gubernator_tpu.utils import lockorder
+
+    before = len(lockorder.DEFAULT_GRAPH.report())
+    yield
+    after = lockorder.DEFAULT_GRAPH.report()
+    if len(after) > before:
+        raise AssertionError(
+            "lock-order violation(s) recorded during this test:\n"
+            + lockorder.DEFAULT_GRAPH.format_report()
+        )
 
 
 @pytest.fixture(autouse=True)

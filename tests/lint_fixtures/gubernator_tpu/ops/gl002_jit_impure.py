@@ -1,0 +1,36 @@
+"""GL002 violation fixture: impure reads inside jit-traced functions.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import functools
+import os
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decide(x):
+    t = time.time()                          # finding: time.time
+    r = random.random()                      # finding: random.random
+    mode = os.environ.get("X")               # finding: os.environ
+    return x + t + r + (1 if mode else 0)
+
+
+@functools.partial(jax.jit, static_argnames=("ways",))
+def probe(x, ways):
+    return x * time.perf_counter()           # finding: time.perf_counter
+
+
+def make_sync_step(mesh):
+    def tick(state):
+        return state + time.monotonic()      # finding: traced via builder
+    return tick
+
+
+def host_helper():
+    # NOT traced: impure reads here are fine.
+    return time.time(), jnp.zeros((2,), dtype=jnp.int64)

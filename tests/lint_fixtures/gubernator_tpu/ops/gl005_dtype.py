@@ -1,0 +1,19 @@
+"""GL005 violation fixture: dtype-sloppy jnp constructors + int32 word
+casts.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import jax.numpy as jnp
+
+I64 = jnp.int64
+
+
+def build(n, slot_words):
+    a = jnp.zeros((n, 9))                    # finding: no dtype
+    b = jnp.arange(n)                        # finding: no dtype
+    c = jnp.asarray(slot_words)              # finding: no dtype
+    d = slot_words.astype(jnp.int32)         # finding: int32 on word data
+    ok1 = jnp.zeros((n,), dtype=I64)         # clean: explicit dtype
+    ok2 = jnp.asarray(slot_words, I64)       # clean: positional dtype
+    return a, b, c, d, ok1, ok2

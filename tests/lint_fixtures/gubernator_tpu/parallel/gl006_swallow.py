@@ -1,0 +1,57 @@
+"""GL006 violation fixture: swallowed exceptions in a transport path.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def bare_pass(sock):
+    try:
+        sock.send(b"x")
+    except Exception:
+        pass  # finding: swallowed
+
+
+def bare_except(sock):
+    try:
+        sock.send(b"x")
+    except:  # noqa: E722  -- finding: swallowed
+        return None
+
+
+def tuple_catch(sock):
+    try:
+        sock.send(b"x")
+    except (OSError, Exception):
+        return None  # finding: swallowed (tuple contains Exception)
+
+
+def pragma_without_reason(sock):
+    try:
+        sock.send(b"x")
+    except Exception:  # guberlint: allow-swallow
+        pass  # finding: pragma present but reason missing
+
+
+def pragma_with_reason(sock):
+    try:
+        sock.send(b"x")
+    except Exception:  # guberlint: allow-swallow -- fixture: properly suppressed
+        pass  # clean
+
+
+def logged(sock):
+    try:
+        sock.send(b"x")
+    except Exception as e:
+        log.warning("send failed: %s", e)  # clean: logged
+
+
+def narrow(sock):
+    try:
+        sock.send(b"x")
+    except OSError:
+        pass  # clean: narrow catch is out of scope for GL006

@@ -1,0 +1,17 @@
+"""GL001 violation fixture: every host-sync idiom the rule must catch.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import jax
+import numpy as np
+
+
+def flush(out, diag, table):
+    out.status.block_until_ready()          # finding: block_until_ready
+    a = np.asarray(out.status)              # finding: np.asarray
+    b = jax.device_get(out.remaining)       # finding: device_get
+    c = int(diag[0])                        # finding: int(subscript)
+    d = float(table[3])                     # finding: float(subscript)
+    e = np.asarray(out.limit)  # guberlint: allow-host-sync -- suppressed on purpose (fixture)
+    return a, b, c, d, e

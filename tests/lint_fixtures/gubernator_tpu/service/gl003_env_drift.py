@@ -1,0 +1,12 @@
+"""GL003 violation fixture: a knob read that no doc catalogs.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import os
+
+
+def setting():
+    # findings: undocumented in docs/config.md AND missing from
+    # example.conf
+    return os.environ.get("GUBER_FIXTURE_ONLY_UNDOCUMENTED_KNOB", "")

@@ -1,0 +1,16 @@
+"""GL004 violation fixture: module-scope environment reads.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import os
+
+_FLAG = os.environ.get("GUBER_DEBUG", "")      # finding: module-level get
+_RAW = os.environ["HOME"]                      # finding: module-level []
+_ALT = os.getenv("GUBER_LOG_LEVEL")            # finding: module-level getenv
+_HAS = "GUBER_DEBUG" in os.environ             # finding: module-level `in`
+
+
+def fine():
+    # call-time read: not a finding
+    return os.environ.get("GUBER_DEBUG", "")

@@ -1,0 +1,76 @@
+"""GL004 satellite regression tests: env knobs that used to latch at
+import time must honor variables set AFTER import (the daemon's
+--config file is injected into os.environ long after these modules
+load)."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from gubernator_tpu.api import keys
+from gubernator_tpu.service import fastpath
+
+
+class _ColumnarEngine:
+    def check_columns(self, *a, **k):  # pragma: no cover - eligibility only
+        raise NotImplementedError
+
+
+@pytest.fixture
+def fast_svc(monkeypatch):
+    monkeypatch.setattr(fastpath.wire, "available", lambda: True)
+    return SimpleNamespace(fast_edge=True, engine=_ColumnarEngine())
+
+
+def test_fast_edge_disable_set_after_import(fast_svc, monkeypatch):
+    monkeypatch.delenv("GUBER_DISABLE_FAST_EDGE", raising=False)
+    assert fastpath.enabled(fast_svc)
+    # the regression: with the old import-time _DISABLED global this
+    # set would have been invisible
+    monkeypatch.setenv("GUBER_DISABLE_FAST_EDGE", "1")
+    assert not fastpath.enabled(fast_svc)
+    monkeypatch.setenv("GUBER_DISABLE_FAST_EDGE", "true")
+    assert not fastpath.enabled(fast_svc)
+    # and it is flippable live (per-call read), e.g. for triage
+    monkeypatch.setenv("GUBER_DISABLE_FAST_EDGE", "0")
+    assert fastpath.enabled(fast_svc)
+
+
+def test_native_hash_disable_set_after_import(monkeypatch):
+    keys._reset_native_for_tests()
+    try:
+        monkeypatch.setenv("GUBER_DISABLE_NATIVE_HASH", "1")
+        # decided on first use — the post-import set is honored
+        assert keys.native_enabled() is False
+        h = keys.key_hash128("latch-test-key")
+        assert h != (0, 0)
+    finally:
+        keys._reset_native_for_tests()
+
+
+def test_native_hash_decision_latches_until_reset(monkeypatch):
+    keys._reset_native_for_tests()
+    try:
+        monkeypatch.setenv("GUBER_DISABLE_NATIVE_HASH", "1")
+        assert keys.native_enabled() is False
+        # flipping the env mid-process must NOT flip the hasher: Murmur
+        # and xxh3 digests differ, so live keys' table identities would
+        # split. The first-use decision is latched.
+        monkeypatch.delenv("GUBER_DISABLE_NATIVE_HASH")
+        assert keys.native_enabled() is False
+    finally:
+        keys._reset_native_for_tests()
+
+
+def test_hashing_consistent_within_a_latch(monkeypatch):
+    keys._reset_native_for_tests()
+    try:
+        monkeypatch.setenv("GUBER_DISABLE_NATIVE_HASH", "1")
+        one = keys.key_hash128("stable-key")
+        two = keys.key_hash128("stable-key")
+        assert one == two
+        hi, lo, grp = keys.key_hash128_batch(["stable-key"], 8)
+        assert (int(hi[0]), int(lo[0])) == one
+        assert int(grp[0]) == keys.group_of(one[1], 8)
+    finally:
+        keys._reset_native_for_tests()

@@ -1,0 +1,220 @@
+"""guberlint tier-1 gate: the full rule set over gubernator_tpu/ +
+tools/ must be clean against the committed baseline, and every rule
+must demonstrably fire on its violation fixture.
+
+Deliberately jax-free: the linter is pure-AST (GL000 imports only the
+jax-free metrics module), so this file must never pull jax in on its
+own — test_linter_is_stdlib_only pins that with a `python -S`
+subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.abspath(os.path.join(HERE, os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    REGISTRY,
+    load_baseline,
+    run_lint,
+)
+
+FIXTURES = os.path.join(HERE, "lint_fixtures", "gubernator_tpu")
+
+
+def fixture(*parts):
+    return os.path.relpath(os.path.join(FIXTURES, *parts), REPO)
+
+
+# ---------------------------------------------------------------------------
+# repo-wide gate
+
+
+def test_repo_clean_with_committed_baseline():
+    res = run_lint(baseline=load_baseline(DEFAULT_BASELINE))
+    assert res.new == [], "new guberlint findings:\n" + "\n".join(
+        f.render() for f in res.new
+    )
+    # a fixed finding whose baseline entry lingers should be pruned, so
+    # the ratchet only ever tightens
+    assert res.stale_keys == [], (
+        "stale baseline entries (run `python -m tools.lint "
+        "--update-baseline`): " + ", ".join(res.stale_keys)
+    )
+
+
+def test_baseline_is_not_vacuous():
+    # the grandfathered host-sync set must actually be observed — an
+    # empty scan (wrong roots, broken walker) must not pass silently
+    res = run_lint()
+    assert len(res.findings) >= 50
+    assert {f.rule for f in res.findings} >= {"GL001", "GL003"}
+
+
+def test_registry_complete():
+    codes = {r.code for r in REGISTRY}
+    assert codes == {
+        "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixture-violation tests
+
+_CASES = [
+    (
+        "GL001",
+        fixture("runtime", "gl001_host_sync.py"),
+        {
+            "block_until_ready",
+            "np.asarray",
+            "device_get",
+            "int(subscript)",
+            "float(subscript)",
+        },
+        5,
+    ),
+    (
+        "GL002",
+        fixture("ops", "gl002_jit_impure.py"),
+        {"time.time", "random.random", "os.environ", "time.perf_counter",
+         "time.monotonic"},
+        5,
+    ),
+    (
+        "GL003",
+        fixture("service", "gl003_env_drift.py"),
+        {"GUBER_FIXTURE_ONLY_UNDOCUMENTED_KNOB"},
+        2,
+    ),
+    (
+        "GL004",
+        fixture("service", "gl004_import_env.py"),
+        {"os.environ.get", "os.environ['HOME']", "os.getenv",
+         "'GUBER_DEBUG' in os.environ"},
+        4,
+    ),
+    (
+        "GL005",
+        fixture("ops", "gl005_dtype.py"),
+        {"jnp.zeros", "jnp.arange", "jnp.asarray", "int32 cast"},
+        4,
+    ),
+    (
+        "GL006",
+        fixture("parallel", "gl006_swallow.py"),
+        {"bare_pass", "bare_except", "tuple_catch"},
+        4,  # 3 swallows + 1 reason-less pragma
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "code,path,needles,expect_n", _CASES, ids=[c[0] for c in _CASES]
+)
+def test_rule_fires_on_its_fixture(code, path, needles, expect_n):
+    res = run_lint(paths=[path], rule_codes=[code])
+    mine = [f for f in res.new if f.rule == code]
+    assert len(mine) == expect_n, "\n".join(f.render() for f in res.new)
+    blob = "\n".join(f.message for f in mine)
+    for needle in needles:
+        assert needle in blob, f"expected a finding mentioning {needle!r}"
+
+
+def test_pragma_suppresses_and_requires_reason():
+    res = run_lint(
+        paths=[fixture("parallel", "gl006_swallow.py")],
+        rule_codes=["GL006"],
+    )
+    msgs = "\n".join(f"{f.line}: {f.message}" for f in res.new)
+    # pragma WITH reason (pragma_with_reason, line 42) is suppressed
+    assert "pragma_with_reason" not in msgs
+    # pragma WITHOUT reason still fails, with an instructive message
+    assert "requires a non-empty reason" in msgs
+    # clean handlers (logged / narrow catch) are not flagged
+    assert "'logged'" not in msgs and "'narrow'" not in msgs
+
+
+def test_gl001_inline_pragma_suppresses():
+    res = run_lint(
+        paths=[fixture("runtime", "gl001_host_sync.py")],
+        rule_codes=["GL001"],
+    )
+    # 6 host syncs in the file, one carries allow-host-sync
+    lines = {f.line for f in res.new}
+    assert len(res.new) == 5 and 16 not in lines
+
+
+def test_baseline_grandfathers_by_key_count():
+    path = fixture("parallel", "gl006_swallow.py")
+    clean = run_lint(paths=[path], rule_codes=["GL006"])
+    assert len(clean.new) == 4
+    # baseline one of the keys: exactly that finding is absorbed
+    key = next(f.key for f in clean.new if "bare_pass" in f.message)
+    res = run_lint(paths=[path], rule_codes=["GL006"], baseline={key: 1})
+    assert len(res.new) == 3
+    assert all("bare_pass" not in f.message for f in res.new)
+    # a count above the observed one is stale
+    res = run_lint(paths=[path], rule_codes=["GL006"], baseline={key: 2})
+    assert res.stale_keys == [key]
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.lint", *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_repo_exits_zero_with_baseline():
+    p = _cli("-q")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_fixture_exits_nonzero():
+    p = _cli(fixture("parallel", "gl006_swallow.py"), "-q")
+    assert p.returncode == 1
+    assert "GL006" in p.stdout
+
+
+def test_cli_list_rules():
+    p = _cli("--list-rules")
+    assert p.returncode == 0
+    for code in ("GL000", "GL006", "allow-swallow"):
+        assert code in p.stdout
+
+
+def test_linter_is_stdlib_only():
+    """The module rules must run without jax, numpy, or any third-party
+    import — `python -S` skips site-packages AND this environment's
+    sitecustomize jax hook, so any non-stdlib import fails loudly."""
+    code = (
+        "import sys; sys.path.insert(0, '.');"
+        "from tools.lint import run_lint;"
+        "r = run_lint(paths=['gubernator_tpu/parallel', 'gubernator_tpu/service']);"
+        "assert 'jax' not in sys.modules and 'numpy' not in sys.modules;"
+        "print('scanned-ok', len(r.findings))"
+    )
+    p = subprocess.run(
+        [sys.executable, "-S", "-c", code],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "scanned-ok" in p.stdout

@@ -1,0 +1,163 @@
+"""Lock-order sanitizer unit tests (ISSUE 4 satellite).
+
+Deliberate-violation tests use their OWN LockOrderGraph so they never
+pollute DEFAULT_GRAPH — conftest's autouse fixture fails any test that
+records a violation on the session-default graph.
+"""
+
+import threading
+
+import pytest
+
+from gubernator_tpu.utils import lockorder
+
+
+@pytest.fixture
+def graph(monkeypatch):
+    monkeypatch.setenv("GUBER_LOCK_SANITIZER", "1")
+    return lockorder.LockOrderGraph()
+
+
+def test_session_wiring_active():
+    # conftest sets the env before any gubernator_tpu import, so the
+    # engine/peers/gateway suites run with sanitized locks
+    assert lockorder.enabled()
+    probe = lockorder.make_lock("probe", lockorder.LockOrderGraph())
+    assert isinstance(probe, lockorder.SanitizedLock)
+
+
+def test_factory_is_noop_when_unset(monkeypatch):
+    monkeypatch.delenv("GUBER_LOCK_SANITIZER", raising=False)
+    lk = lockorder.make_lock("x")
+    rl = lockorder.make_rlock("x")
+    # the raw threading primitives, no wrapper in the acquire path
+    assert type(lk) is type(threading.Lock())
+    assert type(rl) is type(threading.RLock())
+
+
+def test_clean_ordering_produces_no_report(graph):
+    a = lockorder.make_lock("A", graph)
+    b = lockorder.make_lock("B", graph)
+
+    def worker():
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert graph.report() == []
+    assert graph.edges["A"].keys() == {"B"}
+
+
+def test_inversion_detected_same_thread(graph):
+    a = lockorder.make_lock("A", graph)
+    b = lockorder.make_lock("B", graph)
+    with a:
+        with b:
+            pass
+    # opposite order later — never deadlocks in THIS run, but the graph
+    # remembers the A->B edge and reports the would-deadlock order
+    with b:
+        with a:
+            pass
+    kinds = [v["kind"] for v in graph.report()]
+    assert kinds == ["cycle"]
+    v = graph.report()[0]
+    assert v["edge"] == ("B", "A")
+    assert v["cycle"][0] == "A" and v["cycle"][-1] == "A"
+    assert "lock-order inversion" in graph.format_report()
+
+
+def test_inversion_detected_across_threads(graph):
+    a = lockorder.make_lock("A", graph)
+    b = lockorder.make_lock("B", graph)
+    with a:
+        with b:
+            pass
+
+    def other():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert [v["kind"] for v in graph.report()] == ["cycle"]
+
+
+def test_three_lock_cycle_detected(graph):
+    a = lockorder.make_lock("A", graph)
+    b = lockorder.make_lock("B", graph)
+    c = lockorder.make_lock("C", graph)
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:  # closes the A -> B -> C -> A cycle
+            pass
+    viols = graph.report()
+    assert len(viols) == 1 and viols[0]["cycle"] == ["A", "B", "C", "A"]
+
+
+def test_double_acquire_detected_without_hanging(graph):
+    a = lockorder.make_lock("A", graph)
+    assert a.acquire()
+    # recorded at attempt time, BEFORE the acquire blocks: the timeout
+    # bounds the test, the report does not depend on it
+    assert a.acquire(timeout=0.05) is False
+    a.release()
+    viols = graph.report()
+    assert [v["kind"] for v in viols] == ["double-acquire"]
+    assert viols[0]["lock"] == "A"
+    assert "double-acquire" in graph.format_report()
+
+
+def test_rlock_reentry_is_clean(graph):
+    r = lockorder.make_rlock("R", graph)
+    with r:
+        with r:  # legitimate re-entry
+            pass
+    assert graph.report() == []
+
+
+def test_same_name_two_instances_share_a_node(graph):
+    # ordering is keyed by NAME: two engines' "engine.table" locks are
+    # one graph node, so cross-instance inversions are still caught
+    a1 = lockorder.make_lock("engine.table", graph)
+    other = lockorder.make_lock("engine.keys", graph)
+    a2 = lockorder.make_lock("engine.table", graph)
+    with a1:
+        with other:
+            pass
+    with other:
+        with a2:
+            pass
+    assert [v["kind"] for v in graph.report()] == ["cycle"]
+
+
+def test_violations_deduplicate(graph):
+    a = lockorder.make_lock("A", graph)
+    b = lockorder.make_lock("B", graph)
+    with a:
+        with b:
+            pass
+    for _ in range(5):
+        with b:
+            with a:
+                pass
+    assert len(graph.report()) == 1
+
+
+def test_default_graph_is_clean_for_this_session():
+    # the suite-wide invariant the conftest fixture enforces test by
+    # test, asserted here end-of-file for good measure
+    assert lockorder.DEFAULT_GRAPH.report() == []

@@ -1,68 +1,41 @@
 #!/usr/bin/env python
 """Fail on drift between the metrics catalog and docs/monitoring.md.
 
-The code side is `gubernator_tpu.metrics.catalog_names()` — every sample
-family a default-configured daemon can expose at /metrics (deliberately
-jax-free, so this check is cheap). The doc side is every backticked
-`gubernator_*` name appearing in a table row of docs/monitoring.md.
-
-Both directions are errors:
-- a name in code but not in the doc  -> the doc catalog is stale;
-- a name in the doc but not in code -> the doc documents a ghost.
-
-Runnable standalone (exit 1 on drift) and as a tier-1 test
-(tests/test_metrics_names.py imports check()).
+Thin shim: the logic now lives in guberlint as rule GL000
+(tools/lint/rules.py — `python -m tools.lint --rules GL000`). This
+entrypoint and its check()/doc_names()/code_names() API are kept for
+tests/test_metrics_names.py and any CI invoking the standalone path.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC_PATH = os.path.join(REPO_ROOT, "docs", "monitoring.md")
 
-_NAME_RE = re.compile(r"`(gubernator_[a-z0-9_]+)`")
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.lint.rules import (  # noqa: E402
+    metrics_code_names,
+    metrics_doc_names,
+    metrics_drift_errors,
+)
 
 
 def doc_names(path: str = DOC_PATH) -> set:
-    """Backticked gubernator_* names from the doc's table rows (prose
-    may mention derived sample names like *_bucket without pinning
-    them)."""
-    names: set = set()
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            if not line.lstrip().startswith("|"):
-                continue
-            names.update(_NAME_RE.findall(line))
-    return names
+    return metrics_doc_names(path)
 
 
 def code_names() -> set:
-    if REPO_ROOT not in sys.path:
-        sys.path.insert(0, REPO_ROOT)
-    from gubernator_tpu.metrics import catalog_names
-
-    return catalog_names()
+    return metrics_code_names()
 
 
 def check() -> list:
     """Returns a list of human-readable drift errors (empty = in sync)."""
-    code = code_names()
-    doc = doc_names()
-    errors = []
-    for name in sorted(code - doc):
-        errors.append(
-            f"{name}: exposed by the code catalog but missing from "
-            f"docs/monitoring.md"
-        )
-    for name in sorted(doc - code):
-        errors.append(
-            f"{name}: documented in docs/monitoring.md but absent from "
-            f"gubernator_tpu.metrics.catalog_names()"
-        )
-    return errors
+    return metrics_drift_errors()
 
 
 def main() -> int:

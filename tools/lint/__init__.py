@@ -1,0 +1,311 @@
+"""guberlint — AST lint suite pinning the serving-path invariants.
+
+The north-star contract (SURVEY §"What the new framework will be") is
+that the serving path is a pre-compiled, device-resident scatter-update
+loop: no cold compiles, no hidden host syncs, no Python nondeterminism
+inside jitted code, no config drift between the env-knob surface and
+its documentation. PR 2 made those invariants *observable* at runtime
+(cold-compile counter, flight recorder); this package makes them
+*statically enforced* on every PR, pure-AST and jax-free so the check
+stays tier-1 cheap.
+
+Architecture
+------------
+- ``Rule`` subclasses register themselves in ``REGISTRY`` (import-time,
+  via ``__init_subclass__``). A rule is either *module-scoped*
+  (``check_module(mod)`` runs per parsed file) or *repo-scoped*
+  (``check_repo(ctx)`` runs once over the whole scan — used by the
+  drift rules that compare code against docs).
+- Findings carry a stable ``key`` (rule + path + semantic slug, NO line
+  number) so the committed baseline survives unrelated line drift.
+  The baseline maps key -> occurrence count: existing findings are
+  grandfathered, any *new* occurrence of the same key still fails.
+- Inline suppression: ``# guberlint: allow-<rule-name> -- reason`` on
+  the finding's line or the line directly above. Rules may demand a
+  non-empty reason (GL006 does).
+
+CLI: ``python -m tools.lint`` (see ``__main__.py``). Docs:
+docs/linting.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_ROOTS = ("gubernator_tpu", "tools")
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+# Generated protobuf modules and lint fixtures are never scanned.
+_EXCLUDED_DIR_PARTS = {"protos", "__pycache__", "lint_fixtures"}
+
+_PRAGMA_RE = re.compile(r"#\s*guberlint:\s*(?P<body>.+?)\s*$")
+_ALLOW_RE = re.compile(r"allow-(?P<name>[a-z0-9-]+)(?:\s*--\s*(?P<reason>.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # "GL001"
+    name: str  # pragma slug, e.g. "host-sync"
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    key: str  # stable baseline key (no line numbers)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.name}] {self.message}"
+
+
+class Pragmas:
+    """Per-line ``# guberlint: allow-*`` directives for one file."""
+
+    def __init__(self, source: str):
+        # line no (1-based) -> {rule-name: reason-or-None}
+        self.by_line: Dict[int, Dict[str, Optional[str]]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            allows: Dict[str, Optional[str]] = {}
+            for am in _ALLOW_RE.finditer(m.group("body")):
+                reason = am.group("reason")
+                allows[am.group("name")] = reason.strip() if reason else None
+            if allows:
+                self.by_line[i] = allows
+
+    def lookup(self, line: int, name: str) -> Tuple[bool, Optional[str]]:
+        """(present, reason) for an allow-<name> pragma covering `line`
+        (same line or the comment line directly above)."""
+        for ln in (line, line - 1):
+            allows = self.by_line.get(ln)
+            if allows and name in allows:
+                return True, allows[name]
+        return False, None
+
+
+class Module:
+    """One parsed source file handed to module-scoped rules."""
+
+    def __init__(self, abspath: str, relpath: str, source: str, tree: ast.AST):
+        self.abspath = abspath
+        self.relpath = relpath  # posix, repo-relative
+        self.source = source
+        self.tree = tree
+        self.pragmas = Pragmas(source)
+
+    def segment(self, node: ast.AST) -> str:
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is total on 3.10
+            return "<unprintable>"
+
+
+class Context:
+    """Whole-scan context handed to repo-scoped rules."""
+
+    def __init__(self, modules: List[Module], full_repo: bool):
+        self.modules = modules
+        self.full_repo = full_repo
+        self.repo_root = REPO_ROOT
+
+    def read_doc(self, relpath: str) -> str:
+        with open(
+            os.path.join(self.repo_root, relpath), encoding="utf-8"
+        ) as f:
+            return f.read()
+
+
+REGISTRY: List["Rule"] = []
+
+
+class Rule:
+    """Base class; subclassing registers the rule."""
+
+    code: str = ""
+    name: str = ""  # pragma slug
+    description: str = ""
+    requires_reason: bool = False  # allow-pragma must carry a reason
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.code:
+            REGISTRY.append(cls())
+
+    # override exactly one of these
+    def check_module(self, mod: Module) -> List[Finding]:
+        return []
+
+    def check_repo(self, ctx: Context) -> List[Finding]:
+        return []
+
+    def finding(
+        self, path: str, line: int, message: str, slug: str
+    ) -> Finding:
+        return Finding(
+            rule=self.code,
+            name=self.name,
+            path=path,
+            line=line,
+            message=message,
+            key=f"{self.code}:{path}:{slug}",
+        )
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        p = os.path.join(REPO_ROOT, p) if not os.path.isabs(p) else p
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames if d not in _EXCLUDED_DIR_PARTS
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def load_modules(files: Iterable[str]) -> Tuple[List[Module], List[Finding]]:
+    mods, errors = [], []
+    for f in files:
+        rel = os.path.relpath(f, REPO_ROOT).replace(os.sep, "/")
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=f)
+        except SyntaxError as e:
+            errors.append(
+                Finding(
+                    rule="GLSYN",
+                    name="syntax",
+                    path=rel,
+                    line=e.lineno or 0,
+                    message=f"syntax error: {e.msg}",
+                    key=f"GLSYN:{rel}",
+                )
+            )
+            continue
+        mods.append(Module(f, rel, src, tree))
+    return mods, errors
+
+
+def _apply_pragmas(
+    findings: List[Finding], mods: List[Module]
+) -> List[Finding]:
+    """Drop findings suppressed by inline pragmas; a reason-requiring
+    rule whose pragma lacks a reason keeps the finding (re-messaged)."""
+    by_path = {m.relpath: m for m in mods}
+    rules = {r.name: r for r in REGISTRY}
+    out = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is None:
+            out.append(f)
+            continue
+        present, reason = mod.pragmas.lookup(f.line, f.name)
+        if not present:
+            out.append(f)
+            continue
+        rule = rules.get(f.name)
+        if rule is not None and rule.requires_reason and not reason:
+            out.append(
+                dataclasses.replace(
+                    f,
+                    message=(
+                        f"allow-{f.name} pragma requires a non-empty "
+                        f"reason ('# guberlint: allow-{f.name} -- why')"
+                    ),
+                )
+            )
+        # else: suppressed
+    return out
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "comment": (
+                    "guberlint grandfathered findings; regenerate with "
+                    "`python -m tools.lint --update-baseline`. New "
+                    "occurrences beyond these counts still fail."
+                ),
+                "findings": dict(sorted(counts.items())),
+            },
+            f,
+            indent=1,
+            sort_keys=False,
+        )
+        f.write("\n")
+
+
+@dataclasses.dataclass
+class Result:
+    findings: List[Finding]  # every unsuppressed finding
+    new: List[Finding]  # findings not covered by the baseline
+    stale_keys: List[str]  # baseline entries no longer observed
+
+
+def run_lint(
+    paths: Optional[Iterable[str]] = None,
+    rule_codes: Optional[Iterable[str]] = None,
+    baseline: Optional[Dict[str, int]] = None,
+) -> Result:
+    full_repo = paths is None
+    mods, findings = load_modules(iter_py_files(paths or DEFAULT_ROOTS))
+    ctx = Context(mods, full_repo)
+    wanted = None
+    if rule_codes is not None:
+        wanted = {c.upper() for c in rule_codes} | {
+            c.lower() for c in rule_codes
+        }
+    for rule in REGISTRY:
+        if wanted is not None and not (
+            rule.code.upper() in wanted or rule.name.lower() in wanted
+        ):
+            continue
+        for mod in mods:
+            findings.extend(rule.check_module(mod))
+        findings.extend(rule.check_repo(ctx))
+    findings = _apply_pragmas(findings, mods)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    base = dict(baseline or {})
+    seen: Dict[str, int] = {}
+    new = []
+    for f in findings:
+        seen[f.key] = seen.get(f.key, 0) + 1
+        if seen[f.key] > base.get(f.key, 0):
+            new.append(f)
+    stale = sorted(
+        k
+        for k, n in base.items()
+        if seen.get(k, 0) < n
+    )
+    return Result(findings=findings, new=new, stale_keys=stale)
+
+
+# Rule registration (import populates REGISTRY).
+from tools.lint import rules as _rules  # noqa: E402,F401

@@ -1,0 +1,120 @@
+"""CLI: python -m tools.lint [paths...] [options].
+
+Exit status: 0 when no *new* findings (everything is clean, pragma'd,
+or baselined); 1 when new findings exist; 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.lint import (
+    DEFAULT_BASELINE,
+    REGISTRY,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from tools.lint import rules as _rules
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="guberlint: serving-path invariant lint (docs/linting.md)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to scan (default: gubernator_tpu + tools; "
+        "explicit paths skip the repo-level doc-drift directions)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help=f"baseline file (default {DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to the current finding set",
+    )
+    ap.add_argument(
+        "--fix-docs",
+        action="store_true",
+        help="append stub entries to docs/config.md + example.conf for "
+        "GL003 undocumented-knob findings",
+    )
+    ap.add_argument(
+        "--rules",
+        default=None,
+        help="comma list of rule codes or names to run (default: all)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in REGISTRY:
+            reason = " (pragma requires reason)" if r.requires_reason else ""
+            print(f"{r.code}  allow-{r.name}{reason}\n    {r.description}")
+        return 0
+
+    rule_codes = args.rules.split(",") if args.rules else None
+    baseline = (
+        {} if args.no_baseline else load_baseline(args.baseline)
+    )
+    result = run_lint(
+        paths=args.paths or None,
+        rule_codes=rule_codes,
+        baseline=baseline,
+    )
+
+    if args.fix_docs:
+        for action in _rules.fix_docs(result.new):
+            print(f"fix-docs: {action}")
+        if any(f.rule == "GL003" for f in result.new):
+            # re-run so stubbed knobs no longer count as new
+            result = run_lint(
+                paths=args.paths or None,
+                rule_codes=rule_codes,
+                baseline=baseline,
+            )
+
+    if args.update_baseline:
+        save_baseline(args.baseline, result.findings)
+        print(
+            f"baseline updated: {len(result.findings)} finding(s) -> "
+            f"{args.baseline}"
+        )
+        return 0
+
+    for f in result.new:
+        print(f.render())
+    if not args.quiet:
+        grandfathered = len(result.findings) - len(result.new)
+        print(
+            f"guberlint: {len(result.new)} new finding(s), "
+            f"{grandfathered} baselined",
+            file=sys.stderr,
+        )
+        if result.stale_keys:
+            print(
+                f"guberlint: {len(result.stale_keys)} stale baseline "
+                f"entr{'y' if len(result.stale_keys) == 1 else 'ies'} "
+                f"(fixed findings — run --update-baseline to prune): "
+                + ", ".join(result.stale_keys[:5])
+                + ("..." if len(result.stale_keys) > 5 else ""),
+                file=sys.stderr,
+            )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
