@@ -129,6 +129,32 @@ class UpdatePeerGlobal:
     created_at: int = 0
 
 
+# ---- typed error statuses ---------------------------------------------------
+#
+# RateLimitResp.error is a free-form string on the wire (reference proto
+# contract), so machine-checkable statuses are expressed as a stable
+# prefix convention: "UNAVAILABLE:" marks a *retryable* condition — the
+# serving node is draining or overloaded, the request was NOT applied,
+# and an edge/client may safely re-dispatch it (to the same cluster,
+# where discovery will route it to the new owner). Anything else is a
+# terminal per-item failure.
+
+RETRYABLE_PREFIX = "UNAVAILABLE:"
+
+# The engine pump is shutting down and the drain budget expired before
+# this request could be served (replaces the bare "engine shutdown").
+ERR_ENGINE_DRAINING = RETRYABLE_PREFIX + " engine draining; retry"
+
+# A peer's forward batch queue is full (overload shed, never blocked).
+ERR_PEER_OVERLOADED = RETRYABLE_PREFIX + " peer forward queue full; retry"
+
+
+def is_retryable_error(error: str) -> bool:
+    """True when a RateLimitResp.error marks a request that was NOT
+    applied and can be safely re-dispatched (drain/overload shedding)."""
+    return bool(error) and error.startswith(RETRYABLE_PREFIX)
+
+
 def validate_request(req: RateLimitReq) -> Optional[str]:
     """Per-item validation; returns an error string or None.
 

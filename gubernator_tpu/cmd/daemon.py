@@ -78,8 +78,17 @@ def main() -> None:
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
-        logging.info("shutting down")
+        # Graceful drain, not teardown (docs/robustness.md): /readyz
+        # flips to `draining`, in-flight RPCs and the engine queue finish
+        # inside GUBER_DRAIN_TIMEOUT, replication queues flush, owned
+        # keys hand off to ring successors, THEN the listeners die.
+        logging.info(
+            "signal received: draining (budget %.1fs) — queues flush and "
+            "owned keys hand off before teardown",
+            getattr(conf, "drain_timeout_s", 5.0),
+        )
         await d.close()
+        logging.info("drain complete; daemon stopped")
 
     asyncio.run(run())
 

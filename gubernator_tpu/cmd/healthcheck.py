@@ -2,6 +2,13 @@
 (reference cmd/healthcheck/main.go): GET /v1/HealthCheck, exit 0 iff
 healthy.
 
+Exit codes:
+    0  healthy
+    1  unhealthy / unreachable — orchestrators may restart the pod
+    2  draining — graceful shutdown in progress: stop routing, do NOT
+       kill early (queued work is finishing and owned keys are handing
+       off to ring successors; docs/robustness.md)
+
 Address resolution (first match wins):
     --url                              explicit probe URL
     GUBER_STATUS_HTTP_ADDRESS          the no-mTLS status listener exists
@@ -47,7 +54,13 @@ def main(argv=None) -> int:
     except Exception as e:
         print(f"unhealthy: {e}", file=sys.stderr)
         return 1
-    if body.get("status") != "healthy":
+    status = body.get("status")
+    if status == "draining":
+        # Distinct from unhealthy: the node is leaving on purpose.
+        # Stop routing; don't kill the pod before the drain finishes.
+        print(f"draining: {body}", file=sys.stderr)
+        return 2
+    if status != "healthy":
         print(f"unhealthy: {body}", file=sys.stderr)
         return 1
     print("healthy")

@@ -409,6 +409,40 @@ class Metrics:
             "Edge-tier frame calls that timed out waiting on the device "
             "daemon (edge processes expose this on their own /metrics).",
         )
+        self.forward_queue_full = counter(
+            "gubernator_forward_queue_full",
+            "Forwarded checks shed with the typed overload error because "
+            "the target peer's batch queue was full (producers never "
+            "block on a full queue).",
+        )
+
+        # Zero-loss elasticity (docs/robustness.md "Rolling restarts &
+        # handover"; no reference analog — the reference accepts counter
+        # loss whenever ownership moves)
+        self.handover_keys_sent = counter(
+            "gubernator_handover_keys_sent",
+            "Keys shipped to their new owners during ring-change or "
+            "drain handover (TransferSnapshots sender side).",
+        )
+        self.handover_keys_received = counter(
+            "gubernator_handover_keys_received",
+            "Handover keys merged into the local table "
+            "(TransferSnapshots receiver side, after last-writer-wins).",
+        )
+        self.handover_keys_dropped = counter(
+            "gubernator_handover_keys_dropped",
+            "Handover keys NOT transferred, by reason: max_keys (over "
+            "GUBER_HANDOVER_MAX_KEYS), circuit_open (target breaker "
+            "open), deadline (budget exhausted), send_error (transport "
+            "failure), stale (receiver had a newer stamp).",
+            ["reason"],
+        )
+        self.handover_duration = Summary(
+            "gubernator_handover_duration",
+            "Wall time of one handover pass (snapshot gather + chunked "
+            "transfer legs) in seconds.",
+            registry=r,
+        )
 
         # GLOBAL behavior (reference global.go:50-67)
         self.broadcast_duration = Summary(

@@ -94,6 +94,19 @@ class BatchQueue:
                     # silent (reference logs every leg, global.go:180-186).
                     self.on_error(take, e)
 
+    async def drain(self) -> None:
+        """One final flush of whatever is queued (graceful-drain path,
+        docs/robustness.md): called before close() so queued legs ship
+        instead of dying with the loop. Failures go to on_error like any
+        flush — the redelivery callbacks decide what survives."""
+        take, self.items = self.items, {}
+        self.on_len(0)
+        if take:
+            try:
+                await self.flush(take)
+            except Exception as e:
+                self.on_error(take, e)
+
     async def close(self) -> None:
         self._running = False
         self._wake.set()
@@ -378,6 +391,16 @@ class GlobalManager:
             self.svc.metrics.broadcast_counter.inc()
         finally:
             self.svc.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+
+    async def drain(self) -> None:
+        """Flush both legs once before shutdown (zero-loss drain): queued
+        hit-updates reach their owners and queued broadcasts reach the
+        replicas. A hit leg that fails here requeues as usual; whatever
+        is still queued after this final pass is surrendered to the
+        drain handover (the successor inherits the local table, which
+        already includes every locally-applied hit)."""
+        await self._hits_q.drain()
+        await self._upd_q.drain()
 
     async def close(self) -> None:
         await self._hits_q.close()

@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import concurrent.futures
 import dataclasses
+import logging
 import random
 import time
 from typing import Dict, List, Optional, Sequence
@@ -46,9 +48,23 @@ from gubernator_tpu.utils.breaker import STATE_NAMES, CircuitBreaker
 
 _ERROR_TTL_S = 300.0  # reference: 5-minute TTL error cache
 
+log = logging.getLogger("gubernator.peers")
+
 
 class CircuitOpenError(RuntimeError):
     """The owner's circuit breaker is open and degraded mode is off."""
+
+
+class PeerOverloadedError(RuntimeError):
+    """The target peer's forward batch queue is full. Typed so callers
+    shed instead of retrying into the same full queue; the request was
+    never enqueued, so re-dispatch is safe (api.types.is_retryable_error
+    recognizes the message prefix)."""
+
+    def __init__(self, addr: str, depth: int):
+        from gubernator_tpu.api.types import ERR_PEER_OVERLOADED
+
+        super().__init__(f"{ERR_PEER_OVERLOADED} (peer {addr}, {depth} queued)")
 
 
 class Peer:
@@ -134,7 +150,17 @@ class Peer:
             raise RuntimeError("peer client shutdown")
         q = self._ensure_pump()
         fut = asyncio.get_running_loop().create_future()
-        await q.put((req, fut))
+        try:
+            # Shed, never block: a full queue means the pump is already
+            # saturated — an unbounded await here would pile every
+            # producer coroutine behind a slow peer (docs/robustness.md).
+            q.put_nowait((req, fut))
+        except asyncio.QueueFull:
+            if self.metrics is not None and hasattr(
+                self.metrics, "forward_queue_full"
+            ):
+                self.metrics.forward_queue_full.inc()
+            raise PeerOverloadedError(self.info.grpc_address, q.qsize())
         # Upper bound so a request can never hang if the pump dies between
         # the _closed check and the put (shutdown race); a tighter caller
         # deadline wins.
@@ -202,6 +228,32 @@ class Peer:
             msg, timeout=timeout or self.behaviors.global_timeout_s
         )
 
+    async def transfer_snapshots(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> dict:
+        """Ship one handover chunk (pb.snapshots_to_bytes payload) to
+        this peer; breaker- and fault-wrapped like every transport leg."""
+        try:
+            if faults.active():
+                await faults.inject(
+                    self.info.grpc_address, faults.OP_PEER_TRANSFER
+                )
+            out = await self._rpc_transfer_snapshots(payload, timeout)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return out
+
+    async def _rpc_transfer_snapshots(
+        self, payload: bytes, timeout: Optional[float]
+    ) -> dict:
+        stub = self._ensure_stub()
+        raw = await stub.transfer_snapshots(
+            payload, timeout=timeout or self.behaviors.global_timeout_s
+        )
+        return pb.transfer_resp_from_bytes(raw)
+
     # -- batch pump (reference peer_client.go:284-404) -----------------------
 
     async def _run_batch(self) -> None:
@@ -256,7 +308,14 @@ class Peer:
         channel (reference peer_client.go:408-435)."""
         self._closed = True
         if self._queue is not None:
-            await self._queue.put(None)
+            try:
+                self._queue.put_nowait(None)
+            except asyncio.QueueFull:
+                # Full queue: the sentinel can't ride FIFO; cancel the
+                # pump instead (its CancelledError path fails the batch
+                # in flight, and the sweep below fails the queued rest).
+                if self._pump_task is not None:
+                    self._pump_task.cancel()
         if self._pump_task is not None:
             try:
                 await asyncio.wait_for(self._pump_task, timeout=1.0)
@@ -302,6 +361,17 @@ class PeerMesh:
         self.local_ring = ReplicatedConsistentHash(hash_fn, replicas)
         self.region_picker = RegionPicker(ReplicatedConsistentHash(hash_fn, replicas))
         self._all: Dict[str, Peer] = {}
+        # Handover scheduling: set_peers may run on the daemon's loop
+        # (discovery callbacks) or off it (tests, sync callers); the
+        # loop captured at construction (Daemon.start) lets off-loop
+        # ring swaps still ship state via run_coroutine_threadsafe.
+        try:
+            self._loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._loop = None
+        # Most recent ring-change handover (asyncio.Task or
+        # concurrent.futures.Future); tests wait on it via wait_handover.
+        self.handover_last = None
         # Bounded like the reference's TTL'd error cache (peer_client.go
         # :206-235 caps ~100 entries): append is O(1) and pruning happens
         # only on READ. An unbounded list rebuilt per insert livelocks the
@@ -334,7 +404,17 @@ class PeerMesh:
         return self.region_picker.peers()
 
     def set_peers(self, peers: Sequence[PeerInfo], local_info: PeerInfo) -> None:
-        """Atomic ring swap with Peer reuse (reference gubernator.go:616-711)."""
+        """Atomic ring swap with Peer reuse (reference gubernator.go:616-711).
+
+        When membership actually changed, a ring-change handover is
+        scheduled after the swap: counter state for keys this node owned
+        under the OLD ring but no longer owns under the new one ships to
+        the new owners (docs/robustness.md "Rolling restarts &
+        handover"). The old-ownership filter matters — replica-held
+        GLOBAL state must NOT ship, or a stale broadcast copy could
+        clobber the owner's newer bucket via the LWW merge."""
+        old_ring = self.local_ring
+        old_addrs = {p.info.grpc_address for p in old_ring.peers()}
         new_local = self.local_ring.new()
         new_region = self.region_picker.new()
         keep: Dict[str, Peer] = {}
@@ -368,6 +448,180 @@ class PeerMesh:
                 # Called outside the event loop (tests, sync callers):
                 # the handle is marked closed; channel cleanup happens on GC.
                 pass
+        new_addrs = {p.info.grpc_address for p in new_local.peers()}
+        if (
+            self._handover_ready()
+            and old_addrs
+            and old_addrs != new_addrs
+        ):
+
+            def route(key: str):
+                try:
+                    old = old_ring.get(key)
+                    new = self.local_ring.get(key)
+                except RuntimeError:
+                    return None  # a ring emptied; nowhere to ship
+                if not old.info.is_owner or new.info.is_owner:
+                    return None  # we never owned it, or still own it
+                return new
+
+            self.handover_last = self._spawn_handover(
+                self._handover(route, reason="ring_change")
+            )
+
+    # -- ownership handover (docs/robustness.md) -----------------------------
+
+    def _handover_ready(self) -> bool:
+        """Cheap preconditions checked BEFORE spawning the coroutine so
+        stub services / snapshot-less engines never leave a pending task
+        behind (unit tests close their loops right after set_peers)."""
+        return (
+            getattr(self.behaviors, "handover", True)
+            and self.svc is not None
+            and getattr(self.svc, "engine", None) is not None
+            and hasattr(self.svc.engine, "snapshot")
+        )
+
+    def _spawn_handover(self, coro):
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            if self._loop is None:
+                coro.close()
+                return None
+            return asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return asyncio.ensure_future(coro)
+
+    def wait_handover(self, timeout: float = 10.0) -> None:
+        """Block until the most recent ring-change handover finishes
+        (off-loop helper for tests/jobs; no-op when none ran)."""
+        t = self.handover_last
+        if t is None:
+            return
+        if isinstance(t, concurrent.futures.Future):
+            t.result(timeout)
+            return
+        asyncio.run_coroutine_threadsafe(
+            asyncio.wait_for(asyncio.shield(t), timeout), t.get_loop()
+        ).result(timeout + 1.0)
+
+    async def drain_handover(self) -> None:
+        """Graceful-drain half of handover: ship every key this node
+        owns to its ring successor (the ring minus self) before
+        teardown, so a rolling restart loses nothing."""
+        if not self._handover_ready():
+            return
+        cur = self.local_ring
+        others = [p for p in cur.peers() if not p.info.is_owner]
+        if not others:
+            return  # cluster of one: Loader.save is the only successor
+        succ = cur.new()
+        for p in others:
+            succ.add(p)
+
+        def route(key: str):
+            try:
+                old = cur.get(key)
+            except RuntimeError:
+                return None
+            if not old.info.is_owner:
+                return None  # replica-held state; its owner ships it
+            try:
+                return succ.get(key)
+            except RuntimeError:
+                return None
+        await self._handover(route, reason="drain")
+
+    async def _handover(self, route, reason: str) -> None:
+        """Gather ItemSnapshots for keys `route` re-homes, then ship
+        them to the new owners in bounded chunks over TransferSnapshots.
+        Legs run under the per-peer circuit breakers and a per-peer
+        deadline budget (forward_deadline_s, shared across that peer's
+        chunks) — a dead successor costs one shed leg, never a stall."""
+        from gubernator_tpu.store.store import snapshots_from_engine
+
+        m = self.svc.metrics
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        try:
+            snaps = await loop.run_in_executor(
+                None, snapshots_from_engine, self.svc.engine
+            )
+        except Exception as e:
+            log.warning("handover(%s): snapshot gather failed: %s", reason, e)
+            self.record_error(f"handover snapshot gather failed: {e}")
+            return
+        max_keys = int(getattr(self.behaviors, "handover_max_keys", 100_000))
+        chunk = max(1, int(getattr(self.behaviors, "handover_chunk", 512)))
+        by_peer: Dict[str, tuple] = {}
+        moved = 0
+        dropped_cap = 0
+        for s in snaps:
+            peer = route(s.key)
+            if peer is None:
+                continue
+            if moved >= max_keys:
+                dropped_cap += 1
+                continue
+            entry = by_peer.get(peer.info.grpc_address)
+            if entry is None:
+                by_peer[peer.info.grpc_address] = (peer, [s])
+            else:
+                entry[1].append(s)
+            moved += 1
+        if dropped_cap:
+            m.handover_keys_dropped.labels("max_keys").inc(dropped_cap)
+            log.warning(
+                "handover(%s): %d key(s) over GUBER_HANDOVER_MAX_KEYS=%d "
+                "dropped (their new owners start fresh)",
+                reason, dropped_cap, max_keys,
+            )
+        if not by_peer:
+            return
+        budget_s = float(getattr(self.behaviors, "forward_deadline_s", 2.0))
+
+        async def ship(peer: Peer, items) -> int:
+            addr = peer.info.grpc_address
+            deadline = loop.time() + budget_s
+            sent = 0
+            for i in range(0, len(items), chunk):
+                rest = len(items) - i
+                if not peer.breaker.allow():
+                    m.handover_keys_dropped.labels("circuit_open").inc(rest)
+                    self.record_error(
+                        f"{addr}: handover skipped {rest} key(s) "
+                        "(circuit open)"
+                    )
+                    return sent
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    m.handover_keys_dropped.labels("deadline").inc(rest)
+                    self.record_error(
+                        f"{addr}: handover deadline ({budget_s:.2f}s) "
+                        f"exhausted with {rest} key(s) left"
+                    )
+                    return sent
+                part = items[i : i + chunk]
+                try:
+                    await peer.transfer_snapshots(
+                        pb.snapshots_to_bytes(part), timeout=remaining
+                    )
+                except Exception as e:
+                    m.handover_keys_dropped.labels("send_error").inc(rest)
+                    self.record_error(f"{addr}: handover failed: {e}")
+                    return sent
+                m.handover_keys_sent.inc(len(part))
+                sent += len(part)
+            return sent
+        totals = await asyncio.gather(
+            *(ship(p, items) for p, items in by_peer.values())
+        )
+        m.handover_duration.observe(time.perf_counter() - t0)
+        log.info(
+            "handover(%s): shipped %d/%d key(s) to %d peer(s) in %.3fs",
+            reason, sum(totals), moved, len(by_peer),
+            time.perf_counter() - t0,
+        )
 
     # -- forwarder interface (reference gubernator.go:311-391) ---------------
 
@@ -438,6 +692,14 @@ class PeerMesh:
                 resp.metadata = dict(resp.metadata or {})
                 resp.metadata["owner"] = peer.info.grpc_address
                 return resp
+            except PeerOverloadedError:
+                # Overload shed is typed and final: retrying would land
+                # in the same full queue. The caller (or an edge) can
+                # re-dispatch — the request was never enqueued.
+                self.record_error(
+                    f"{peer.info.grpc_address}: forward queue full"
+                )
+                raise
             except Exception as e:
                 self.record_error(f"{peer.info.grpc_address}: {e}")
                 if attempts >= 5:

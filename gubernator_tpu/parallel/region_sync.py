@@ -355,6 +355,14 @@ class RegionManager:
                 time.perf_counter() - t0
             )
 
+    async def drain(self) -> None:
+        """Final flush of both cross-region legs before shutdown
+        (graceful-drain path, docs/robustness.md): a lost delta would
+        permanently undercount the home region, so it ships now rather
+        than dying with the loop."""
+        await self._hits_q.drain()
+        await self._upd_q.drain()
+
     async def close(self) -> None:
         await self._hits_q.close()
         await self._upd_q.close()

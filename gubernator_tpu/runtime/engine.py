@@ -36,6 +36,7 @@ from gubernator_tpu.utils import lockorder
 from gubernator_tpu.api.keys import group_of, key_hash128, key_hash128_batch
 from gubernator_tpu.api.types import (
     Behavior,
+    ERR_ENGINE_DRAINING,
     RateLimitReq,
     RateLimitResp,
     validate_request,
@@ -71,6 +72,18 @@ class EngineConfig:
     # over to the next flush in arrival order.
     max_waves: int = 32
     keep_key_strings: bool = True  # hash -> string dict (Loader/debug)
+    # Record key strings on the STORE-LESS columnar edge too (bulk
+    # membership probe + decode of never-seen keys only). Required for
+    # ownership handover — an anonymous row cannot be ring-placed at its
+    # new owner; daemons running GUBER_HANDOVER=off with no Loader can
+    # drop it for the last word of fastpath host time.
+    record_columnar_keys: bool = True
+    # Graceful-drain budget (GUBER_DRAIN_TIMEOUT): on close() the pump
+    # keeps serving whatever is already queued for up to this long;
+    # only stragglers past the budget fail, and they fail with the
+    # typed retryable status (api.types.ERR_ENGINE_DRAINING) so edges
+    # and clients can re-dispatch instead of reporting a loss.
+    drain_timeout_s: float = 5.0
     # Background-compile power-of-two batch widths (128..batch_size) so
     # the columnar edge can size the kernel to each call's occupancy.
     fast_buckets: bool = False
@@ -227,6 +240,12 @@ class EngineBase:
     def check_async(self, req: RateLimitReq) -> "Future[RateLimitResp]":
         """Enqueue one request; resolves after its wave executes."""
         fut: Future = Future()
+        if not self._running:
+            # The pump already exited its drain phase; nothing will ever
+            # pull this entry, so fail it typed-retryable immediately
+            # instead of letting the future hang.
+            fut.set_result(RateLimitResp(error=ERR_ENGINE_DRAINING))
+            return fut
         err = validate_request(req)
         if err is not None:
             fut.set_result(RateLimitResp(error=err))
@@ -241,6 +260,11 @@ class EngineBase:
         (amortizes pump wakeups and future overhead; the natural fit for
         the batched GetRateLimits API). Resolves in request order."""
         out: Future = Future()
+        if not self._running:
+            out.set_result(
+                [RateLimitResp(error=ERR_ENGINE_DRAINING) for _ in reqs]
+            )
+            return out
         slots: List[_Slot] = []
         work = []
         now = None
@@ -271,9 +295,16 @@ class EngineBase:
         self._queue.put(_FLUSH)
 
     def close(self) -> None:
-        self._running = False
+        """Drain, then stop. The pump keeps serving whatever is already
+        queued (the FIFO guarantees everything enqueued before this call
+        is seen before _STOP), then runs a bounded drain pass for
+        entries that raced the shutdown; only stragglers past
+        cfg.drain_timeout_s fail, with the typed retryable status
+        (api.types.ERR_ENGINE_DRAINING) so callers can re-dispatch."""
+        drain_s = max(float(getattr(self.cfg, "drain_timeout_s", 5.0)), 0.0)
         self._queue.put(_STOP)
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=5 + drain_s)
+        self._running = False  # backstop for a wedged pump
         # The bucket warmer compiles inside XLA C++ frames; if it is
         # still alive when the interpreter finalizes, its GIL touch
         # turns into pthread_exit's forced unwind through C++ catch(...)
@@ -338,6 +369,7 @@ class EngineBase:
                 except queue.Empty:
                     item = _FLUSH
             if item is _STOP:
+                self._running = False
                 break
             batch: List[Tuple[RateLimitReq, object]] = list(carry)
             carry = []
@@ -394,12 +426,83 @@ class EngineBase:
                     else:
                         still.append(b)
                 pending_bulks = still
-        # Shutdown: fail anything still carried and resolve bulks.
+        # Shutdown: drain whatever is still queued within the drain
+        # budget (zero-loss elasticity, docs/robustness.md), then fail
+        # stragglers with the typed retryable status.
+        carry, pending_bulks = self._drain_tail(carry, pending_bulks)
         for _, fut in carry:
             if not fut.done():
-                fut.set_result(RateLimitResp(error="engine shutdown"))
+                fut.set_result(RateLimitResp(error=ERR_ENGINE_DRAINING))
         for b in pending_bulks:
             b.resolve()
+
+    def _drain_tail(self, carry, pending_bulks):
+        """Serve queue entries that raced the shutdown signal. Entries
+        enqueued before close() are already handled by the main loop
+        (FIFO order puts them ahead of _STOP); this pass covers carried
+        wave overflow and producers that slipped in between the _STOP
+        being seen and _running going False. Returns the (pairs, bulks)
+        the drain budget could not serve."""
+        deadline = time.monotonic() + max(
+            float(getattr(self.cfg, "drain_timeout_s", 5.0)), 0.0
+        )
+        pending = list(carry)
+        bulks = list(pending_bulks)
+
+        def pull(entry) -> None:
+            if entry is _STOP or entry is _FLUSH:
+                return
+            if type(entry) is _Bulk:
+                pending.extend(entry.work)
+                bulks.append(entry)
+            else:
+                req, fut, _t = entry
+                pending.append((req, fut))
+
+        while time.monotonic() <= deadline:
+            # Sweep everything currently queued into `pending`.
+            while True:
+                try:
+                    pull(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            if not pending:
+                # Idle: wait one short beat for producers that raced the
+                # intake guard (checked _running before it went False),
+                # then exit.
+                try:
+                    pull(self._queue.get(timeout=0.02))
+                except queue.Empty:
+                    break
+                continue
+            batch = pending[: self.cfg.max_flush_items]
+            pending = pending[self.cfg.max_flush_items:]
+            try:
+                extra = self._process(batch) or []
+            except Exception as e:  # never die mid-drain
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(RateLimitResp(error=str(e)))
+                extra = []
+            # Wave-capped leftovers retry first (per-key arrival order).
+            pending = list(extra) + pending
+            still = []
+            for b in bulks:
+                if all(s.done() for s in b.slots):
+                    b.resolve()
+                else:
+                    still.append(b)
+            bulks = still
+        # Past the budget (or idle): hand back the stragglers — including
+        # anything still sitting in the queue — so the caller fails them
+        # with the typed retryable status instead of leaving futures
+        # hanging.
+        while True:
+            try:
+                pull(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return pending, bulks
 
 
 class DeviceEngine(EngineBase):
@@ -857,7 +960,8 @@ class DeviceEngine(EngineBase):
         else:
             hi, lo, grp = hashes
         # Key strings resolve through the ORIGINAL columns (select drops
-        # key_offsets); only the store path pays for string decodes.
+        # key_offsets); the store path decodes every key, the store-less
+        # path only never-seen ones (record_columnar_keys).
         orig_cols, sel_map = cols, None
         if select is not None:
             if len(select) == 0:
@@ -934,6 +1038,26 @@ class DeviceEngine(EngineBase):
             by_wave = [[] for _ in range(W)]
             for j, w_ in enumerate(wave_l):
                 by_wave[w_].append(j)
+        elif cfg.keep_key_strings and cfg.record_columnar_keys:
+            # Store-less columnar edge: keep the key-string dictionary
+            # complete so handover/Loader snapshots are routable
+            # (docs/robustness.md "Rolling restarts & handover" — an
+            # anonymous row cannot be ring-placed at its new owner).
+            # Cost discipline: a bulk (hi, lo) membership probe, and
+            # string decodes ONLY for never-seen keys — steady-state
+            # traffic pays dict lookups, not Python string builds.
+            keys_l = list(zip(hi.tolist(), lo.tolist()))
+            with self._keys_lock:
+                miss = [
+                    (j, k)
+                    for j, k in enumerate(keys_l)
+                    if k not in self._key_strings
+                ]
+            if miss:
+                decoded = [(k, key_str(j)) for j, k in miss]
+                with self._keys_lock:
+                    self._key_strings.update(decoded)
+                self._maybe_prune_key_strings()
 
         wave_slices = [jax.tree.map(lambda a, w=w: a[w], wb) for w in range(W)]
         lane_reqs: List[Dict[int, tuple]] = [{} for _ in range(W)]
@@ -1537,7 +1661,7 @@ class _Bulk:
                 [
                     s.value
                     if s.done()
-                    else RateLimitResp(error="engine shutdown")
+                    else RateLimitResp(error=ERR_ENGINE_DRAINING)
                     for s in self.slots
                 ]
             )

@@ -73,6 +73,21 @@ class BehaviorConfig:
     # hard-coded 30.0 in EdgeClient.call.
     edge_timeout_s: float = 30.0
 
+    # -- zero-loss elasticity (docs/robustness.md "Rolling restarts &
+    # handover"; no reference analog: the reference accepts counter
+    # loss whenever ownership moves) --------------------------------------
+
+    # GUBER_HANDOVER: when the ring changes (or this node drains), ship
+    # counter state for keys this node no longer owns to their new
+    # owners over TransferSnapshots; receivers merge last-writer-wins on
+    # stamp. Off restores the reference's lossy elasticity semantics.
+    handover: bool = True
+    # GUBER_HANDOVER_MAX_KEYS: cap on keys gathered per handover pass;
+    # beyond it keys drop (counted in gubernator_handover_keys_dropped).
+    handover_max_keys: int = 100_000
+    # GUBER_HANDOVER_CHUNK: keys per TransferSnapshots RPC leg.
+    handover_chunk: int = 512
+
 
 @dataclasses.dataclass
 class EtcdConfig:
@@ -228,6 +243,13 @@ class DaemonConfig:
     prewarm_buckets: bool = False
     prewarm_timeout_s: float = 600.0
 
+    # Graceful-drain budget (GUBER_DRAIN_TIMEOUT): bounds how long a
+    # SIGTERM/close() waits for in-flight RPCs and the engine queue to
+    # finish before stragglers fail with the typed retryable status.
+    # Also feeds EngineConfig.drain_timeout_s for the pump's own drain
+    # pass (docs/robustness.md "Rolling restarts & handover").
+    drain_timeout_s: float = 5.0
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine
@@ -251,4 +273,8 @@ class DaemonConfig:
             # compile in the background at boot.
             fast_buckets=True,
             layout=self.table_layout,
+            drain_timeout_s=self.drain_timeout_s,
+            # Handover needs routable (string-keyed) snapshots even on
+            # the store-less columnar edge; with it off, skip the decode.
+            record_columnar_keys=self.behaviors.handover,
         )

@@ -42,6 +42,8 @@ class Daemon:
         self.status_runner = None
         self.status_address = ""
         self._channel: Optional[grpc.aio.Channel] = None
+        # Lifecycle: serving -> draining -> stopped (docs/robustness.md)
+        self.state = "serving"
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -322,33 +324,80 @@ class Daemon:
                     await asyncio.sleep(0.05)
 
     async def close(self) -> None:
-        # Drain counters to the Loader before teardown (reference
-        # workerPool.Store at shutdown, gubernator.go:151-178)
-        if self.conf.loader is not None and self.engine is not None:
-            from gubernator_tpu.store import save_engine
+        """Graceful drain, then teardown (docs/robustness.md "Rolling
+        restarts & handover"). SIGTERM lands here via cmd/daemon.py; the
+        sequence flips the node lossless instead of dropping in-flight
+        traffic and resetting limits:
 
-            save_engine(self.engine, self.conf.loader)
+        1. DRAINING state: /readyz and HealthCheck report `draining`
+           (orchestrators stop routing without killing the pod), and
+           discovery deregisters so no new ownership lands here.
+        2. Intake stops: the gRPC/edge listeners quit accepting new
+           RPCs but in-flight calls get the drain budget to finish
+           (the engine pump is still alive to serve them).
+        3. Replication flush: queued GLOBAL hit-updates/broadcasts and
+           MULTI_REGION legs ship now instead of dying with the loop.
+        4. Ownership handover: every owned key's counter state ships to
+           its ring successor over TransferSnapshots.
+        5. Engine drain: the pump finishes its queue; only stragglers
+           past GUBER_DRAIN_TIMEOUT fail, with the typed retryable
+           status (api.types.ERR_ENGINE_DRAINING).
+        6. Loader.save runs AFTER the engine drained, so the checkpoint
+           includes every applied hit; then teardown."""
+        if self.state == "stopped":
+            return
+        drain_s = max(float(getattr(self.conf, "drain_timeout_s", 5.0)), 0.0)
+        self.state = "draining"
+        if self.svc is not None:
+            self.svc.draining = True
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
+        # preStop settle (the k8s preStop-sleep analog): calls already on
+        # the wire get dispatched to handlers before the listener stops
+        # accepting — without it, transport-queued RPCs die CANCELLED at
+        # stop() no matter how long the grace is.
+        await asyncio.sleep(min(0.05, drain_s))
+        if self.grpc_server is not None:
+            # Stops new RPCs immediately; in-flight handlers get up to
+            # the drain budget (the engine below them is still serving).
+            await self.grpc_server.stop(grace=drain_s)
         if getattr(self, "edge_listener", None) is not None:
             await self.edge_listener.close()
+        if self.svc is not None and self.svc.global_mgr is not None:
+            await self.svc.global_mgr.drain()
+        if self.svc is not None and getattr(self.svc, "region_mgr", None) is not None:
+            await self.svc.region_mgr.drain()
+        if self.svc is not None and hasattr(self.svc.forwarder, "drain_handover"):
+            await self.svc.forwarder.drain_handover()
         if self.svc is not None and self.svc.global_mgr is not None:
             await self.svc.global_mgr.close()
         if self.svc is not None and getattr(self.svc, "region_mgr", None) is not None:
             await self.svc.region_mgr.close()
+        if self.engine is not None:
+            # Engine close blocks for its own drain pass; keep the event
+            # loop responsive (other in-process daemons share it).
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.close
+            )
+        # Checkpoint AFTER the engine drained (reference workerPool.Store
+        # at shutdown, gubernator.go:151-178) so the snapshot includes
+        # every hit the drain just applied.
+        if self.conf.loader is not None and self.engine is not None:
+            from gubernator_tpu.store import save_engine
+
+            save_engine(self.engine, self.conf.loader)
         if self.svc is not None and self.svc.forwarder is not None:
             await self.svc.forwarder.close()
         if self._channel is not None:
-            await self._channel.close()
+            # Grace lets client-side RPCs that already have responses in
+            # flight deliver them instead of dying CANCELLED.
+            await self._channel.close(grace=drain_s)
             self._channel = None
-        if self.grpc_server is not None:
-            await self.grpc_server.stop(grace=0.5)
         if self.http_runner is not None:
             await self.http_runner.cleanup()
         if getattr(self, "status_runner", None) is not None:
             await self.status_runner.cleanup()
-        if self.engine is not None:
-            self.engine.close()
+        self.state = "stopped"
 
     # -- peers ---------------------------------------------------------------
 

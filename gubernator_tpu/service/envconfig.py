@@ -109,6 +109,12 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         global_requeue_limit=_env_int("GUBER_GLOBAL_REQUEUE_LIMIT", 10),
         global_requeue_max_keys=_env_int("GUBER_GLOBAL_REQUEUE_MAX_KEYS", 10_000),
         edge_timeout_s=parse_duration_s(_env("GUBER_EDGE_TIMEOUT"), 30.0),
+        # Zero-loss elasticity (docs/robustness.md "Rolling restarts &
+        # handover"): GUBER_HANDOVER=off restores the reference's lossy
+        # ownership-move semantics.
+        handover=_env_bool("GUBER_HANDOVER", True),
+        handover_max_keys=_env_int("GUBER_HANDOVER_MAX_KEYS", 100_000),
+        handover_chunk=_env_int("GUBER_HANDOVER_CHUNK", 512),
     )
     if behaviors.owner_unreachable not in ("error", "local"):
         raise ValueError(
@@ -141,6 +147,10 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         # never read from the environment).
         prewarm_buckets=_env_bool("GUBER_PREWARM_BUCKETS"),
         prewarm_timeout_s=parse_duration_s(_env("GUBER_PREWARM_TIMEOUT"), 600.0),
+        # SIGTERM drain budget (docs/robustness.md): in-flight RPCs, the
+        # engine queue, replication flushes, and the ownership handover
+        # all finish inside this window before teardown.
+        drain_timeout_s=parse_duration_s(_env("GUBER_DRAIN_TIMEOUT"), 5.0),
     )
 
     # Table layouts validate EARLY against the one registry

@@ -106,7 +106,10 @@ def add_probe_routes(app: web.Application, svc: V1Service) -> None:
     - /livez: process liveness only — 200 while the event loop serves.
     - /readyz: breaker-derived readiness — 200 "ready" (all circuits
       closed), 200 "degraded" (some open; surviving keys still serve),
-      503 "unready" (every peer circuit open). Flips degraded -> ready
+      503 "unready" (every peer circuit open), 503 "draining" (graceful
+      shutdown: stop routing, don't kill — the body distinguishes it
+      from "unready" so orchestrators and cmd/healthcheck.py can tell
+      a leaving node from a partitioned one). Flips degraded -> ready
       without a restart the moment a returning peer's circuit closes.
     """
 
@@ -116,7 +119,7 @@ def add_probe_routes(app: web.Application, svc: V1Service) -> None:
     async def readyz(request: web.Request) -> web.Response:
         r = svc.readiness()
         return web.json_response(
-            r, status=503 if r["status"] == "unready" else 200
+            r, status=503 if r["status"] in ("unready", "draining") else 200
         )
 
     app.router.add_get("/livez", livez)

@@ -148,3 +148,19 @@ class PeersV1Servicer:
                 [pb.global_from_pb(g) for g in request.globals]
             )
             return pb.peers_pb.UpdatePeerGlobalsResp()
+
+    async def TransferSnapshots(self, request_bytes, context):
+        """Ownership handover receiver (docs/robustness.md): merge the
+        sender's counter state last-writer-wins on stamp."""
+        async with _instrumented(
+            self.svc.metrics, "/pb.gubernator.PeersV1/TransferSnapshots"
+        ):
+            try:
+                snaps = pb.snapshots_from_bytes(request_bytes)
+            except (ValueError, TypeError):
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "malformed snapshot transfer",
+                )
+            accepted, stale = await self.svc.transfer_snapshots(snaps)
+            return pb.transfer_resp_to_bytes(accepted, stale)

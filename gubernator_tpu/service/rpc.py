@@ -39,7 +39,8 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
 
 
 def peers_handler(servicer) -> grpc.GenericRpcHandler:
-    """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req, ctx)."""
+    """servicer: async GetPeerRateLimits(req, ctx), UpdatePeerGlobals(req,
+    ctx), TransferSnapshots(req, ctx)."""
     return grpc.method_handlers_generic_handler(
         PEERS_SERVICE,
         {
@@ -53,6 +54,14 @@ def peers_handler(servicer) -> grpc.GenericRpcHandler:
                 servicer.UpdatePeerGlobals,
                 request_deserializer=pb.peers_pb.UpdatePeerGlobalsReq.FromString,
                 response_serializer=pb.peers_pb.UpdatePeerGlobalsResp.SerializeToString,
+            ),
+            # Ownership handover (docs/robustness.md): BYTES mode with a
+            # hand-encoded payload (pb.snapshots_to_bytes) — no protoc in
+            # this image, and the RPC runs at membership-change cadence.
+            "TransferSnapshots": grpc.unary_unary_rpc_method_handler(
+                servicer.TransferSnapshots,
+                request_deserializer=None,
+                response_serializer=None,
             ),
         },
     )
@@ -87,4 +96,10 @@ class PeersV1Stub:
             f"/{PEERS_SERVICE}/UpdatePeerGlobals",
             request_serializer=pb.peers_pb.UpdatePeerGlobalsReq.SerializeToString,
             response_deserializer=pb.peers_pb.UpdatePeerGlobalsResp.FromString,
+        )
+        # BYTES mode both ways (payload is pb.snapshots_to_bytes output).
+        self.transfer_snapshots = channel.unary_unary(
+            f"/{PEERS_SERVICE}/TransferSnapshots",
+            request_serializer=None,
+            response_deserializer=None,
         )

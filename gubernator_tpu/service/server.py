@@ -57,6 +57,11 @@ class V1Service:
         self.forwarder = None  # PeerForwarder for non-owner items
         self.global_mgr = None  # GlobalManager for GLOBAL behavior
         self.region_mgr = None  # RegionManager for MULTI_REGION behavior
+        # Graceful-drain state (docs/robustness.md): flipped by
+        # Daemon.close() before teardown starts. /readyz and HealthCheck
+        # report it so orchestrators stop routing without killing the
+        # pod early; the node keeps serving while it drains.
+        self.draining = False
         self._peers_lock = asyncio.Lock()
         # pre-resolved metric children (labels() lookups are hot-loop cost)
         m = self.metrics
@@ -266,6 +271,25 @@ class V1Service:
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, self.engine.inject_globals, globals_)
 
+    # ---- PeersV1.TransferSnapshots (ownership handover) --------------------
+
+    async def transfer_snapshots(self, snaps) -> tuple:
+        """Receiver half of ring-change/drain handover: merge incoming
+        counter state last-writer-wins on stamp (docs/robustness.md
+        "Rolling restarts & handover"). Returns (accepted, stale)."""
+        from gubernator_tpu.store.store import merge_snapshots_lww
+
+        loop = asyncio.get_running_loop()
+        accepted, stale = await loop.run_in_executor(
+            None, merge_snapshots_lww, self.engine, list(snaps)
+        )
+        m = self.metrics
+        if accepted:
+            m.handover_keys_received.inc(accepted)
+        if stale:
+            m.handover_keys_dropped.labels("stale").inc(stale)
+        return accepted, stale
+
     # ---- V1.HealthCheck (reference gubernator.go:542-586) ------------------
 
     async def health_check(self) -> HealthCheckResp:
@@ -284,6 +308,15 @@ class V1Service:
                         for a, s in self.forwarder.breaker_summary().items()
                         if s != "closed"
                     )
+        if self.draining:
+            # Drain state outranks the error log: the node is leaving on
+            # purpose; orchestrators should stop routing, not restart it
+            # (cmd/healthcheck.py exits 2 on this status).
+            return HealthCheckResp(
+                status="draining",
+                message="graceful drain in progress; stop routing",
+                peer_count=peer_count,
+            )
         if errors:
             msg = "; ".join(errors[:3])
             if open_circuits:
@@ -308,12 +341,17 @@ class V1Service:
                    still serve within SLO
         unready  — every remote peer's circuit is open (the node cannot
                    reach any fault domain but its own)
+        draining — graceful shutdown in progress: stop routing here, but
+                   do NOT kill the pod — queued work is finishing and
+                   owned keys are handing off to ring successors
         """
         summary = {}
         if self.forwarder is not None and hasattr(self.forwarder, "breaker_summary"):
             summary = self.forwarder.breaker_summary()
         open_circuits = sorted(a for a, s in summary.items() if s == "open")
-        if summary and len(open_circuits) == len(summary):
+        if self.draining:
+            status = "draining"
+        elif summary and len(open_circuits) == len(summary):
             status = "unready"
         elif open_circuits:
             status = "degraded"

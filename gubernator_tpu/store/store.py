@@ -141,6 +141,56 @@ def snapshots_from_engine(engine) -> List[ItemSnapshot]:
     return out
 
 
+def merge_snapshots_lww(engine, items: List[ItemSnapshot]) -> tuple:
+    """Last-writer-wins merge of incoming snapshots into an engine table
+    (the receiver half of ring-change handover, docs/robustness.md).
+
+    Unlike inject_snapshots' unconditional overwrite (correct for the
+    Loader restore into an empty table and for authoritative GLOBAL
+    broadcasts), a handover can race live traffic at the receiver: the
+    new owner may already have served hits for a moved key by the time
+    the old owner's snapshot arrives. Resolution, per key:
+
+    - strictly newer local `stamp` wins (the receiver re-created the
+      bucket after the sender snapshotted it — its writes are newer);
+    - equal stamps: the MORE-CONSUMED side wins (lower `remaining`).
+      Equal stamps mean both sides hold copies of the same bucket
+      (handover echo, or a drain re-ship racing post-transfer hits at
+      the successor); within a window hits only consume, so the lower
+      remaining carries strictly more of the true count.
+
+    Returns (accepted, stale) counts."""
+    import numpy as np
+
+    from gubernator_tpu.api.keys import key_hash128
+
+    if not items:
+        return 0, 0
+    snap = engine.snapshot()
+    used = np.asarray(snap["used"])
+    idx = np.nonzero(used)[0]
+    hi_col, lo_col = snap["key_hi"], snap["key_lo"]
+    stamp_col, rem_col = snap["stamp"], snap["remaining"]
+    existing: Dict[tuple, tuple] = {}
+    for i in idx:
+        existing[(int(hi_col[i]), int(lo_col[i]))] = (
+            int(stamp_col[i]),
+            int(rem_col[i]),
+        )
+    keep: List[ItemSnapshot] = []
+    stale = 0
+    for s in items:
+        have = existing.get(key_hash128(s.key))
+        if have is not None and (
+            have[0] > s.stamp or (have[0] == s.stamp and have[1] <= s.remaining)
+        ):
+            stale += 1
+            continue
+        keep.append(s)
+    engine.inject_snapshots(keep)
+    return len(keep), stale
+
+
 def save_engine(engine, loader: Loader) -> int:
     items = snapshots_from_engine(engine)
     loader.save(items)

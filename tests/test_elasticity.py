@@ -1,7 +1,13 @@
 """Membership churn and failure detection: SetPeers swaps rings and
 re-owns keys mid-flight; HealthCheck degrades on peer errors
 (reference gubernator.go:616-711, 542-586; SURVEY.md §5 failure
-detection)."""
+detection).
+
+Elasticity semantics (docs/robustness.md "Rolling restarts &
+handover"): unlike the reference — which accepts a fresh bucket at the
+new owner whenever ownership moves — GUBER_HANDOVER (default on) ships
+counter state to new owners on ring changes, so the pair of tests below
+pins BOTH behaviors: zero-loss by default, legacy lossy when off."""
 
 import time
 
@@ -58,24 +64,58 @@ def test_set_peers_reowns_keys(cluster, loop_thread):
     cluster.rewire()
 
 
-def test_removed_owner_state_is_lost_but_service_continues(cluster, loop_thread):
-    """If the owner leaves the ring, its keys get a new owner with fresh
-    state (the reference's accepted cache-loss semantics)."""
-    name, key = "elastic2", "account:lost"
-    call(loop_thread, cluster.peer_at(0), name, key, 30)
+def _decommission_owner(cluster, name, key):
+    """Remove the owner of (name, key) from EVERY daemon's view —
+    including the owner's own (the graceful-decommission signal that
+    triggers its ring-change handover). Returns (owner, survivors)."""
     owner = cluster.find_owning_daemon(name, key)
     survivors = [d for d in cluster.daemons if d is not owner]
     peers = [
         PeerInfo(grpc_address=d.grpc_address, http_address=d.http_address)
         for d in survivors
     ]
-    for d in survivors:
+    for d in cluster.daemons:
         d.set_peers(peers)
+    return owner, survivors
+
+
+def test_removed_owner_state_survives_with_handover(cluster, loop_thread):
+    """Zero-loss elasticity (default GUBER_HANDOVER=on): when the owner
+    leaves the ring, its counter state ships to the new owner over
+    TransferSnapshots — the count continues instead of resetting
+    (docs/robustness.md "Rolling restarts & handover")."""
+    name, key = "elastic2", "account:moved"
+    rl = call(loop_thread, cluster.peer_at(0), name, key, 30)
+    assert rl.error == "" and rl.remaining == 70
+
+    owner, survivors = _decommission_owner(cluster, name, key)
+    # The leaving owner diffs old-vs-new ownership and ships its keys;
+    # handover is async — wait for it before asserting.
+    owner.svc.picker.wait_handover(timeout=15)
 
     rl = call(loop_thread, survivors[0], name, key, 10)
     assert rl.error == ""
-    assert rl.remaining == 90  # fresh bucket at the new owner
+    assert rl.remaining == 60  # 100 - 30 (before the move) - 10
 
+    cluster.rewire()
+
+
+def test_removed_owner_state_is_lost_with_handover_off(cluster, loop_thread):
+    """GUBER_HANDOVER=off restores the reference's legacy lossy
+    semantics: the new owner starts a fresh bucket."""
+    name, key = "elastic2b", "account:lost"
+    call(loop_thread, cluster.peer_at(0), name, key, 30)
+    # Each daemon holds its own BehaviorConfig: toggle them all.
+    for d in cluster.daemons:
+        d.conf.behaviors.handover = False
+    try:
+        owner, survivors = _decommission_owner(cluster, name, key)
+        rl = call(loop_thread, survivors[0], name, key, 10)
+        assert rl.error == ""
+        assert rl.remaining == 90  # fresh bucket at the new owner
+    finally:
+        for d in cluster.daemons:
+            d.conf.behaviors.handover = True
     cluster.rewire()
 
 

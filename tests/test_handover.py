@@ -1,0 +1,123 @@
+"""Handover unit semantics: TransferSnapshots wire codec round-trip and
+the last-writer-wins merge rule (docs/robustness.md "Rolling restarts &
+handover"). Cluster-level behavior is pinned by tests/test_elasticity.py
+and tests/test_rolling_restart.py."""
+
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.service import pb
+from gubernator_tpu.store.store import (
+    ItemSnapshot,
+    merge_snapshots_lww,
+    snapshots_from_engine,
+)
+
+
+def snap(key, stamp=1000, remaining=50, **kw):
+    return ItemSnapshot(
+        key=key, algorithm=int(Algorithm.TOKEN_BUCKET), limit=100,
+        duration=600_000, remaining=remaining, stamp=stamp,
+        expire_at=stamp + 600_000, **kw,
+    )
+
+
+def test_snapshot_wire_roundtrip():
+    items = [
+        snap("a_k1", stamp=123, remaining=7, burst=3, invalid_at=9),
+        snap("b_k2", stamp=456, remaining=0, status=1),
+    ]
+    out = pb.snapshots_from_bytes(pb.snapshots_to_bytes(items))
+    assert out == items
+
+
+def test_snapshot_wire_rejects_malformed():
+    with pytest.raises(ValueError):
+        pb.snapshots_from_bytes(b"[1,2,3]")
+    with pytest.raises(ValueError):
+        pb.snapshots_from_bytes(b'{"v": 999, "items": []}')
+    with pytest.raises(ValueError):
+        pb.snapshots_from_bytes(b'{"v": 1, "items": [["k", 1]]}')
+    with pytest.raises(ValueError):
+        pb.snapshots_from_bytes(b"not json")
+
+
+def test_transfer_resp_roundtrip():
+    body = pb.transfer_resp_from_bytes(pb.transfer_resp_to_bytes(3, 2))
+    assert body == {"accepted": 3, "stale": 2}
+
+
+@pytest.fixture()
+def engine():
+    eng = DeviceEngine(EngineConfig(num_groups=256, batch_size=128))
+    yield eng
+    eng.close()
+
+
+def test_merge_lww_empty_table_accepts_all(engine):
+    accepted, stale = merge_snapshots_lww(
+        engine, [snap("m_k1"), snap("m_k2")]
+    )
+    assert (accepted, stale) == (2, 0)
+    keys = {s.key for s in snapshots_from_engine(engine)}
+    assert keys == {"m_k1", "m_k2"}
+
+
+def test_merge_lww_newer_local_stamp_wins(engine):
+    engine.inject_snapshots([snap("m_k1", stamp=2000, remaining=90)])
+    accepted, stale = merge_snapshots_lww(
+        engine, [snap("m_k1", stamp=1000, remaining=10)]
+    )
+    assert (accepted, stale) == (0, 1)
+    [s] = snapshots_from_engine(engine)
+    assert s.remaining == 90  # the receiver's newer bucket survived
+
+
+def test_merge_lww_tie_more_consumed_wins(engine):
+    # Equal stamps = copies of the same bucket; the lower-remaining side
+    # carries strictly more of the true count (drain re-ship racing
+    # post-transfer hits at the successor).
+    engine.inject_snapshots([snap("m_k1", stamp=1000, remaining=60)])
+    accepted, stale = merge_snapshots_lww(
+        engine, [snap("m_k1", stamp=1000, remaining=40)]
+    )
+    assert (accepted, stale) == (1, 0)
+    [s] = snapshots_from_engine(engine)
+    assert s.remaining == 40
+
+    # ...and the echo direction: an equal-stamp, LESS-consumed incoming
+    # copy must not roll the counter back.
+    accepted, stale = merge_snapshots_lww(
+        engine, [snap("m_k1", stamp=1000, remaining=90)]
+    )
+    assert (accepted, stale) == (0, 1)
+    [s] = snapshots_from_engine(engine)
+    assert s.remaining == 40
+
+
+def test_merge_lww_older_incoming_dropped_as_stale_counts_metric():
+    """V1Service.transfer_snapshots surfaces stale drops on the handover
+    dropped counter with reason=stale."""
+    import asyncio
+
+    from gubernator_tpu.metrics import Metrics
+    from gubernator_tpu.service.server import V1Service
+
+    eng = DeviceEngine(EngineConfig(num_groups=256, batch_size=128))
+    try:
+        svc = V1Service(eng, metrics=Metrics())
+        eng.inject_snapshots([snap("m_k1", stamp=2000, remaining=90)])
+
+        async def main():
+            return await svc.transfer_snapshots(
+                [snap("m_k1", stamp=1000), snap("m_k2", stamp=1000)]
+            )
+
+        accepted, stale = asyncio.run(main())
+        assert (accepted, stale) == (1, 1)
+        m = svc.metrics
+        assert m.handover_keys_received.labels().get() == 1
+        assert m.handover_keys_dropped.labels("stale").get() == 1
+    finally:
+        eng.close()
