@@ -285,6 +285,21 @@ def engine_histograms() -> dict:
             "Requests served per engine flush, by serving path.",
             scale=cnt, n_buckets=16, labelnames=("path",),
         ),
+        "pipeline_inflight": Log2Histogram(
+            "gubernator_engine_pipeline_inflight",
+            "In-flight flush tickets observed at each pump dispatch "
+            "(dispatched, not yet completed; bounded by "
+            "GUBER_PIPELINE_DEPTH — pinned at 1 in serial mode).",
+            scale=cnt, n_buckets=6,
+        ),
+        "pipeline_overlap": Log2Histogram(
+            "gubernator_engine_pipeline_overlap_ratio",
+            "Per-flush host/device overlap: host dispatch work done for "
+            "OTHER flushes while this one was in flight, as a fraction "
+            "of its in-flight window (0 = serial pump, ~1 = host encode "
+            "fully hidden behind device execution).",
+            scale=1 / 256, n_buckets=10,
+        ),
         "ici_tick_duration": Log2Histogram(
             "gubernator_ici_tick_duration",
             "ICI GLOBAL sync tick wall time in seconds (collective "

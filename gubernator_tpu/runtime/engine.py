@@ -84,6 +84,19 @@ class EngineConfig:
     # typed retryable status (api.types.ERR_ENGINE_DRAINING) so edges
     # and clients can re-dispatch instead of reporting a loss.
     drain_timeout_s: float = 5.0
+    # Continuous-batching pipeline depth (GUBER_PIPELINE_DEPTH): max
+    # flushes in flight at once — dispatched to the device (JAX async
+    # dispatch; the table threads flush-to-flush as a device-side
+    # dependency through the donated buffers) but not yet synced. Depth
+    # 1 = the classic serial pump (dispatch, sync, resolve, repeat);
+    # depth >= 2 adds a completion thread that syncs tickets in FIFO
+    # order while the pump encodes the NEXT flush, so the device never
+    # waits on host encode and p99 tracks device time, not dispatch
+    # RTT. Decisions are bit-exact across depths (device execution
+    # order == dispatch order). A Store pins the effective depth at 1:
+    # its read-through probes sync inside the dispatch stage and
+    # write-behind must not race the next flush's prefetch.
+    pipeline_depth: int = 2
     # Background-compile power-of-two batch widths (128..batch_size) so
     # the columnar edge can size the kernel to each call's occupancy.
     fast_buckets: bool = False
@@ -179,6 +192,51 @@ class _Slot:
         return self._done
 
 
+class _FlushTicket:
+    """One dispatched-but-unsynced flush traveling the dispatch ->
+    completion pipeline: the device outputs (un-materialized JAX arrays),
+    the host bookkeeping needed to demux them, and the timing marks the
+    completion stage turns into histogram samples. Built by an engine's
+    _dispatch, consumed exactly once by its _complete (FIFO)."""
+
+    __slots__ = (
+        "items",        # [(req, future-like)] — the flush's intake
+        "placements",   # per-item routing (engine-specific)
+        "outs",         # per-wave DecideOutputs (device arrays)
+        "r_outs",       # ici replica-tier outputs (device arrays)
+        "rows",         # store path: materialized per-wave gathered rows
+        "events",       # store path: ('d'|'i', key) displacement events
+        "served",       # items answered by this flush (excludes carry)
+        "carry_n",      # items deferred to the next flush (wave cap)
+        "waves",        # wave count
+        "widths",       # per-wave device batch widths
+        "t0",           # flush assembly start (perf_counter)
+        "t_dev",        # device dispatch start
+        "t_disp_end",   # dispatch stage end (set by EngineBase._process)
+        "host_mark",    # cumulative pump host-busy time at dispatch end
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+def _materialize_out(o) -> tuple:
+    """One wave's DecideOutputs pulled to host — THE completion-stage
+    flush-boundary readback (pipelined engines run it off the pump
+    thread, so the device never waits on host encode)."""
+    return (
+        np.asarray(o.status),  # guberlint: allow-host-sync -- completion-stage flush-boundary readback
+        np.asarray(o.remaining),  # guberlint: allow-host-sync -- completion-stage flush-boundary readback
+        np.asarray(o.reset_time),  # guberlint: allow-host-sync -- completion-stage flush-boundary readback
+        np.asarray(o.limit),  # guberlint: allow-host-sync -- completion-stage flush-boundary readback
+        int(o.hits),
+        int(o.misses),
+        int(o.unexpired_evictions),
+        int(o.over_limit),
+    )
+
+
 class _WaveAssembler:
     """First-fit placement of requests into scatter-disjoint waves: a
     request goes to the first wave where its slot-group is unused and a
@@ -223,17 +281,190 @@ class EngineBase:
     (the reference's micro-batch policy, peer_client.go:284-337).
 
     Subclasses provide cfg (batch_wait_s/batch_limit/max_flush_items/
-    max_waves), now_fn, metrics, and _process(items) -> carry, where
-    carry is the list of (req, future) pairs the flush could not place
-    (wave cap); the pump re-presents them first on the next flush."""
+    max_waves/pipeline_depth), now_fn, metrics, and the two pipeline
+    stages: _dispatch(items) -> (carry, ticket) — assemble + encode on
+    host and launch the kernels WITHOUT a host sync — and
+    _complete(ticket) — materialize device results, feed telemetry, and
+    resolve futures. carry is the list of (req, future) pairs the flush
+    could not place (wave cap); the pump re-presents them first on the
+    next flush. _process glues the stages: serially at depth 1 (today's
+    pump, bit-exact), through the bounded in-flight ring + completion
+    thread at depth >= 2 (continuous batching: host encode of flush N+1
+    overlaps device execution of flush N)."""
 
     def _init_base(self, thread_name: str) -> None:
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
+        self._draining = False
+        # Bulk entries whose members may span flushes (wave-cap carry);
+        # resolved by whichever thread completes their last member.
+        self._bulks: List[_Bulk] = []
+        self._bulks_lock = lockorder.make_lock("engine.bulks")
+        # Cumulative pump time spent in _dispatch (host encode + launch);
+        # pump-thread-only writer, read by the completion stage for the
+        # host/device overlap ratio.
+        self._host_busy = 0.0
+        depth = max(int(getattr(self.cfg, "pipeline_depth", 1) or 1), 1)
+        self._pipe_depth = depth
+        self._pipe_q: Optional["queue.SimpleQueue"] = None
+        self._pipe_thread: Optional[threading.Thread] = None
+        if depth > 1:
+            # In-flight ring: the semaphore's permits ARE the ring slots
+            # (backpressure: the pump blocks acquiring a slot before it
+            # launches more device work); the SimpleQueue carries tickets
+            # to the completion thread in FIFO dispatch order.
+            self._pipe_sem = threading.Semaphore(depth)
+            self._pipe_q = queue.SimpleQueue()
+            self._pipe_lock = lockorder.make_lock("engine.pipeline")
+            self._inflight = 0
+            self._pipe_thread = threading.Thread(
+                target=self._completion_loop,
+                name=thread_name + "-complete", daemon=True,
+            )
+            self._pipe_thread.start()
         self._thread = threading.Thread(
             target=self._pump, name=thread_name, daemon=True
         )
         self._thread.start()
+
+    # -- two-stage pipeline --------------------------------------------------
+
+    def _pipeline_active(self) -> bool:
+        """Pipelined completion applies only while serving (the drain
+        pass completes inline for deterministic straggler accounting)
+        and only store-less: the Store path's read-through probes sync
+        inside the dispatch stage anyway, and its write-behind must not
+        race the NEXT flush's prefetch."""
+        return (
+            self._pipe_q is not None
+            and not self._draining
+            and getattr(self, "store", None) is None
+        )
+
+    def _process(self, items: List[Tuple[RateLimitReq, object]]) -> list:
+        """One flush through both stages. Serial mode (depth 1, store
+        attached, or draining): dispatch then complete inline — exactly
+        the classic pump. Pipelined mode: dispatch, then hand the ticket
+        to the completion thread and return immediately so the pump can
+        assemble the next flush while the device executes this one."""
+        pipelined = self._pipeline_active()
+        if pipelined:
+            # Backpressure BEFORE launching more device work: a full
+            # ring means the device is the bottleneck — adding waves
+            # would only grow the unsynced frontier.
+            self._pipe_sem.acquire()
+        t_host0 = time.perf_counter()
+        try:
+            carry, ticket = self._dispatch(items)
+        except Exception:
+            if pipelined:
+                self._pipe_sem.release()
+            raise
+        end = time.perf_counter()
+        self._host_busy += end - t_host0
+        if ticket is None:
+            if pipelined:
+                self._pipe_sem.release()
+            return carry
+        ticket.t_disp_end = end
+        ticket.host_mark = self._host_busy
+        if pipelined:
+            with self._pipe_lock:
+                self._inflight += 1
+                depth = self._inflight
+            self.metrics.pipeline_inflight.observe(depth)
+            self._pipe_q.put(ticket)
+        else:
+            self.metrics.pipeline_inflight.observe(1)
+            self._complete(ticket)
+        return carry
+
+    def _completion_loop(self) -> None:
+        """Completion stage: sync each in-flight ticket in FIFO dispatch
+        order, resolve its futures, feed the histograms. A failed ticket
+        fails ONLY its own futures (earlier tickets already completed;
+        later ones dispatched against the recovered table) — the loop
+        itself never dies while the engine runs."""
+        while True:
+            t = self._pipe_q.get()
+            if t is _STOP:
+                return
+            try:
+                self._complete(t)
+            except Exception as e:
+                self._ticket_failed(t, e)
+            finally:
+                with self._pipe_lock:
+                    self._inflight -= 1
+                self._pipe_sem.release()
+                self._sweep_bulks()
+
+    def _ticket_failed(self, ticket, exc) -> None:
+        """An in-flight ticket's results could not be materialized: fail
+        that ticket's unresolved futures, then rebuild the table if the
+        failed device call consumed (or poisoned) its donated buffers.
+        Recovery is idempotent — a healthy table is left alone — so a
+        burst of failing tickets rebuilds exactly once."""
+        import logging
+
+        err = str(exc)
+        for _req, fut in ticket.items:
+            if not fut.done():
+                fut.set_result(RateLimitResp(error=err))
+        try:
+            self._recover_after_failure()
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "table recovery after failed in-flight flush failed"
+            )
+
+    def _observe_overlap(self, ticket) -> None:
+        """Host/device overlap sample for one completed flush: host
+        dispatch work done for OTHER flushes while this one was in
+        flight, as a fraction of its in-flight window. Serial mode pins
+        this at 0 — the pump idles while the device runs."""
+        window = time.perf_counter() - ticket.t_disp_end
+        overlap = self._host_busy - ticket.host_mark
+        ratio = min(overlap / window, 1.0) if window > 0 else 0.0
+        self.metrics.pipeline_overlap.observe(ratio)
+
+    def _pipeline_quiesce(self) -> None:
+        """Wait until every in-flight ticket has completed, and switch
+        _process to inline completion (drain mode). Pump-thread only —
+        acquiring every ring slot is only ticket-free when no other
+        producer can interleave."""
+        self._draining = True
+        if self._pipe_q is None:
+            return
+        for _ in range(self._pipe_depth):
+            self._pipe_sem.acquire()
+        for _ in range(self._pipe_depth):
+            self._pipe_sem.release()
+
+    def _sweep_bulks(self) -> None:
+        """Resolve bulk futures whose members have all been answered.
+        Serial mode sweeps from the pump after each flush; pipelined
+        mode sweeps from the completion thread after each ticket."""
+        done: List[_Bulk] = []
+        with self._bulks_lock:
+            still = []
+            for b in self._bulks:
+                if all(s.done() for s in b.slots):
+                    done.append(b)
+                else:
+                    still.append(b)
+            self._bulks[:] = still
+        for b in done:
+            b.resolve()
+
+    def _resolve_all_bulks(self) -> None:
+        """Shutdown tail: resolve every remaining bulk — members never
+        served fill in as typed-retryable (see _Bulk.resolve)."""
+        with self._bulks_lock:
+            rest = list(self._bulks)
+            self._bulks[:] = []
+        for b in rest:
+            b.resolve()
 
     # -- public intake -------------------------------------------------------
 
@@ -314,6 +545,13 @@ class EngineBase:
         warm = getattr(self, "_warm_thread", None)
         if warm is not None and warm.is_alive():
             warm.join(timeout=60)
+        comp = self._pipe_thread
+        if comp is not None and comp.is_alive():
+            # The pump sends _STOP at the end of its drain; this second
+            # sentinel is a backstop for a wedged pump (extra sentinels
+            # are harmless — the loop exits on the first one it sees).
+            self._pipe_q.put(_STOP)
+            comp.join(timeout=5 + drain_s)
 
     # -- introspection (shared) ----------------------------------------------
 
@@ -339,6 +577,8 @@ class EngineBase:
             "layout": getattr(cfg, "layout", ""),
             "batch_size": cfg.batch_size,
             "max_waves": cfg.max_waves,
+            "pipeline_depth": self._pipe_depth,
+            "inflight": getattr(self, "_inflight", 0),
             "queue_depth": self.queue_depth(),
             "counters": counters,
             "histograms": {h.name: h.summary() for h in em.histograms()},
@@ -353,7 +593,6 @@ class EngineBase:
     def _pump(self) -> None:
         NB = int(Behavior.NO_BATCHING)
         carry: List[Tuple[RateLimitReq, object]] = []
-        pending_bulks: List[_Bulk] = []
         while self._running:
             if not carry:
                 try:
@@ -383,7 +622,8 @@ class EngineBase:
                 if type(entry) is _Bulk:
                     qw.observe(time.perf_counter() - entry.t_enq)
                     batch.extend(entry.work)
-                    pending_bulks.append(entry)
+                    with self._bulks_lock:
+                        self._bulks.append(entry)
                     return any(r.behavior & NB for r, _ in entry.work)
                 req, fut, t_enq = entry
                 qw.observe(time.perf_counter() - t_enq)
@@ -412,49 +652,54 @@ class EngineBase:
             if batch:
                 try:
                     carry = self._process(batch) or []
+                    # Resolve bulks whose members have all been answered.
+                    # Pipelined mode leaves this to the completion
+                    # thread's per-ticket sweep — slots are not set yet
+                    # here, and a redundant pump-side scan of every
+                    # pending bulk's slots is pure overhead; wave-capped
+                    # bulks wait for their carried items either way.
+                    if not self._pipeline_active():
+                        self._sweep_bulks()
                 except Exception as e:  # never kill the pump
                     for _, fut in batch:
                         if not fut.done():
                             fut.set_result(RateLimitResp(error=str(e)))
                     carry = []
-                # Resolve bulks whose members have all been answered;
-                # wave-capped bulks wait for their carried items.
-                still = []
-                for b in pending_bulks:
-                    if all(s.done() for s in b.slots):
-                        b.resolve()
-                    else:
-                        still.append(b)
-                pending_bulks = still
-        # Shutdown: drain whatever is still queued within the drain
-        # budget (zero-loss elasticity, docs/robustness.md), then fail
-        # stragglers with the typed retryable status.
-        carry, pending_bulks = self._drain_tail(carry, pending_bulks)
+                    self._sweep_bulks()
+        # Shutdown: sync every in-flight ticket FIRST (FIFO future
+        # order; zero-loss elasticity must cover dispatched-but-unsynced
+        # flushes too), then drain whatever is still queued within the
+        # drain budget (docs/robustness.md), then fail stragglers with
+        # the typed retryable status.
+        self._pipeline_quiesce()
+        carry = self._drain_tail(carry)
         for _, fut in carry:
             if not fut.done():
                 fut.set_result(RateLimitResp(error=ERR_ENGINE_DRAINING))
-        for b in pending_bulks:
-            b.resolve()
+        self._resolve_all_bulks()
+        if self._pipe_q is not None:
+            self._pipe_q.put(_STOP)
 
-    def _drain_tail(self, carry, pending_bulks):
+    def _drain_tail(self, carry):
         """Serve queue entries that raced the shutdown signal. Entries
         enqueued before close() are already handled by the main loop
         (FIFO order puts them ahead of _STOP); this pass covers carried
         wave overflow and producers that slipped in between the _STOP
-        being seen and _running going False. Returns the (pairs, bulks)
+        being seen and _running going False. Flushes complete INLINE
+        here (_pipeline_quiesce flipped drain mode). Returns the pairs
         the drain budget could not serve."""
         deadline = time.monotonic() + max(
             float(getattr(self.cfg, "drain_timeout_s", 5.0)), 0.0
         )
         pending = list(carry)
-        bulks = list(pending_bulks)
 
         def pull(entry) -> None:
             if entry is _STOP or entry is _FLUSH:
                 return
             if type(entry) is _Bulk:
                 pending.extend(entry.work)
-                bulks.append(entry)
+                with self._bulks_lock:
+                    self._bulks.append(entry)
             else:
                 req, fut, _t = entry
                 pending.append((req, fut))
@@ -486,13 +731,7 @@ class EngineBase:
                 extra = []
             # Wave-capped leftovers retry first (per-key arrival order).
             pending = list(extra) + pending
-            still = []
-            for b in bulks:
-                if all(s.done() for s in b.slots):
-                    b.resolve()
-                else:
-                    still.append(b)
-            bulks = still
+            self._sweep_bulks()
         # Past the budget (or idle): hand back the stragglers — including
         # anything still sitting in the queue — so the caller fails them
         # with the typed retryable status instead of leaving futures
@@ -502,7 +741,7 @@ class EngineBase:
                 pull(self._queue.get_nowait())
             except queue.Empty:
                 break
-        return pending, bulks
+        return pending
 
 
 class DeviceEngine(EngineBase):
@@ -706,18 +945,26 @@ class DeviceEngine(EngineBase):
 
     # ---- wave assembly + kernel dispatch -----------------------------------
 
-    def _process(
+    def _dispatch(
         self, items: List[Tuple[RateLimitReq, object]]
-    ) -> List[Tuple[RateLimitReq, object]]:
+    ) -> Tuple[List[Tuple[RateLimitReq, object]], Optional[_FlushTicket]]:
+        """Pipeline stage 1: assemble + encode the flush on host and
+        launch its waves (no host sync — JAX async dispatch; the table
+        threads flush-to-flush through the donated buffers). Returns
+        (carry, ticket); _complete materializes the ticket."""
         t0 = time.perf_counter()
         now = self.now_fn()
         cfg = self.cfg
         B = cfg.batch_size
 
         # One native batch-hash call for the whole flush (assembler hot
-        # loop; gubernator_tpu.native).
+        # loop; gubernator_tpu.native), then one-shot tolist conversions
+        # — per-item numpy scalar boxing dominated the assembler loop.
         hashes = key_hash128_batch(
             [req.hash_key() for req, _ in items], cfg.num_groups
+        )
+        hi_l, lo_l, grp_l = (
+            hashes[0].tolist(), hashes[1].tolist(), hashes[2].tolist()
         )
 
         # Store read-through happens per WAVE inside the execution loop
@@ -734,7 +981,7 @@ class DeviceEngine(EngineBase):
                 need = []
                 seen = set()
                 for i, (req, _) in enumerate(items):
-                    k = (int(hashes[0][i]), int(hashes[1][i]))
+                    k = (hi_l[i], lo_l[i])
                     if k not in self._key_strings and k not in seen:
                         seen.add(k)
                         need.append((req, k))
@@ -759,10 +1006,10 @@ class DeviceEngine(EngineBase):
         carry: List[Tuple[RateLimitReq, object]] = []
         new_strings: Dict[Tuple[int, int], str] = {}
         for i, (req, fut) in enumerate(items):
-            hi, lo = int(hashes[0][i]), int(hashes[1][i])
+            hi, lo = hi_l[i], lo_l[i]
             if keep:
                 new_strings[(hi, lo)] = req.hash_key()
-            grp = int(hashes[2][i])
+            grp = grp_l[i]
             placed = asm.place(grp, cfg.max_waves)
             if placed is None:
                 # Wave cap reached for this group: defer to the next flush
@@ -838,35 +1085,35 @@ class DeviceEngine(EngineBase):
             outs, wave_rows_host, events = self._execute_waves(
                 waves, wave_lane_req, now, prefetched
             )
+        return carry, _FlushTicket(
+            items=items, placements=placements, outs=outs,
+            rows=wave_rows_host, events=events,
+            served=len(items) - len(carry), carry_n=len(carry),
+            waves=len(waves),
+            widths=[int(w.active.shape[0]) for w in waves],  # guberlint: allow-host-sync -- static shape metadata, no device readback
+            t0=t0, t_dev=t_dev,
+        )
 
-            # Materialize results (one host sync per wave) and demux.
-            host = [
-                (
-                    np.asarray(o.status),
-                    np.asarray(o.remaining),
-                    np.asarray(o.reset_time),
-                    np.asarray(o.limit),
-                    int(o.hits),
-                    int(o.misses),
-                    int(o.unexpired_evictions),
-                    int(o.over_limit),
-                )
-                for o in outs
-            ]
-        dev_s = time.perf_counter() - t_dev
+    def _complete(self, t: _FlushTicket) -> None:
+        """Pipeline stage 2: materialize the ticket's device results
+        (one host sync per wave), feed telemetry, run write-behind, and
+        resolve the futures — in FIFO dispatch order when pipelined."""
+        cfg = self.cfg
+        # The np.asarray syncs live in _materialize_out (the sanctioned
+        # completion-stage readback).
+        host = [_materialize_out(o) for o in t.outs]
+        dev_s = time.perf_counter() - t.t_dev
 
-        if keep:
-            self._drop_displaced_strings(events)
+        if cfg.keep_key_strings:
+            self._drop_displaced_strings(t.events)
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
-        served = len(items) - len(carry)  # carried items count when served
-        dur = time.perf_counter() - t0
+        dur = time.perf_counter() - t.t0
         em = self.metrics
-        em.observe(tot[0], tot[1], tot[2], tot[3], len(waves), served, dur)
-        em.observe_flush("object", served, len(waves), dur, dev_s)
+        em.observe(tot[0], tot[1], tot[2], tot[3], t.waves, t.served, dur)
+        em.observe_flush("object", t.served, t.waves, dur, dev_s)
         em.recorder.record(
-            path="object", layout=cfg.layout, n=served, waves=len(waves),
-            carry=len(carry),
-            widths=[int(w.active.shape[0]) for w in waves],
+            path="object", layout=cfg.layout, n=t.served, waves=t.waves,
+            carry=t.carry_n, widths=t.widths,
             dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
         )
 
@@ -874,22 +1121,22 @@ class DeviceEngine(EngineBase):
         # its response can rely on the store reflecting it (the reference's
         # OnChange runs within the request, algorithms.go:149-153).
         if self.store is not None:
-            self._store_write_behind(items, placements, outs, wave_rows_host)
+            self._store_write_behind(t.items, t.placements, t.outs, t.rows)
 
-        for (req, fut), place in zip(items, placements):
+        for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
                 continue  # resolved (encode error) or deferred
             w, lane = place[0], place[1]
             st, rem, rst, lim = host[w][0], host[w][1], host[w][2], host[w][3]
             fut.set_result(
                 RateLimitResp(
-                    status=int(st[lane]),
-                    limit=int(lim[lane]),
-                    remaining=int(rem[lane]),
-                    reset_time=int(rst[lane]),
+                    status=int(st[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    limit=int(lim[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    remaining=int(rem[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    reset_time=int(rst[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
                 )
             )
-        return carry
+        self._observe_overlap(t)
 
     @staticmethod
     def _snapshot_from_row(r, lane: int, key: str):
@@ -1386,12 +1633,20 @@ class DeviceEngine(EngineBase):
 
     def _recover_table_locked(self) -> bool:
         """Called with the lock held after a failed device call: if the
-        donated table buffers were consumed, rebuild an empty table so
+        donated table buffers were consumed — or the table points at an
+        array poisoned by a failed ASYNC dispatch (pipelined mode: the
+        error only surfaces at the completion stage's sync, after the
+        table reference already advanced) — rebuild an empty table so
         subsequent requests serve instead of failing forever. Returns
         True when the table was rebuilt (all counters lost — a fallback
         replay is then safe, not a double-apply)."""
         try:
             deleted = getattr(self.table.key_hi, "is_deleted", lambda: False)()
+            if not deleted:
+                # Error-path-only health probe, never on the serving path:
+                # a poisoned dependency chain raises its deferred error
+                # here instead of on every future flush.
+                jax.block_until_ready(self.table.key_hi)  # guberlint: allow-host-sync -- error-path table health probe
         except Exception:
             deleted = True
         if deleted:
@@ -1399,6 +1654,13 @@ class DeviceEngine(EngineBase):
             with self._keys_lock:
                 self._key_strings.clear()
         return deleted
+
+    def _recover_after_failure(self) -> bool:
+        """Completion-stage recovery entry (EngineBase._ticket_failed):
+        same rebuild-once semantics as the dispatch path, taken under
+        the device lock."""
+        with self._lock:
+            return self._recover_table_locked()
 
     # ---- direct state injection (AddCacheItem analog) ----------------------
 

@@ -49,8 +49,10 @@ from gubernator_tpu.runtime.engine import (
     EngineBase,
     EngineMetrics,
     TableCommittedError,
+    _FlushTicket,
     _WaveAssembler,
     _assemble_column_waves,
+    _materialize_out,
     _select_columns,
     _stack_wave_outputs,
     _wave_totals,
@@ -87,6 +89,12 @@ class IciEngineConfig:
     # keeping the 100ms cadence at 10M+ key geometries. None = merge
     # the full table every tick.
     max_sync_groups: "int | None" = 65536
+    # Continuous-batching pipeline depth (GUBER_PIPELINE_DEPTH): max
+    # flushes dispatched-but-unsynced at once; 1 = serial pump. Same
+    # semantics as EngineConfig.pipeline_depth — both ici tiers'
+    # (sharded + replica) waves launch in the dispatch stage and sync
+    # in the completion stage.
+    pipeline_depth: int = 2
 
 
 class IciEngine(EngineBase):
@@ -389,7 +397,15 @@ class IciEngine(EngineBase):
         def consumed(tree) -> bool:
             try:
                 leaf = jax.tree_util.tree_leaves(tree)[0]
-                return getattr(leaf, "is_deleted", lambda: False)()
+                if getattr(leaf, "is_deleted", lambda: False)():
+                    return True
+                # Error-path-only health probe: a failed ASYNC dispatch
+                # (pipelined completion) leaves the state reference
+                # pointing at poisoned arrays whose deferred error only
+                # surfaces on sync — catch it here, once, instead of on
+                # every future flush.
+                jax.block_until_ready(leaf)  # guberlint: allow-host-sync -- error-path state health probe
+                return False
             except Exception:
                 return True
 
@@ -477,7 +493,10 @@ class IciEngine(EngineBase):
 
     # -- flush processing ----------------------------------------------------
 
-    def _process(self, items) -> list:
+    def _dispatch(self, items):
+        """Pipeline stage 1 (both ici tiers): assemble + encode on host,
+        launch the sharded SPMD waves then the replica waves without a
+        host sync. Returns (carry, ticket) for _complete."""
         t0 = time.perf_counter()
         now = self.now_fn()
         cfg = self.cfg
@@ -485,9 +504,11 @@ class IciEngine(EngineBase):
         GLOBAL = int(Behavior.GLOBAL)
 
         # Hash once; derive each path's index from lo (group/slot are just
-        # lo mod geometry).
+        # lo mod geometry). One-shot tolist: per-item numpy scalar boxing
+        # dominated this loop.
         keys = [req.hash_key() for req, _ in items]
         hi_a, lo_a, grp_a = key_hash128_batch(keys, cfg.num_groups)
+        hi_l, lo_l, grp_l = hi_a.tolist(), lo_a.tolist(), grp_a.tolist()
 
         sharded_asm = _WaveAssembler(RequestBatch.zeros, B)
         replica_asm = _WaveAssembler(RequestBatch.zeros, B)
@@ -496,10 +517,10 @@ class IciEngine(EngineBase):
 
         carry = []
         for i, (req, fut) in enumerate(items):
-            hi, lo = int(hi_a[i]), int(lo_a[i])
+            hi, lo = hi_l[i], lo_l[i]
             try:
                 if not (req.behavior & GLOBAL):
-                    grp = int(grp_a[i])
+                    grp = grp_l[i]
                     placed = sharded_asm.place(grp, cfg.max_waves)
                     if placed is None:
                         carry.append((req, fut))
@@ -557,44 +578,56 @@ class IciEngine(EngineBase):
             self.table = table
             self.ici_state = state
 
-        def host_rows(outs):
-            return [
-                (np.asarray(o.status), np.asarray(o.remaining),
-                 np.asarray(o.reset_time), np.asarray(o.limit),
-                 int(o.hits), int(o.misses), int(o.unexpired_evictions),
-                 int(o.over_limit))
-                for o in outs
-            ]
+        return carry, _FlushTicket(
+            items=items, placements=placements, outs=s_out, r_outs=r_out,
+            served=len(items) - len(carry), carry_n=len(carry),
+            waves=waves_total, widths=[B] * waves_total,
+            t0=t0, t_dev=t_dev,
+        )
 
-        host = {"s": host_rows(s_out), "r": host_rows(r_out)}
-        dev_s = time.perf_counter() - t_dev
+    def _complete(self, t) -> None:
+        """Pipeline stage 2: materialize both tiers' wave outputs, feed
+        telemetry, resolve futures (FIFO dispatch order when
+        pipelined)."""
+        cfg = self.cfg
+        host = {
+            "s": [_materialize_out(o) for o in t.outs],
+            "r": [_materialize_out(o) for o in t.r_outs],
+        }
+        dev_s = time.perf_counter() - t.t_dev
         tots = [0, 0, 0, 0]
         for path in host.values():
             for h in path:
                 for j in range(4):
                     tots[j] += h[4 + j]
-        served = len(items) - len(carry)  # carried items count when served
-        dur = time.perf_counter() - t0
+        dur = time.perf_counter() - t.t0
         em = self.metrics
-        em.observe(tots[0], tots[1], tots[2], tots[3], waves_total, served, dur)
-        em.observe_flush("object", served, waves_total, dur, dev_s)
+        em.observe(tots[0], tots[1], tots[2], tots[3], t.waves, t.served, dur)
+        em.observe_flush("object", t.served, t.waves, dur, dev_s)
         em.recorder.record(
-            path="object", layout=cfg.layout, n=served, waves=waves_total,
-            carry=len(carry), widths=[B] * waves_total,
+            path="object", layout=cfg.layout, n=t.served, waves=t.waves,
+            carry=t.carry_n, widths=t.widths,
             dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
         )
 
-        for (req, fut), place in zip(items, placements):
+        for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
                 continue
             path, w, lane = place
             st, rem, rst, lim = host[path][w][:4]
             fut.set_result(
                 RateLimitResp(
-                    status=int(st[lane]),
-                    limit=int(lim[lane]),
-                    remaining=int(rem[lane]),
-                    reset_time=int(rst[lane]),
+                    status=int(st[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    limit=int(lim[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    remaining=int(rem[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    reset_time=int(rst[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
                 )
             )
-        return carry
+        self._observe_overlap(t)
+
+    def _recover_after_failure(self) -> bool:
+        """Completion-stage recovery entry (EngineBase._ticket_failed):
+        rebuild whichever tier's donated state the failed flush consumed
+        or poisoned, at most once."""
+        with self._lock:
+            return self._recover_tables_locked()

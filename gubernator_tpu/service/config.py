@@ -250,6 +250,13 @@ class DaemonConfig:
     # pass (docs/robustness.md "Rolling restarts & handover").
     drain_timeout_s: float = 5.0
 
+    # Continuous-batching pipeline depth (GUBER_PIPELINE_DEPTH): max
+    # engine flushes in flight at once — host encode of the next flush
+    # overlaps device execution of the previous (docs/architecture.md
+    # "Pipelined dispatch"). 1 = the serial pump (bit-exact decisions
+    # either way); feeds EngineConfig/IciEngineConfig.pipeline_depth.
+    pipeline_depth: int = 2
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine
@@ -274,6 +281,7 @@ class DaemonConfig:
             fast_buckets=True,
             layout=self.table_layout,
             drain_timeout_s=self.drain_timeout_s,
+            pipeline_depth=self.pipeline_depth,
             # Handover needs routable (string-keyed) snapshots even on
             # the store-less columnar edge; with it off, skip the decode.
             record_columnar_keys=self.behaviors.handover,

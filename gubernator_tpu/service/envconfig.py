@@ -151,7 +151,17 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         # engine queue, replication flushes, and the ownership handover
         # all finish inside this window before teardown.
         drain_timeout_s=parse_duration_s(_env("GUBER_DRAIN_TIMEOUT"), 5.0),
+        # Continuous-batching pipeline depth (docs/architecture.md
+        # "Pipelined dispatch"): 1 = serial pump, >=2 overlaps host
+        # encode with device execution. Decisions are bit-exact across
+        # depths.
+        pipeline_depth=_env_int("GUBER_PIPELINE_DEPTH", 2),
     )
+    if conf.pipeline_depth < 1:
+        raise ValueError(
+            f"'GUBER_PIPELINE_DEPTH={conf.pipeline_depth}' is invalid; "
+            "must be >= 1 (1 = serial dispatch)"
+        )
 
     # Table layouts validate EARLY against the one registry
     # (ops/kernels.py) so a typo'd GUBER_TABLE_LAYOUT / GUBER_ICI_LAYOUT
@@ -196,6 +206,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             batch_wait_s=behaviors.batch_wait_s,
             batch_limit=behaviors.batch_limit,
             layout=_env("GUBER_ICI_LAYOUT", base.layout),  # LAYOUTS-validated below
+            pipeline_depth=conf.pipeline_depth,
             # 0 = unbounded (merge the full table every tick)
             max_sync_groups=(
                 _env_int("GUBER_ICI_SYNC_GROUPS", base.max_sync_groups or 0)

@@ -128,9 +128,9 @@ def latest(
 
 
 _MODE_FROM_JOB = re.compile(
-    r"(kernel10m|kernel|engine|server|global|latency|edge|ici)"
+    r"(kernel10m|kernel|engine_ab|engine|server|global|latency|edge|ici)"
 )
-_LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide)")
+_LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide|narrow)")
 
 
 def infer_mode_layout(job: str, metric: str = "") -> tuple[str, str]:

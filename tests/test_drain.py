@@ -39,6 +39,41 @@ def test_engine_close_drains_queue():
         assert resp.remaining == 9_999
 
 
+def test_engine_close_syncs_inflight_tickets_zero_loss():
+    """Dispatched-but-unsynced pipeline tickets are completed — not
+    failed — on close(): the drain covers the in-flight ring, not just
+    the intake queue (ISSUE 6: zero-loss elasticity must survive
+    pipelining)."""
+    import threading
+
+    eng = DeviceEngine(
+        EngineConfig(
+            num_groups=256, batch_size=128, batch_wait_s=0.0005,
+            pipeline_depth=4,
+        )
+    )
+    gate = threading.Event()
+    orig = eng._complete
+
+    def gated(t):
+        gate.wait(10)
+        orig(t)
+
+    eng._complete = gated
+    try:
+        futs = [eng.check_async(_req(i)) for i in range(200)]
+        # Let the pump fill the in-flight ring, then release completion
+        # shortly AFTER close() starts so the quiesce genuinely waits on
+        # in-flight tickets.
+        threading.Timer(0.3, gate.set).start()
+    finally:
+        eng.close()
+    for f in futs:
+        resp = f.result(timeout=1)
+        assert resp.error == "", resp
+        assert resp.remaining == 9_999
+
+
 def test_engine_close_stragglers_get_typed_retryable_error():
     """Past the drain budget, stragglers fail with the typed retryable
     status (not the old bare \"engine shutdown\" string) so edges and

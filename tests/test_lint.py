@@ -52,9 +52,12 @@ def test_repo_clean_with_committed_baseline():
 
 def test_baseline_is_not_vacuous():
     # the grandfathered host-sync set must actually be observed — an
-    # empty scan (wrong roots, broken walker) must not pass silently
+    # empty scan (wrong roots, broken walker) must not pass silently.
+    # (Floor lowered as the ratchet tightens: the pipelined-dispatch
+    # refactor moved the pump's flush-boundary readbacks into the
+    # explicitly-pragma'd completion stage, 72 -> 47 GL001 entries.)
     res = run_lint()
-    assert len(res.findings) >= 50
+    assert len(res.findings) >= 30
     assert {f.rule for f in res.findings} >= {"GL001", "GL003"}
 
 

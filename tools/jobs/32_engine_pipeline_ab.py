@@ -1,0 +1,14 @@
+# TIMEOUT: 1800
+# Serial-vs-pipelined engine A/B on the real device (ISSUE 6): the same
+# request trace through pipeline depth 1 (serial pump) and depth 2
+# (continuous batching — host encode overlaps device decide). On TPU the
+# device claim is held by THIS process, so both cells run in-process
+# (bench_engine_ab falls through from the fresh-process CPU path). Raw
+# rows and the pipelined/serial ratio row are ledgered as they land.
+import sys, json
+sys.path.insert(0, "/root/repo")
+for _m in [k for k in list(sys.modules) if k == "bench" or k.startswith("gubernator_tpu")]:
+    del sys.modules[_m]
+import bench
+r = bench.bench_engine_ab()
+print("RESULT " + json.dumps(r))
