@@ -57,6 +57,18 @@ def _engine_telemetry(eng) -> dict:
             "overlap_p50": round(ov["p50"], 3),
             "inflight_p99": round(fl["p99"], 1),
         },
+        # Per-stage p50/p99 (µs): where a flush's wall time actually
+        # goes (assemble vs dispatch vs device_sync vs resolve), so
+        # BENCH rows show the shape of the pipeline, not just totals.
+        "stages_us": {
+            labels[0]: {
+                "p50": round(s["p50"] * 1e6, 1),
+                "p99": round(s["p99"] * 1e6, 1),
+                "count": s["count"],
+            }
+            for labels, s in sorted(em.stage_duration.label_summaries().items())
+            if s["count"]
+        },
         "cold_compiles": em.cold_compiles,
     }
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import math
 import threading
+import time
 
 from prometheus_client import (
     CollectorRegistry,
@@ -119,8 +120,8 @@ class _HistChild:
         self._parent = parent
         self._key = key
 
-    def observe(self, value: float) -> None:
-        self._parent._observe(self._key, value)
+    def observe(self, value: float, trace_id: str = "") -> None:
+        self._parent._observe(self._key, value, trace_id)
 
 
 class Log2Histogram:
@@ -150,10 +151,14 @@ class Log2Histogram:
         self.labelnames = tuple(labelnames)
         self._les = [self.scale * (1 << i) for i in range(self.n_buckets)]
         self._lock = lockorder.make_lock("metrics.histogram")
-        # key -> [bucket counts (n_buckets + 1, last = +Inf), sum]
+        # key -> [bucket counts (n_buckets + 1, last = +Inf), sum,
+        #         {bucket index -> (trace_id, value, unix_ts) exemplar}]
+        # Exemplar memory is bounded: one (the latest) per bucket per
+        # label set, populated only when observe() is handed a sampled
+        # trace id (docs/monitoring.md "Tracing the pipeline").
         self._series: dict = {}
         if not self.labelnames:
-            self._series[()] = [[0] * (self.n_buckets + 1), 0.0]
+            self._series[()] = [[0] * (self.n_buckets + 1), 0.0, {}]
 
     def sample_names(self) -> list:
         return [self.name, f"{self.name}_bucket",
@@ -166,8 +171,8 @@ class Log2Histogram:
             )
         return _HistChild(self, tuple(str(v) for v in values))
 
-    def observe(self, value: float) -> None:
-        self._observe((), value)
+    def observe(self, value: float, trace_id: str = "") -> None:
+        self._observe((), value, trace_id)
 
     def _bucket_index(self, value: float) -> int:
         if value <= self.scale:
@@ -176,37 +181,52 @@ class Log2Histogram:
         i = e - 1 if m == 0.5 else e  # smallest i with value <= scale*2**i
         return min(i, self.n_buckets)  # n_buckets = the +Inf bucket
 
-    def _observe(self, key: tuple, value: float) -> None:
+    def _observe(self, key: tuple, value: float, trace_id: str = "") -> None:
         v = float(value)
         i = self._bucket_index(v)
         with self._lock:
             s = self._series.get(key)
             if s is None:
-                s = self._series[key] = [[0] * (self.n_buckets + 1), 0.0]
+                s = self._series[key] = [[0] * (self.n_buckets + 1), 0.0, {}]
             s[0][i] += 1
             s[1] += v
+            if trace_id:
+                s[2][i] = (trace_id, v, time.time())
 
-    def render_lines(self) -> list:
+    def render_lines(self, openmetrics: bool = False) -> list:
+        """Prometheus text lines; with openmetrics=True each bucket that
+        holds an exemplar gets the OpenMetrics `# {trace_id="..."}`
+        suffix (exemplars are an OpenMetrics-only construct — plain
+        Prometheus text exposition stays byte-identical to before)."""
         out = [f"# HELP {self.name} {self.doc}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             items = sorted(
-                (k, list(s[0]), s[1]) for k, s in self._series.items()
+                (k, list(s[0]), s[1], dict(s[2]))
+                for k, s in self._series.items()
             )
-        for key, counts, total in items:
+        for key, counts, total, exemplars in items:
             lbl = ",".join(
                 f'{n}="{_escape_label(v)}"'
                 for n, v in zip(self.labelnames, key)
             )
             sep = "," if lbl else ""
             cum = 0
-            for le, c in zip(self._les, counts):
+            for i, (le, c) in enumerate(zip(self._les, counts)):
                 cum += c
-                out.append(
-                    f'{self.name}_bucket{{{lbl}{sep}le="{le:.12g}"}} {cum}'
-                )
+                line = f'{self.name}_bucket{{{lbl}{sep}le="{le:.12g}"}} {cum}'
+                if openmetrics and i in exemplars:
+                    tid, v, ts = exemplars[i]
+                    line += (
+                        f' # {{trace_id="{tid}"}} {v:.9g} {ts:.3f}'
+                    )
+                out.append(line)
             cum += counts[-1]
-            out.append(f'{self.name}_bucket{{{lbl}{sep}le="+Inf"}} {cum}')
+            inf_line = f'{self.name}_bucket{{{lbl}{sep}le="+Inf"}} {cum}'
+            if openmetrics and self.n_buckets in exemplars:
+                tid, v, ts = exemplars[self.n_buckets]
+                inf_line += f' # {{trace_id="{tid}"}} {v:.9g} {ts:.3f}'
+            out.append(inf_line)
             suffix = f"{{{lbl}}}" if lbl else ""
             out.append(f"{self.name}_sum{suffix} {total}")
             out.append(f"{self.name}_count{suffix} {cum}")
@@ -219,7 +239,7 @@ class Log2Histogram:
         with self._lock:
             counts = [0] * (self.n_buckets + 1)
             total = 0.0
-            for buckets, s in self._series.values():
+            for buckets, s, _exemplars in self._series.values():
                 total += s
                 for i, c in enumerate(buckets):
                     counts[i] += c
@@ -248,6 +268,188 @@ class Log2Histogram:
                 cum += c
             out[f"p{int(q * 100)}"] = val
         return out
+
+    def label_summaries(self, qs=(0.5, 0.99)) -> dict:
+        """Per-label-set summaries: {label_values_tuple: summary_dict}.
+        The bench ledger uses this to break the stage-duration histogram
+        out per stage instead of blending all stages into one blob."""
+        with self._lock:
+            keys = list(self._series)
+        out = {}
+        for key in keys:
+            # Reuse summary()'s interpolation over a single series by
+            # projecting through a temporary view of the counts.
+            with self._lock:
+                s = self._series.get(key)
+                if s is None:
+                    continue
+                counts = list(s[0])
+                total = s[1]
+            n = sum(counts)
+            summ = {"count": n, "sum": total}
+            for q in qs:
+                summ[f"p{int(q * 100)}"] = self._quantile(counts, n, q)
+            out[key] = summ
+        return out
+
+    def _quantile(self, counts, n, q) -> float:
+        if n == 0:
+            return 0.0
+        rank = q * n
+        cum = 0
+        val = float(self._les[-1] * 2)
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                hi = self._les[i] if i < self.n_buckets else self._les[-1] * 2
+                lo = 0.0 if i == 0 else self._les[i - 1]
+                val = lo + (hi - lo) * max(rank - cum, 0.0) / c
+                break
+            cum += c
+        return val
+
+
+class HotKeySketch:
+    """Top-K hot-key attribution via a weighted space-saving (Misra-
+    Gries) sketch: at most `k` tracked keys, each entry carrying its
+    estimated hit count, the over-estimate bound `err` inherited at
+    insertion, and an over-limit tally. Guarantees (classic space-
+    saving): every key with true weight > total/k is tracked, and each
+    entry's estimate overshoots its true weight by at most its `err`
+    (<= total/k) — property-tested against an exact counter in
+    tests/test_observability.py.
+
+    Updated at the flush boundary where keys are already on host (the
+    engine object path's placements and the columnar edge's hash
+    columns); keyed by the 128-bit key hash pair so the columnar path
+    never has to decode key strings, with display names attached
+    opportunistically (object-path requests carry them) and bounded to
+    the tracked set. k=0 disables the sketch entirely — update() is one
+    attribute read, no allocation."""
+
+    def __init__(self, name: str, doc: str, k: int = 128):
+        self.name = name
+        self.doc = doc
+        self._lock = lockorder.make_lock("metrics.hotkeys")
+        self._k = int(k)
+        # (hi, lo) -> [count, err, over_limit]
+        self._entries: dict = {}
+        self._names: dict = {}  # (hi, lo) -> display string (tracked only)
+        self._total = 0
+        self._resolver = None  # fallback (hi, lo) -> Optional[str]
+
+    @property
+    def k(self) -> int:
+        return self._k
+
+    def configure(self, k: int) -> None:
+        with self._lock:
+            self._k = int(k)
+            if self._k <= 0:
+                self._entries.clear()
+                self._names.clear()
+
+    def set_resolver(self, fn) -> None:
+        """Fallback display-name resolver ((hi, lo) -> str or None),
+        e.g. DeviceEngine.key_string — used at snapshot/render time for
+        keys whose strings never crossed an update()."""
+        self._resolver = fn
+
+    def update(self, rows) -> None:
+        """Apply one flush's aggregated per-key rows:
+        [(hi, lo), weight, over_limit_count, name-or-None]. Caller
+        pre-aggregates per flush so the O(k) eviction scan runs per
+        distinct new key, not per request."""
+        if self._k <= 0:
+            return
+        with self._lock:
+            e = self._entries
+            k = self._k
+            names = self._names
+            for key, w, over, name in rows:
+                if w <= 0 and not over:
+                    continue
+                w = max(int(w), 0)
+                self._total += w
+                ent = e.get(key)
+                if ent is not None:
+                    ent[0] += w
+                    ent[2] += over
+                elif len(e) < k:
+                    e[key] = [w, 0, over]
+                else:
+                    # Space-saving eviction: the minimum-count entry is
+                    # replaced; the newcomer inherits its count as err.
+                    victim = min(e, key=lambda kk: e[kk][0])
+                    floor = e[victim][0]
+                    del e[victim]
+                    names.pop(victim, None)
+                    e[key] = [floor + w, floor, over]
+                if name is not None and key not in names:
+                    names[key] = name
+
+    def _display(self, key) -> str:
+        name = self._names.get(key)
+        if name is None and self._resolver is not None:
+            try:
+                name = self._resolver(key[0], key[1])
+            except Exception:
+                name = None
+        return name if name is not None else f"hash:{key[0]:x}:{key[1]:x}"
+
+    def snapshot(self) -> dict:
+        """JSON payload for /debug/hotkeys: entries sorted hottest-
+        first, with the sketch's global error bound (total/k)."""
+        with self._lock:
+            entries = sorted(self._entries.items(), key=lambda kv: -kv[1][0])
+            total = self._total
+            k = self._k
+        return {
+            "k": k,
+            "total_hits": total,
+            "max_error": (total // k) if k else 0,
+            "entries": [
+                {
+                    "key": self._display(key),
+                    "key_hash": [key[0], key[1]],
+                    "hits": ent[0],
+                    "err": ent[1],
+                    "over_limit": ent[2],
+                }
+                for key, ent in entries
+            ],
+        }
+
+    # -- renderable protocol (Metrics.register_renderable) -------------------
+
+    def sample_names(self) -> list:
+        return [self.name]
+
+    def render_lines(self, openmetrics: bool = False) -> list:
+        """Top-K gauge series, one per tracked key — cardinality is
+        bounded by k by construction (and counts can fall on eviction,
+        hence gauge, not counter)."""
+        out = [f"# HELP {self.name} {self.doc}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            entries = sorted(self._entries.items(), key=lambda kv: -kv[1][0])
+        for key, ent in entries:
+            out.append(
+                f'{self.name}{{key="{_escape_label(self._display(key))}"}} '
+                f"{ent[0]}"
+            )
+        return out
+
+    def summary(self) -> dict:
+        """Debug-snapshot shape (the /debug/engine histogram map calls
+        summary() on every engine renderable)."""
+        with self._lock:
+            return {
+                "count": len(self._entries),
+                "k": self._k,
+                "total_hits": self._total,
+            }
 
 
 # The device-tier histogram families (single source of truth: the engine
@@ -310,6 +512,23 @@ def engine_histograms() -> dict:
             "gubernator_ici_tick_groups",
             "Groups merged per ICI GLOBAL sync tick.",
             scale=cnt, n_buckets=26,
+        ),
+        "stage_duration": Log2Histogram(
+            "gubernator_engine_stage_duration",
+            "Per-stage request-lifecycle latency in seconds, by stage: "
+            "intake (submit-side validation until enqueue), assemble "
+            "(flush pull to kernel launch), dispatch (async kernel "
+            "launch), inflight_wait (dispatched, waiting for the "
+            "completion stage), device_sync (host materialization of "
+            "device results), resolve (telemetry + write-behind + "
+            "future resolution).",
+            scale=us, n_buckets=24, labelnames=("stage",),
+        ),
+        "hotkeys": HotKeySketch(
+            "gubernator_hotkey_hits",
+            "Estimated hits for the top-K hottest keys (weighted "
+            "space-saving sketch, GUBER_HOTKEYS_K entries max; see "
+            "/debug/hotkeys for error bounds and over-limit counts).",
         ),
     }
 
@@ -677,17 +896,38 @@ class Metrics:
                         "its series are stale until it recovers)", fn, n,
                     )
 
-    def render(self) -> bytes:
+    def render(self, openmetrics: bool = False) -> bytes:
         self.sync()
         lines = []
         for c in self._bare:
             lines.extend(c.render_lines())
         for h in self._renderables:
-            lines.extend(h.render_lines())
+            try:
+                lines.extend(h.render_lines(openmetrics=openmetrics))
+            except TypeError:  # externally-owned renderable, old shape
+                lines.extend(h.render_lines())
         text = ("\n".join(lines) + "\n").encode() if lines else b""
-        return text + generate_latest(self.registry)
+        body = text + generate_latest(self.registry)
+        if openmetrics:
+            body += b"# EOF\n"
+        return body
+
+    def render_negotiated(self, accept: str = "") -> tuple:
+        """(body, content_type) for one scrape, honoring OpenMetrics
+        content negotiation: exemplars are an OpenMetrics construct, so
+        they render ONLY when the scraper asks for
+        application/openmetrics-text (Prometheus does once exemplar
+        storage is enabled). Plain scrapes stay byte-stable."""
+        if OPENMETRICS_CONTENT_TYPE.split(";")[0] in (accept or ""):
+            return self.render(openmetrics=True), OPENMETRICS_CONTENT_TYPE
+        return self.render(), CONTENT_TYPE_LATEST
 
     content_type = CONTENT_TYPE_LATEST
+
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 def engine_sync(engine):

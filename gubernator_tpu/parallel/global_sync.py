@@ -245,6 +245,18 @@ class GlobalManager:
             self._hits_q.notify()
 
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        # The flush span makes trace context ride the hit-update leg:
+        # Peer._rpc_get_peer_rate_limits injects the CURRENT context
+        # into each item's metadata, and the owner's
+        # get_peer_rate_limits extracts it — without an active span
+        # here the injection is a no-op and the leg is trace-orphaned.
+        # (asyncio.gather tasks inherit this contextvar context.)
+        with tracing.span(
+            "globalManager.sendHits", level="DEBUG", keys=len(hits)
+        ):
+            await self._send_hits_traced(hits)
+
+    async def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
         t0 = time.perf_counter()
         self.svc.metrics.global_send_keys.observe(len(hits))
         failed = []  # (reqs, aged) legs to merge back into the queue
@@ -315,6 +327,12 @@ class GlobalManager:
     # -- broadcast to replicas (reference global.go:234-283) -----------------
 
     async def _broadcast(self, updates: Dict[str, RateLimitReq]) -> None:
+        with tracing.span(
+            "globalManager.broadcast", level="DEBUG", keys=len(updates)
+        ):
+            await self._broadcast_traced(updates)
+
+    async def _broadcast_traced(self, updates: Dict[str, RateLimitReq]) -> None:
         peers = [p for p in self.svc.picker.peers() if not p.info.is_owner]
         if not peers:
             # Single-pod deployment: nobody to broadcast to; skip the

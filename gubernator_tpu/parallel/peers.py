@@ -537,7 +537,16 @@ class PeerMesh:
         them to the new owners in bounded chunks over TransferSnapshots.
         Legs run under the per-peer circuit breakers and a per-peer
         deadline budget (forward_deadline_s, shared across that peer's
-        chunks) — a dead successor costs one shed leg, never a stall."""
+        chunks) — a dead successor costs one shed leg, never a stall.
+        Trace context rides each chunk's payload (the receiver's
+        TransferSnapshots servicer extracts it), so a handover's legs
+        stitch into one trace across the cluster."""
+        with tracing.span(
+            "PeerMesh.handover", level="INFO", reason=reason
+        ):
+            await self._handover_traced(route, reason)
+
+    async def _handover_traced(self, route, reason: str) -> None:
         from gubernator_tpu.store.store import snapshots_from_engine
 
         m = self.svc.metrics
@@ -604,7 +613,10 @@ class PeerMesh:
                 part = items[i : i + chunk]
                 try:
                     await peer.transfer_snapshots(
-                        pb.snapshots_to_bytes(part), timeout=remaining
+                        pb.snapshots_to_bytes(
+                            part, metadata=tracing.propagate_inject({})
+                        ),
+                        timeout=remaining,
                     )
                 except Exception as e:
                     m.handover_keys_dropped.labels("send_error").inc(rest)

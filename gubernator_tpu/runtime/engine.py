@@ -97,6 +97,24 @@ class EngineConfig:
     # its read-through probes sync inside the dispatch stage and
     # write-behind must not race the next flush's prefetch.
     pipeline_depth: int = 2
+    # Top-K hot-key attribution (GUBER_HOTKEYS_K): tracked entries in
+    # the space-saving sketch updated at the flush boundary (keys are
+    # already on host there) and served at /debug/hotkeys + as the
+    # cardinality-bounded gubernator_hotkey_hits metric. 0 disables the
+    # sketch entirely (update sites check once per flush, no per-item
+    # cost).
+    hotkeys_k: int = 128
+    # Per-request stage breakdown in response metadata
+    # (GUBER_STAGE_METADATA, default off): when on, each response
+    # carries a `stage_breakdown_us` metadata entry with the serving
+    # flush's intake->resolve stage times so clients can see where
+    # their p99 went. Off = zero per-item bookkeeping.
+    stage_metadata: bool = False
+    # OpenMetrics exemplars (GUBER_EXEMPLARS): attach the flush span's
+    # trace id to the histogram bucket each flush lands in. Only does
+    # anything when an OTel SDK records spans AND the scraper negotiates
+    # OpenMetrics; off = never attach.
+    exemplars: bool = True
     # Background-compile power-of-two batch widths (128..batch_size) so
     # the columnar edge can size the kernel to each call's occupancy.
     fast_buckets: bool = False
@@ -141,11 +159,23 @@ class EngineMetrics:
         for attr, h in hists.items():
             setattr(self, attr, h)
         self._histograms = tuple(hists.values())
+        # Pre-resolved stage children (labels() lookups are per-flush
+        # hot-path cost; see observe_stages).
+        self._stage = {
+            s: self.stage_duration.labels(s)
+            for s in (
+                "intake", "assemble", "dispatch", "inflight_wait",
+                "device_sync", "resolve",
+            )
+        }
         self.recorder = FlightRecorder()
         install_compile_listener()
 
     def histograms(self) -> tuple:
         return self._histograms
+
+    def observe_stage(self, stage: str, dur: float) -> None:
+        self._stage[stage].observe(dur)
 
     def note_cold_compile(self) -> None:
         with self.lock:
@@ -163,11 +193,13 @@ class EngineMetrics:
             self.batch_duration_sum += dur
 
     def observe_flush(self, path: str, n: int, waves: int, dur: float,
-                      dev: float) -> None:
+                      dev: float, trace_id: str = "") -> None:
         """One flush's distribution samples (per FLUSH, not per
-        request)."""
-        self.flush_duration.labels(path).observe(dur)
-        self.device_sync.labels(path).observe(dev)
+        request). A non-empty trace_id attaches an OpenMetrics exemplar
+        to the latency buckets this flush lands in, so a p99 spike in
+        Grafana clicks through to the exact trace."""
+        self.flush_duration.labels(path).observe(dur, trace_id)
+        self.device_sync.labels(path).observe(dev, trace_id)
         self.batch_width.labels(path).observe(n)
         self.flush_waves.observe(waves)
 
@@ -176,13 +208,19 @@ class _Slot:
     """Lock-free result slot for bulk submissions: Future.set_result costs
     ~12µs in lock/notify overhead per item; bulk callers only need the
     final list, so members use plain assignment and ONE real Future
-    resolves when the whole entry is processed."""
+    resolves when the whole entry is processed.
 
-    __slots__ = ("value", "_done")
+    `span` (the caller's request span, captured once per bulk) and
+    `t_enq` (enqueue stamp for GUBER_STAGE_METADATA) are observability
+    side-channels — both stay None on the knob-off path."""
+
+    __slots__ = ("value", "_done", "span", "t_enq")
 
     def __init__(self):
         self.value = None
         self._done = False
+        self.span = None
+        self.t_enq = None
 
     def set_result(self, v) -> None:
         self.value = v
@@ -214,6 +252,10 @@ class _FlushTicket:
         "t_dev",        # device dispatch start
         "t_disp_end",   # dispatch stage end (set by EngineBase._process)
         "host_mark",    # cumulative pump host-busy time at dispatch end
+        "seq",          # monotonic flush-ticket sequence (join key)
+        "span",         # flush OTel span (dispatch->completion lifecycle)
+        "otel_ctx",     # dispatch-time trace context for _complete
+        "trace_id",     # sampled trace id hex ('' when unsampled/off)
     )
 
     def __init__(self, **kw):
@@ -296,6 +338,15 @@ class EngineBase:
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
         self._draining = False
+        # Flush-ticket sequence (pump-thread only; the drain pass runs
+        # on the same thread): the /debug/engine <-> trace join key.
+        self._ticket_seq = 0
+        self._stage_md = bool(getattr(self.cfg, "stage_metadata", False))
+        hk = getattr(self.metrics, "hotkeys", None)
+        if hk is not None:
+            hk.configure(int(getattr(self.cfg, "hotkeys_k", 128) or 0))
+            if hasattr(self, "key_string"):
+                hk.set_resolver(self.key_string)
         # Bulk entries whose members may span flushes (wave-cap carry);
         # resolved by whichever thread completes their last member.
         self._bulks: List[_Bulk] = []
@@ -376,8 +427,32 @@ class EngineBase:
             self._pipe_q.put(ticket)
         else:
             self.metrics.pipeline_inflight.observe(1)
-            self._complete(ticket)
+            self._complete_ticket(ticket)
         return carry
+
+    def _complete_ticket(self, t) -> None:
+        """Run the completion stage under the ticket's dispatch-time
+        trace context (the completion thread otherwise runs
+        context-less — write-behind / resolve errors would land
+        trace-orphaned), then end the flush span. The `engine.complete`
+        child span gives the completion stage its own timing node with
+        thread-crossing parentage under the flush span."""
+        err = None
+        try:
+            with tracing.attached(t.otel_ctx):
+                if t.span is not None:
+                    with tracing.span(
+                        "engine.complete", level="DEBUG", ticket_seq=t.seq
+                    ):
+                        self._complete(t)
+                else:
+                    self._complete(t)
+        except Exception as e:
+            err = e
+            raise
+        finally:
+            tracing.end_span(t.span, error=err)
+            t.span = None
 
     def _completion_loop(self) -> None:
         """Completion stage: sync each in-flight ticket in FIFO dispatch
@@ -390,7 +465,7 @@ class EngineBase:
             if t is _STOP:
                 return
             try:
-                self._complete(t)
+                self._complete_ticket(t)
             except Exception as e:
                 self._ticket_failed(t, e)
             finally:
@@ -408,15 +483,23 @@ class EngineBase:
         import logging
 
         err = str(exc)
-        for _req, fut in ticket.items:
-            if not fut.done():
-                fut.set_result(RateLimitResp(error=err))
-        try:
-            self._recover_after_failure()
-        except Exception:
-            logging.getLogger(__name__).exception(
-                "table recovery after failed in-flight flush failed"
-            )
+        # Failure handling runs under the ticket's dispatch-time trace
+        # context too: the ERROR-level span (kept at every configured
+        # trace level) lands the failure under the flush's trace.
+        with tracing.attached(getattr(ticket, "otel_ctx", None)):
+            with tracing.span(
+                "engine.ticket_failed", level="ERROR", error=err,
+                ticket_seq=getattr(ticket, "seq", None) or 0,
+            ):
+                for _req, fut in ticket.items:
+                    if not fut.done():
+                        fut.set_result(RateLimitResp(error=err))
+                try:
+                    self._recover_after_failure()
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "table recovery after failed in-flight flush failed"
+                    )
 
     def _observe_overlap(self, ticket) -> None:
         """Host/device overlap sample for one completed flush: host
@@ -466,10 +549,48 @@ class EngineBase:
         for b in rest:
             b.resolve()
 
+    # -- flush-span lifecycle (docs/monitoring.md "Tracing the pipeline") ----
+
+    def _flush_seq(self) -> int:
+        """Next ticket sequence. Pump-thread only (the drain pass runs
+        on the pump thread too), so a plain increment suffices."""
+        self._ticket_seq += 1
+        return self._ticket_seq
+
+    def _start_flush_span(self, flush_items, seq: int, **attributes):
+        """Start the per-ticket flush span (ends at completion, possibly
+        on another thread) and wire the batch-boundary links: the flush
+        span links to each distinct request span it serves, and each
+        request span links back to the flush span. Returns None when
+        tracing is off — the entire method is then two cheap calls."""
+        fspan = tracing.start_span(
+            "engine.flush", level="DEBUG",
+            pipeline_depth=self._pipe_depth, ticket_seq=seq, **attributes,
+        )
+        if fspan is None:
+            return None
+        seen = set()
+        for _req, fut in flush_items:
+            rs = getattr(fut, "span", None)
+            if rs is None or id(rs) in seen:
+                continue
+            seen.add(id(rs))
+            tracing.link(fspan, rs)
+            tracing.link(rs, fspan)
+        return fspan
+
+    def hotkeys_snapshot(self) -> dict:
+        """JSON payload for /debug/hotkeys (service/gateway.py)."""
+        hk = getattr(self.metrics, "hotkeys", None)
+        if hk is None:
+            return {"k": 0, "total_hits": 0, "max_error": 0, "entries": []}
+        return hk.snapshot()
+
     # -- public intake -------------------------------------------------------
 
     def check_async(self, req: RateLimitReq) -> "Future[RateLimitResp]":
         """Enqueue one request; resolves after its wave executes."""
+        t_in = time.perf_counter()
         fut: Future = Future()
         if not self._running:
             # The pump already exited its drain phase; nothing will ever
@@ -483,13 +604,23 @@ class EngineBase:
             return fut
         if req.created_at is None:
             req.created_at = self.now_fn()
-        self._queue.put((req, fut, time.perf_counter()))
+        # Request-span capture for the batch-boundary link (None unless
+        # an SDK records a span in this caller's context).
+        rs = tracing.current_span()
+        if rs is not None:
+            fut.span = rs
+        t_enq = time.perf_counter()
+        self.metrics.observe_stage("intake", t_enq - t_in)
+        if self._stage_md:
+            fut.t_enq = t_enq
+        self._queue.put((req, fut, t_enq))
         return fut
 
     def check_bulk(self, reqs: Sequence[RateLimitReq]) -> "Future[List[RateLimitResp]]":
         """Bulk check: ONE queue entry and ONE Future for N requests
         (amortizes pump wakeups and future overhead; the natural fit for
         the batched GetRateLimits API). Resolves in request order."""
+        t_in = time.perf_counter()
         out: Future = Future()
         if not self._running:
             out.set_result(
@@ -499,8 +630,12 @@ class EngineBase:
         slots: List[_Slot] = []
         work = []
         now = None
+        # One request-span capture per BULK (members share the caller's
+        # context): the flush that serves them links back to this span.
+        rs = tracing.current_span()
         for req in reqs:
             slot = _Slot()
+            slot.span = rs
             slots.append(slot)
             err = validate_request(req)
             if err is not None:
@@ -512,7 +647,12 @@ class EngineBase:
                 req.created_at = now
             work.append((req, slot))
         if work:
-            self._queue.put(_Bulk(work, slots, out))
+            b = _Bulk(work, slots, out)
+            self.metrics.observe_stage("intake", b.t_enq - t_in)
+            if self._stage_md:
+                for s in slots:
+                    s.t_enq = b.t_enq
+            self._queue.put(b)
         else:
             out.set_result([s.value for s in slots])
         return out
@@ -1077,21 +1217,36 @@ class DeviceEngine(EngineBase):
                     wave_lane_req[place[0]][place[1]] = (
                         items[i][0], place[2], place[3],
                     )
-        t_dev = time.perf_counter()
-        with _telemetry.serving_scope(self.metrics), tracing.span(
-            "engine.flush", level="DEBUG", path="object",
+        # Per-ticket flush span: starts here, rides the ticket across
+        # the pipeline boundary, ends when _complete finishes (the
+        # completion thread re-attaches its context — see
+        # _complete_ticket). Request spans link to it and back.
+        seq = self._flush_seq()
+        fspan = self._start_flush_span(
+            items, seq, path="object", layout=cfg.layout,
             items=len(items), waves=len(waves),
-        ):
-            outs, wave_rows_host, events = self._execute_waves(
-                waves, wave_lane_req, now, prefetched
-            )
+            batch_width=len(items) - len(carry),
+        )
+        t_dev = time.perf_counter()
+        try:
+            with _telemetry.serving_scope(self.metrics), tracing.use_span_ctx(
+                fspan
+            ):
+                outs, wave_rows_host, events = self._execute_waves(
+                    waves, wave_lane_req, now, prefetched
+                )
+        except Exception as e:
+            tracing.end_span(fspan, error=e)
+            raise
         return carry, _FlushTicket(
             items=items, placements=placements, outs=outs,
             rows=wave_rows_host, events=events,
             served=len(items) - len(carry), carry_n=len(carry),
             waves=len(waves),
             widths=[int(w.active.shape[0]) for w in waves],  # guberlint: allow-host-sync -- static shape metadata, no device readback
-            t0=t0, t_dev=t_dev,
+            t0=t0, t_dev=t_dev, seq=seq, span=fspan,
+            otel_ctx=tracing.context_of(fspan),
+            trace_id=tracing.trace_id_of(fspan),
         )
 
     def _complete(self, t: _FlushTicket) -> None:
@@ -1099,22 +1254,30 @@ class DeviceEngine(EngineBase):
         (one host sync per wave), feed telemetry, run write-behind, and
         resolve the futures — in FIFO dispatch order when pipelined."""
         cfg = self.cfg
+        t_c0 = time.perf_counter()
         # The np.asarray syncs live in _materialize_out (the sanctioned
         # completion-stage readback).
         host = [_materialize_out(o) for o in t.outs]
-        dev_s = time.perf_counter() - t.t_dev
+        t_sync = time.perf_counter()
+        dev_s = t_sync - t.t_dev
 
         if cfg.keep_key_strings:
             self._drop_displaced_strings(t.events)
         tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
         dur = time.perf_counter() - t.t0
         em = self.metrics
+        trace_id = (t.trace_id or "") if cfg.exemplars else ""
         em.observe(tot[0], tot[1], tot[2], tot[3], t.waves, t.served, dur)
-        em.observe_flush("object", t.served, t.waves, dur, dev_s)
+        em.observe_flush("object", t.served, t.waves, dur, dev_s, trace_id)
+        em.observe_stage("assemble", t.t_dev - t.t0)
+        em.observe_stage("dispatch", t.t_disp_end - t.t_dev)
+        em.observe_stage("inflight_wait", max(t_c0 - t.t_disp_end, 0.0))
+        em.observe_stage("device_sync", t_sync - t_c0)
         em.recorder.record(
             path="object", layout=cfg.layout, n=t.served, waves=t.waves,
             carry=t.carry_n, widths=t.widths,
             dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
+            ticket=t.seq, trace_id=t.trace_id or "",
         )
 
         # Write-behind BEFORE resolving futures, so a caller that observed
@@ -1123,19 +1286,62 @@ class DeviceEngine(EngineBase):
         if self.store is not None:
             self._store_write_behind(t.items, t.placements, t.outs, t.rows)
 
+        # GUBER_STAGE_METADATA: the flush-level stage times every served
+        # item shares, built once; each response appends its own queue
+        # wait (resolve time is unknowable before resolution and is
+        # reported as the flush-level histogram only).
+        stage_base = None
+        if self._stage_md:
+            stage_base = (
+                f"assemble={int((t.t_dev - t.t0) * 1e6)}"
+                f",dispatch={int((t.t_disp_end - t.t_dev) * 1e6)}"
+                f",inflight_wait={int(max(t_c0 - t.t_disp_end, 0.0) * 1e6)}"
+                f",device_sync={int((t_sync - t_c0) * 1e6)}"
+            )
+        hk = em.hotkeys if em.hotkeys.k > 0 else None
+        hk_agg: Dict[Tuple[int, int], list] = {}
+        OVER = 1  # api.types.Status.OVER_LIMIT
         for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
                 continue  # resolved (encode error) or deferred
             w, lane = place[0], place[1]
             st, rem, rst, lim = host[w][0], host[w][1], host[w][2], host[w][3]
+            status = int(st[lane])  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+            if hk is not None:
+                k = (place[2], place[3])
+                ent = hk_agg.get(k)
+                if ent is None:
+                    hk_agg[k] = [
+                        max(int(req.hits), 0), int(status == OVER),
+                        req.hash_key(),
+                    ]
+                else:
+                    ent[0] += max(int(req.hits), 0)
+                    ent[1] += int(status == OVER)
+            md = None
+            if stage_base is not None:
+                t_enq = getattr(fut, "t_enq", None)
+                md = {
+                    "stage_breakdown_us": (
+                        f"queue={int((t.t0 - t_enq) * 1e6)},{stage_base}"
+                        if t_enq is not None
+                        else stage_base
+                    )
+                }
             fut.set_result(
                 RateLimitResp(
-                    status=int(st[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    status=status,
                     limit=int(lim[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
                     remaining=int(rem[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
                     reset_time=int(rst[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    **({"metadata": md} if md else {}),
                 )
             )
+        if hk is not None and hk_agg:
+            hk.update(
+                [(k, v[0], v[1], v[2]) for k, v in hk_agg.items()]
+            )
+        em.observe_stage("resolve", time.perf_counter() - t_sync)
         self._observe_overlap(t)
 
     @staticmethod
@@ -1318,7 +1524,8 @@ class DeviceEngine(EngineBase):
         t_dev = time.perf_counter()
         with _telemetry.serving_scope(self.metrics), tracing.span(
             "engine.flush", level="DEBUG", path="columnar", items=n, waves=W,
-        ):
+            layout=cfg.layout,
+        ) as fspan:
             outs, wave_rows_host, events = self._execute_waves(
                 wave_slices, lane_reqs, now, prefetched,
                 req_resolver=resolver,
@@ -1326,6 +1533,7 @@ class DeviceEngine(EngineBase):
 
             status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
         dev_s = time.perf_counter() - t_dev
+        flush_trace_id = tracing.trace_id_of(fspan)
 
         if store is not None:
             # Write-behind from the per-wave gathered rows (last-op-wins
@@ -1342,12 +1550,21 @@ class DeviceEngine(EngineBase):
         dur = time.perf_counter() - t_start
         em = self.metrics
         em.observe(tot_hits, tot_miss, tot_evic, tot_over, W, n, dur)
-        em.observe_flush("columnar", n, W, dur, dev_s)
+        em.observe_flush(
+            "columnar", n, W, dur, dev_s,
+            flush_trace_id if cfg.exemplars else "",
+        )
+        em.observe_stage("assemble", t_dev - t_start)
+        em.observe_stage("device_sync", dev_s)
         em.recorder.record(
             path="columnar", layout=cfg.layout, n=n, waves=W, carry=0,
             widths=[B] * W, dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
+            trace_id=flush_trace_id,
         )
-        return (status[ix], r_limit[ix], remaining[ix], reset_time[ix])
+        st_req = status[ix]
+        if em.hotkeys.k > 0:
+            _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, st_req)
+        return (st_req, r_limit[ix], remaining[ix], reset_time[ix])
 
     def _execute_waves(
         self, waves, lane_reqs, now, prefetched, req_resolver=None
@@ -1865,6 +2082,28 @@ def _stack_wave_outputs(outs):
         np.stack([np.asarray(o.remaining) for o in outs]),
         np.stack([np.asarray(o.reset_time) for o in outs]),
     )
+
+
+def _note_hotkeys_columnar(hk, hi, lo, hits, status) -> None:
+    """Aggregate one columnar flush into the hot-key sketch. Keyed by
+    the 128-bit hash pair — the columnar edge never decodes key strings
+    for this (cost discipline); display names resolve lazily at
+    snapshot/render time through the sketch's resolver or the object
+    path's updates. All inputs are already-materialized host arrays."""
+    agg: Dict[Tuple[int, int], list] = {}
+    for h, l, w, s in zip(
+        hi.tolist(), lo.tolist(), hits.tolist(), status.tolist()
+    ):
+        o = 1 if s == 1 else 0  # api.types.Status.OVER_LIMIT
+        k = (h, l)
+        ent = agg.get(k)
+        if ent is None:
+            agg[k] = [max(int(w), 0), o]
+        else:
+            ent[0] += max(int(w), 0)
+            ent[1] += o
+    if agg:
+        hk.update([(k, v[0], v[1], None) for k, v in agg.items()])
 
 
 def _wave_totals(outs):

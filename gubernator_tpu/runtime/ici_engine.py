@@ -53,6 +53,7 @@ from gubernator_tpu.runtime.engine import (
     _WaveAssembler,
     _assemble_column_waves,
     _materialize_out,
+    _note_hotkeys_columnar,
     _select_columns,
     _stack_wave_outputs,
     _wave_totals,
@@ -77,6 +78,11 @@ class IciEngineConfig:
     max_flush_items: int = 8192
     max_waves: int = 32  # per-flush wave cap; overflow carries over
     sync_wait_s: float = 0.1  # GLOBAL sync cadence (reference 100ms)
+    # Observability knobs — same semantics as EngineConfig (GUBER_HOTKEYS_K
+    # / GUBER_STAGE_METADATA / GUBER_EXEMPLARS; docs/monitoring.md).
+    hotkeys_k: int = 128
+    stage_metadata: bool = False
+    exemplars: bool = True
     # Table layout for BOTH the sharded and replica tiers (the
     # ops/kernels.py LAYOUTS registry; "narrow" halves probe DMA at
     # large tables); fused is the TPU production layout (VERDICT r4
@@ -175,7 +181,7 @@ class IciEngine(EngineBase):
             # so it counts against the cold-compile invariant too.
             with _telemetry.serving_scope(self.metrics), tracing.span(
                 "ici.sync_tick", level="DEBUG"
-            ):
+            ) as tick_span:
                 self.ici_state, diag = self._sync(self.ici_state, now)
                 d = np.asarray(diag)
             # kept/dropped cover groups merged THIS tick; under a capped
@@ -195,6 +201,7 @@ class IciEngine(EngineBase):
             path="ici-sync", layout=self.cfg.layout, groups=groups,
             backlog=self.sync_backlog, overflow_keys=self.overflow_keys,
             dur_us=int(dur * 1e6),
+            trace_id=tracing.trace_id_of(tick_span),
         )
 
     def inject_globals(self, globals_) -> None:
@@ -319,7 +326,8 @@ class IciEngine(EngineBase):
         t_dev = time.perf_counter()
         with self._lock, _telemetry.serving_scope(self.metrics), tracing.span(
             "engine.flush", level="DEBUG", path="columnar", items=n,
-        ):
+            layout=cfg.layout,
+        ) as fspan:
             table = self.table
             state = self.ici_state
             try:
@@ -373,14 +381,23 @@ class IciEngine(EngineBase):
                 tots[j] += v
         dev_s = time.perf_counter() - t_dev
         dur = time.perf_counter() - t_start
+        flush_trace_id = tracing.trace_id_of(fspan)
         em = self.metrics
         em.observe(tots[0], tots[1], tots[2], tots[3], waves_total, n, dur)
-        em.observe_flush("columnar", n, waves_total, dur, dev_s)
+        em.observe_flush(
+            "columnar", n, waves_total, dur, dev_s,
+            flush_trace_id if cfg.exemplars else "",
+        )
+        em.observe_stage("assemble", t_dev - t_start)
+        em.observe_stage("device_sync", dev_s)
         em.recorder.record(
             path="columnar", layout=cfg.layout, n=n, waves=waves_total,
             carry=0, widths=[cfg.batch_size] * waves_total,
             dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
+            trace_id=flush_trace_id,
         )
+        if em.hotkeys.k > 0:
+            _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, status)
         return (status, r_limit, remaining, reset_time)
 
     def _recover_tables_locked(self) -> bool:
@@ -529,7 +546,7 @@ class IciEngine(EngineBase):
                     wb, w, lane = placed
                     encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
                     sharded_asm.commit(w, grp)
-                    placements.append(("s", w, lane))
+                    placements.append(("s", w, lane, hi, lo))
                 else:
                     slot = group_of(lo, self.num_rgroups)
                     home = self._home_rr % self.n_dev
@@ -545,7 +562,7 @@ class IciEngine(EngineBase):
                         replica_homes.append(np.zeros(B, dtype=np.int64))
                     replica_homes[w][lane] = home
                     replica_asm.commit(w, (home, slot))
-                    placements.append(("r", w, lane))
+                    placements.append(("r", w, lane, hi, lo))
             except EncodeError as e:
                 fut.set_result(RateLimitResp(error=str(e)))
                 placements.append(None)
@@ -556,33 +573,44 @@ class IciEngine(EngineBase):
         # (the futures resolve with errors; nothing replays this flush).
         s_out, r_out = [], []
         waves_total = len(sharded_asm.waves) + len(replica_asm.waves)
-        t_dev = time.perf_counter()
-        with self._lock, _telemetry.serving_scope(self.metrics), tracing.span(
-            "engine.flush", level="DEBUG", path="object",
+        seq = self._flush_seq()
+        fspan = self._start_flush_span(
+            items, seq, path="object", layout=cfg.layout,
             items=len(items), waves=waves_total,
-        ):
-            table = self.table
-            state = self.ici_state
-            try:
-                for wb in sharded_asm.waves:
-                    table, out = self._decide(table, wb, now)
-                    s_out.append(out)
-                for wb, hm in zip(replica_asm.waves, replica_homes):
-                    state, out = self._replica(state, wb, hm, now)
-                    r_out.append(out)
-            except Exception:
+            batch_width=len(items) - len(carry),
+        )
+        t_dev = time.perf_counter()
+        try:
+            with self._lock, _telemetry.serving_scope(
+                self.metrics
+            ), tracing.use_span_ctx(fspan):
+                table = self.table
+                state = self.ici_state
+                try:
+                    for wb in sharded_asm.waves:
+                        table, out = self._decide(table, wb, now)
+                        s_out.append(out)
+                    for wb, hm in zip(replica_asm.waves, replica_homes):
+                        state, out = self._replica(state, wb, hm, now)
+                        r_out.append(out)
+                except Exception:
+                    self.table = table
+                    self.ici_state = state
+                    self._recover_tables_locked()
+                    raise
                 self.table = table
                 self.ici_state = state
-                self._recover_tables_locked()
-                raise
-            self.table = table
-            self.ici_state = state
+        except Exception as e:
+            tracing.end_span(fspan, error=e)
+            raise
 
         return carry, _FlushTicket(
             items=items, placements=placements, outs=s_out, r_outs=r_out,
             served=len(items) - len(carry), carry_n=len(carry),
             waves=waves_total, widths=[B] * waves_total,
-            t0=t0, t_dev=t_dev,
+            t0=t0, t_dev=t_dev, seq=seq, span=fspan,
+            otel_ctx=tracing.context_of(fspan),
+            trace_id=tracing.trace_id_of(fspan),
         )
 
     def _complete(self, t) -> None:
@@ -590,11 +618,13 @@ class IciEngine(EngineBase):
         telemetry, resolve futures (FIFO dispatch order when
         pipelined)."""
         cfg = self.cfg
+        t_c0 = time.perf_counter()
         host = {
             "s": [_materialize_out(o) for o in t.outs],
             "r": [_materialize_out(o) for o in t.r_outs],
         }
-        dev_s = time.perf_counter() - t.t_dev
+        t_sync = time.perf_counter()
+        dev_s = t_sync - t.t_dev
         tots = [0, 0, 0, 0]
         for path in host.values():
             for h in path:
@@ -602,27 +632,70 @@ class IciEngine(EngineBase):
                     tots[j] += h[4 + j]
         dur = time.perf_counter() - t.t0
         em = self.metrics
+        trace_id = (t.trace_id or "") if cfg.exemplars else ""
         em.observe(tots[0], tots[1], tots[2], tots[3], t.waves, t.served, dur)
-        em.observe_flush("object", t.served, t.waves, dur, dev_s)
+        em.observe_flush("object", t.served, t.waves, dur, dev_s, trace_id)
+        em.observe_stage("assemble", t.t_dev - t.t0)
+        em.observe_stage("dispatch", t.t_disp_end - t.t_dev)
+        em.observe_stage("inflight_wait", max(t_c0 - t.t_disp_end, 0.0))
+        em.observe_stage("device_sync", t_sync - t_c0)
         em.recorder.record(
             path="object", layout=cfg.layout, n=t.served, waves=t.waves,
             carry=t.carry_n, widths=t.widths,
             dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
+            ticket=t.seq, trace_id=t.trace_id or "",
         )
 
+        stage_base = None
+        if self._stage_md:
+            stage_base = (
+                f"assemble={int((t.t_dev - t.t0) * 1e6)}"
+                f",dispatch={int((t.t_disp_end - t.t_dev) * 1e6)}"
+                f",inflight_wait={int(max(t_c0 - t.t_disp_end, 0.0) * 1e6)}"
+                f",device_sync={int((t_sync - t_c0) * 1e6)}"
+            )
+        hk = em.hotkeys if em.hotkeys.k > 0 else None
+        hk_agg = {}
+        OVER = 1  # api.types.Status.OVER_LIMIT
         for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
                 continue
-            path, w, lane = place
+            path, w, lane = place[0], place[1], place[2]
             st, rem, rst, lim = host[path][w][:4]
+            status = int(st[lane])  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+            if hk is not None:
+                k = (place[3], place[4])
+                ent = hk_agg.get(k)
+                if ent is None:
+                    hk_agg[k] = [
+                        max(int(req.hits), 0), int(status == OVER),
+                        req.hash_key(),
+                    ]
+                else:
+                    ent[0] += max(int(req.hits), 0)
+                    ent[1] += int(status == OVER)
+            md = None
+            if stage_base is not None:
+                t_enq = getattr(fut, "t_enq", None)
+                md = {
+                    "stage_breakdown_us": (
+                        f"queue={int((t.t0 - t_enq) * 1e6)},{stage_base}"
+                        if t_enq is not None
+                        else stage_base
+                    )
+                }
             fut.set_result(
                 RateLimitResp(
-                    status=int(st[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    status=status,
                     limit=int(lim[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
                     remaining=int(rem[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
                     reset_time=int(rst[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+                    **({"metadata": md} if md else {}),
                 )
             )
+        if hk is not None and hk_agg:
+            hk.update([(k, v[0], v[1], v[2]) for k, v in hk_agg.items()])
+        em.observe_stage("resolve", time.perf_counter() - t_sync)
         self._observe_overlap(t)
 
     def _recover_after_failure(self) -> bool:

@@ -257,6 +257,16 @@ class DaemonConfig:
     # either way); feeds EngineConfig/IciEngineConfig.pipeline_depth.
     pipeline_depth: int = 2
 
+    # Request-lifecycle observability (docs/monitoring.md "Tracing the
+    # pipeline" / "Hot keys"): GUBER_HOTKEYS_K bounds the top-K hot-key
+    # sketch (0 = off); GUBER_STAGE_METADATA returns a per-response
+    # stage_breakdown_us metadata entry (off: zero per-item cost);
+    # GUBER_EXEMPLARS attaches flush-trace exemplars to the latency
+    # histograms under OpenMetrics negotiation.
+    hotkeys_k: int = 128
+    stage_metadata: bool = False
+    exemplars: bool = True
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine
@@ -280,6 +290,9 @@ class DaemonConfig:
             # compile in the background at boot.
             fast_buckets=True,
             layout=self.table_layout,
+            hotkeys_k=self.hotkeys_k,
+            stage_metadata=self.stage_metadata,
+            exemplars=self.exemplars,
             drain_timeout_s=self.drain_timeout_s,
             pipeline_depth=self.pipeline_depth,
             # Handover needs routable (string-keyed) snapshots even on

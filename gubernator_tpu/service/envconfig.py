@@ -156,6 +156,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         # encode with device execution. Decisions are bit-exact across
         # depths.
         pipeline_depth=_env_int("GUBER_PIPELINE_DEPTH", 2),
+        # Request-lifecycle observability (docs/monitoring.md): hot-key
+        # sketch size, per-response stage breakdown, histogram exemplars.
+        hotkeys_k=_env_int("GUBER_HOTKEYS_K", 128),
+        stage_metadata=_env_bool("GUBER_STAGE_METADATA"),
+        exemplars=_env_bool("GUBER_EXEMPLARS", True),
     )
     if conf.pipeline_depth < 1:
         raise ValueError(
@@ -207,6 +212,9 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             batch_limit=behaviors.batch_limit,
             layout=_env("GUBER_ICI_LAYOUT", base.layout),  # LAYOUTS-validated below
             pipeline_depth=conf.pipeline_depth,
+            hotkeys_k=conf.hotkeys_k,
+            stage_metadata=conf.stage_metadata,
+            exemplars=conf.exemplars,
             # 0 = unbounded (merge the full table every tick)
             max_sync_groups=(
                 _env_int("GUBER_ICI_SYNC_GROUPS", base.max_sync_groups or 0)

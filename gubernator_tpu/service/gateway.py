@@ -9,6 +9,8 @@ Device-tier debug surface (docs/monitoring.md; no reference analog):
 
 - GET /debug/engine — the engine's flight recorder (last K flush
   records), histogram summaries, counters, and table occupancy as JSON.
+- GET /debug/hotkeys — top-K hot-key attribution (the space-saving
+  sketch: estimated hits, error bound, over-limit counts per key).
 - GET /debug/profile?seconds=N — on-demand jax.profiler capture to a
   temp dir (one capture at a time process-wide; 503 when busy or when
   the profiler is unavailable). Works on CPU too — the XLA profiler is
@@ -92,7 +94,12 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
             _PROFILE_GUARD.release()
         return web.json_response(out)
 
+    async def debug_hotkeys(request: web.Request) -> web.Response:
+        # Host-side sketch snapshot — no device work, no engine lock.
+        return web.json_response(svc.engine.hotkeys_snapshot())
+
     app.router.add_get("/debug/engine", debug_engine)
+    app.router.add_get("/debug/hotkeys", debug_hotkeys)
     app.router.add_get("/debug/profile", debug_profile)
 
 
@@ -183,9 +190,13 @@ def build_app(svc: V1Service) -> web.Application:
         )
 
     async def metrics(request: web.Request) -> web.Response:
-        return web.Response(
-            body=svc.metrics.render(), content_type="text/plain", charset="utf-8"
+        # OpenMetrics content negotiation: exemplars (trace ids on
+        # histogram buckets) render ONLY when the scraper asks for
+        # application/openmetrics-text; plain scrapes stay byte-stable.
+        body, ctype = svc.metrics.render_negotiated(
+            request.headers.get("Accept", "")
         )
+        return web.Response(body=body, headers={"Content-Type": ctype})
 
     app.router.add_post("/v1/GetRateLimits", get_rate_limits)
     app.router.add_get("/v1/HealthCheck", health_check)

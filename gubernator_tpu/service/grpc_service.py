@@ -151,16 +151,28 @@ class PeersV1Servicer:
 
     async def TransferSnapshots(self, request_bytes, context):
         """Ownership handover receiver (docs/robustness.md): merge the
-        sender's counter state last-writer-wins on stamp."""
+        sender's counter state last-writer-wins on stamp. The chunk's
+        optional metadata carries the sender's trace context, so the
+        receive + merge lands under the sender's handover trace."""
+        from gubernator_tpu.utils import tracing
+
         async with _instrumented(
             self.svc.metrics, "/pb.gubernator.PeersV1/TransferSnapshots"
         ):
             try:
-                snaps = pb.snapshots_from_bytes(request_bytes)
+                snaps, md = pb.snapshots_md_from_bytes(request_bytes)
             except (ValueError, TypeError):
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     "malformed snapshot transfer",
                 )
-            accepted, stale = await self.svc.transfer_snapshots(snaps)
+            ctx = tracing.propagate_extract(md)
+            with tracing.attached(ctx):
+                with tracing.span(
+                    "PeersV1.TransferSnapshots", level="DEBUG",
+                    keys=len(snaps),
+                ):
+                    accepted, stale = await self.svc.transfer_snapshots(
+                        snaps
+                    )
             return pb.transfer_resp_to_bytes(accepted, stale)

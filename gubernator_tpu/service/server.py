@@ -27,6 +27,7 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.metrics import Metrics
 from gubernator_tpu.runtime.engine import DeviceEngine
 from gubernator_tpu.utils import clock as _clock
+from gubernator_tpu.utils import tracing
 
 
 class ApiError(Exception):
@@ -82,7 +83,14 @@ class V1Service:
         m.concurrent_checks.inc()
         t0 = time.perf_counter()
         try:
-            return await self._get_rate_limits(reqs)
+            # Request span: the engine links the flush span that serves
+            # each batch back to this span (and vice versa) across the
+            # batch boundary — see runtime/engine.py _start_flush_span
+            # and docs/monitoring.md "Tracing the pipeline".
+            with tracing.span(
+                "V1Instance.GetRateLimits", level="INFO", items=len(reqs)
+            ):
+                return await self._get_rate_limits(reqs)
         finally:
             m.concurrent_checks.dec()
             m.func_duration.labels("V1Instance.GetRateLimits").observe(
@@ -163,7 +171,9 @@ class V1Service:
                 for (i, req, owner), resp in zip(global_items, results):
                     if self.global_mgr is not None:
                         self.global_mgr.queue_hit(req)
-                    resp.metadata = {"owner": owner.grpc_address}
+                    # Merge, don't replace: the engine may have attached
+                    # stage_breakdown_us (GUBER_STAGE_METADATA) already.
+                    resp.metadata["owner"] = owner.grpc_address
                     responses[i] = resp
             except Exception as e:
                 for i, _, _ in global_items:

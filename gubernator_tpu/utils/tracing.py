@@ -70,6 +70,133 @@ def span(name: str, level: str = "INFO", **attributes):
         yield s
 
 
+# ---------------------------------------------------------------------------
+# Batch-aware span lifecycle (docs/monitoring.md "Tracing the pipeline").
+#
+# The two-stage engine pipeline dispatches a flush on the pump thread and
+# completes it on the completion thread, possibly tickets later — a plain
+# `with span(...)` cannot cover that. These helpers split the span
+# lifecycle: start_span() creates a non-current span at dispatch,
+# context_of() captures an attachable context the _FlushTicket carries
+# across the thread boundary, and end_span() closes it at completion.
+# Every helper is a cheap no-op (None in, None out) when the OTel API is
+# absent, no SDK is configured, or the span's level is filtered — the
+# knob-off serving path allocates nothing.
+
+
+def current_span():
+    """The active *recording* span, or None. One call per intake (per
+    check_bulk / check_async, never per item): the engine captures the
+    request span here so the flush that eventually serves the batch can
+    link back to it across the batch boundary."""
+    if not _OTEL:
+        return None
+    try:
+        s = _otel_trace.get_current_span()
+        if s.is_recording():
+            return s
+    except Exception:
+        pass
+    return None
+
+
+def start_span(name: str, level: str = "INFO", **attributes):
+    """Start (but do not make current) a span, or None when tracing is
+    off / the level is filtered / no SDK records spans. The caller owns
+    the lifecycle: make it current with use_span_ctx(), carry
+    context_of() across threads, finish with end_span()."""
+    if not _OTEL or _LEVELS.get(str(level).upper(), 1) > _LEVEL:
+        return None
+    try:
+        s = _TRACER.start_span(name)
+        if not s.is_recording():
+            return None  # no SDK: INVALID_SPAN — skip the bookkeeping
+        for k, v in attributes.items():
+            try:
+                s.set_attribute(k, v)
+            except Exception:
+                pass
+        return s
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def use_span_ctx(s):
+    """Make an explicitly-started span current for a scope WITHOUT
+    ending it on exit (the flush span outlives its dispatch scope)."""
+    if not _OTEL or s is None:
+        yield s
+        return
+    with _otel_trace.use_span(
+        s, end_on_exit=False, record_exception=False,
+        set_status_on_exception=False,
+    ):
+        yield s
+
+
+def context_of(s):
+    """An attachable Context with `s` current — what a _FlushTicket
+    carries so the completion thread can re-attach the dispatch-time
+    trace context (tracing.attached)."""
+    if not _OTEL or s is None:
+        return None
+    try:
+        return _otel_trace.set_span_in_context(s)
+    except Exception:
+        return None
+
+
+def end_span(s, error=None) -> None:
+    """Finish an explicitly-started span, recording `error` (an
+    exception) as span status when given. Safe on None and safe to call
+    at most once per span from exactly one thread (the completion
+    stage)."""
+    if not _OTEL or s is None:
+        return
+    try:
+        if error is not None:
+            try:
+                s.record_exception(error)
+                if hasattr(_otel_trace, "StatusCode"):
+                    s.set_status(_otel_trace.StatusCode.ERROR)
+            except Exception:
+                pass
+        s.end()
+    except Exception:
+        pass
+
+
+def link(src, dst) -> None:
+    """Add a span link src -> dst across the batch boundary (request
+    span -> flush span and back). Both may be None; add_link needs
+    OTel API >= 1.23 and degrades to a no-op below that."""
+    if not _OTEL or src is None or dst is None:
+        return
+    try:
+        add = getattr(src, "add_link", None)
+        if add is not None:
+            add(dst.get_span_context())
+    except Exception:
+        pass
+
+
+def trace_id_of(s) -> str:
+    """32-hex trace id of a recording+sampled span (the flight-recorder
+    join key and the OpenMetrics exemplar payload), or ''. Only sampled
+    traces qualify — an exemplar pointing at a never-exported trace is
+    a dead link in Grafana."""
+    if not _OTEL or s is None:
+        return ""
+    try:
+        sc = s.get_span_context()
+        if sc.is_valid and sc.trace_flags.sampled:
+            return format(sc.trace_id, "032x")
+    except Exception:
+        pass
+    return ""
+
+
 def propagate_inject(metadata: Dict[str, str]) -> Dict[str, str]:
     """Inject current trace context into a rate limit's metadata map
     (reference MetadataCarrier inject side). Fast-path: skip the

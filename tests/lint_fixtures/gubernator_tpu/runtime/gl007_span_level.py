@@ -1,0 +1,34 @@
+"""GL007 violation fixture: span calls without an explicit level=."""
+
+from gubernator_tpu.utils import tracing
+from gubernator_tpu.utils.tracing import span
+
+
+def unlabeled_attr_call():
+    with tracing.span("engine.flush"):  # fires: no level kwarg
+        pass
+
+
+def unlabeled_bare_call():
+    with span("engine.flush", path="object"):  # fires: attrs but no level
+        pass
+
+
+def unlabeled_start_span():
+    s = tracing.start_span("engine.flush")  # fires: start_span, no level
+    tracing.end_span(s)
+
+
+def leveled_kwarg_ok():
+    with tracing.span("engine.flush", level="DEBUG"):
+        pass
+
+
+def leveled_positional_ok():
+    with tracing.span("engine.flush", "ERROR"):
+        pass
+
+
+def pragma_ok():
+    with tracing.span("engine.flush"):  # guberlint: allow-span-level -- fixture witness
+        pass

@@ -64,6 +64,31 @@ def test_debug_engine_on_status_listener(daemon):
     assert r.json()["engine"] == "DeviceEngine"
 
 
+def test_debug_hotkeys_served_on_both_listeners(daemon):
+    for addr in (daemon.http_address, daemon.status_address):
+        r = requests.get(f"http://{addr}/debug/hotkeys", timeout=10)
+        assert r.status_code == 200
+        snap = r.json()
+        assert snap["k"] >= 1
+        assert snap["total_hits"] >= 20
+        keys = {e["key"] for e in snap["entries"]}
+        assert any(k.startswith("dbg_k") for k in keys), keys
+        for e in snap["entries"]:
+            assert e["hits"] >= 1 and e["err"] >= 0
+
+
+def test_metrics_openmetrics_negotiation(daemon):
+    url = f"http://{daemon.http_address}/metrics"
+    plain = requests.get(url, timeout=10)
+    assert "# {trace_id=" not in plain.text
+    om = requests.get(
+        url, headers={"Accept": "application/openmetrics-text"}, timeout=10
+    )
+    assert "openmetrics" in om.headers["Content-Type"]
+    assert om.text.rstrip().endswith("# EOF")
+    assert "gubernator_hotkey_hits" in om.text
+
+
 def test_metrics_exposes_histogram_series(daemon):
     text = requests.get(
         f"http://{daemon.http_address}/metrics", timeout=10

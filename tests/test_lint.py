@@ -65,6 +65,7 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+        "GL007",
     }
 
 
@@ -115,6 +116,13 @@ _CASES = [
         fixture("parallel", "gl006_swallow.py"),
         {"bare_pass", "bare_except", "tuple_catch"},
         4,  # 3 swallows + 1 reason-less pragma
+    ),
+    (
+        "GL007",
+        fixture("runtime", "gl007_span_level.py"),
+        {"unlabeled_attr_call", "unlabeled_bare_call",
+         "unlabeled_start_span"},
+        3,  # leveled kwarg/positional + pragma'd sites don't fire
     ),
 ]
 

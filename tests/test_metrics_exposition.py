@@ -77,6 +77,75 @@ def test_histogram_labels_render_separately():
     assert h.summary()["count"] == 3
 
 
+# ---- exemplars (OpenMetrics negotiation only) -------------------------------
+
+EXEMPLAR_RE = __import__("re").compile(
+    r'^(?P<series>\S+_bucket\{[^}]*le="[^"]+"\}) (?P<count>\d+) '
+    r'# \{trace_id="(?P<tid>[0-9a-f]{32})"\} '
+    r"(?P<value>[0-9.eE+-]+) (?P<ts>[0-9.]+)$"
+)
+
+
+def test_histogram_exemplar_renders_under_openmetrics_only():
+    h = Log2Histogram("h_ex", "doc", scale=1e-6, n_buckets=8)
+    h.observe(3e-6, trace_id="ab" * 16)
+    h.observe(5e-5)  # no trace id -> no exemplar for this bucket
+    plain = h.render_lines()
+    assert not any("# {" in ln for ln in plain), (
+        "plain Prometheus exposition must stay exemplar-free"
+    )
+    om = h.render_lines(openmetrics=True)
+    ex_lines = [ln for ln in om if "# {" in ln]
+    assert len(ex_lines) == 1
+    m = EXEMPLAR_RE.match(ex_lines[0])
+    assert m, f"exemplar line does not parse: {ex_lines[0]!r}"
+    assert m.group("tid") == "ab" * 16
+    assert float(m.group("value")) == pytest.approx(3e-6)
+    assert float(m.group("ts")) > 0
+    # exemplar suffix never corrupts the cumulative bucket counts
+    plain_counts = [ln.rsplit(" ", 1)[-1] for ln in plain if "_bucket" in ln]
+    om_counts = [
+        (EXEMPLAR_RE.match(ln).group("count") if "# {" in ln
+         else ln.rsplit(" ", 1)[-1])
+        for ln in om
+        if "_bucket" in ln
+    ]
+    assert plain_counts == om_counts
+
+
+def test_histogram_exemplar_latest_wins_per_bucket():
+    h = Log2Histogram("h_ex2", "doc", scale=1.0, n_buckets=4)
+    h.observe(1.5, trace_id="11" * 16)
+    h.observe(1.6, trace_id="22" * 16)  # same bucket: latest replaces
+    om = "\n".join(h.render_lines(openmetrics=True))
+    assert 'trace_id="' + "22" * 16 in om
+    assert 'trace_id="' + "11" * 16 not in om
+
+
+def test_labeled_exemplars_stay_per_series():
+    h = Log2Histogram("h_ex3", "doc", scale=1.0, n_buckets=4,
+                      labelnames=("path",))
+    h.labels("object").observe(1.0, "33" * 16)
+    h.labels("columnar").observe(1.0)
+    om = [ln for ln in h.render_lines(openmetrics=True) if "# {" in ln]
+    assert len(om) == 1 and 'path="object"' in om[0]
+
+
+def test_render_negotiated_content_types():
+    m = Metrics()
+    body, ctype = m.render_negotiated("text/plain")
+    assert ctype.startswith("text/plain")
+    assert not body.rstrip().endswith(b"# EOF")
+    body_om, ctype_om = m.render_negotiated(
+        "application/openmetrics-text; version=1.0.0"
+    )
+    assert "openmetrics" in ctype_om
+    assert body_om.rstrip().endswith(b"# EOF")
+    # both bodies parse with the Prometheus family parser modulo EOF
+    fams = list(parser.text_string_to_metric_families(body.decode()))
+    assert fams
+
+
 # ---- /metrics exposition ----------------------------------------------------
 
 

@@ -1,0 +1,254 @@
+"""Request-lifecycle observability: the hot-key space-saving sketch
+(error-bound property tests against an exact counter + engine wiring on
+both serving paths), per-stage latency histograms, the
+GUBER_STAGE_METADATA response breakdown, and the flight-recorder
+trace/ticket join keys."""
+
+import random
+from collections import Counter
+
+import pytest
+
+from gubernator_tpu.api.types import RateLimitReq
+from gubernator_tpu.metrics import HotKeySketch
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+NOW = 1_753_700_000_000
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 1_000_000)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+@pytest.fixture()
+def engine():
+    eng = DeviceEngine(
+        EngineConfig(
+            num_groups=1 << 10, batch_size=64, batch_wait_s=0.0005,
+            hotkeys_k=16,
+        ),
+        now_fn=lambda: NOW,
+    )
+    yield eng
+    eng.close()
+
+
+# ---- space-saving sketch properties -----------------------------------------
+
+
+def _zipf_stream(n_items, n_keys, seed, weighted=False):
+    rng = random.Random(seed)
+    keys = [f"key{i}" for i in range(n_keys)]
+    # zipf-ish skew: key i drawn with probability ~ 1/(i+1)
+    weights = [1.0 / (i + 1) for i in range(n_keys)]
+    stream = rng.choices(keys, weights=weights, k=n_items)
+    out = []
+    for k in stream:
+        w = rng.randint(1, 5) if weighted else 1
+        out.append((k, w))
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_sketch_error_bound_vs_exact_counter(seed, weighted):
+    """Space-saving guarantees: every entry's estimate is >= its true
+    count and overshoots by at most its recorded err (<= total/k), and
+    every key with true weight > total/k is tracked."""
+    k = 16
+    sk = HotKeySketch("t_hotkeys", "d", k=k)
+    exact = Counter()
+    total = 0
+    # injective key ids (a colliding hash would break the exactness
+    # oracle, not the sketch)
+    for key, w in _zipf_stream(3000, 200, seed + 10, weighted):
+        kid = (int(key[3:]), 1)
+        sk.update([(kid, w, 0, key)])
+        exact[kid] += w
+        total += w
+    snap = sk.snapshot()
+    assert snap["k"] == k
+    assert snap["total_hits"] == total
+    assert len(snap["entries"]) <= k
+    bound = total // k
+    assert snap["max_error"] == bound
+    tracked = {tuple(e["key_hash"]): e for e in snap["entries"]}
+    for kh, e in tracked.items():
+        true = exact[kh]
+        assert e["hits"] >= true, (kh, e, true)
+        assert e["err"] <= bound
+        assert e["hits"] - true <= e["err"], (kh, e, true)
+    # heavy hitters (> total/k true weight) are guaranteed present
+    for kh, true in exact.items():
+        if true > bound:
+            assert kh in tracked, (kh, true, bound)
+
+
+def test_sketch_top_k_recovery_under_skew():
+    """Under strong skew the sketch's hottest entries are exactly the
+    true hottest keys, in order."""
+    sk = HotKeySketch("t_hot2", "d", k=8)
+    # 4 heavy keys dominating a long uniform tail
+    heavy = {(i, 1): 1000 * (4 - i) for i in range(4)}
+    rows = [(kh, w, 0, f"heavy{kh[0]}") for kh, w in heavy.items()]
+    rng = random.Random(7)
+    tail = [((100 + rng.randrange(500), 1), 1, 0, None) for _ in range(400)]
+    mixed = rows + tail
+    rng.shuffle(mixed)
+    for r in mixed:
+        sk.update([r])
+    top4 = [tuple(e["key_hash"]) for e in sk.snapshot()["entries"][:4]]
+    assert top4 == [(0, 1), (1, 1), (2, 1), (3, 1)]
+    # display names fed through update() survive
+    names = [e["key"] for e in sk.snapshot()["entries"][:4]]
+    assert names == ["heavy0", "heavy1", "heavy2", "heavy3"]
+
+
+def test_sketch_disabled_at_k_zero():
+    sk = HotKeySketch("t_hot3", "d", k=0)
+    sk.update([((1, 1), 5, 0, "x")])
+    assert sk.snapshot()["entries"] == []
+    sk.configure(4)
+    sk.update([((1, 1), 5, 1, "x")])
+    snap = sk.snapshot()
+    assert snap["entries"][0]["hits"] == 5
+    assert snap["entries"][0]["over_limit"] == 1
+    sk.configure(0)  # disable clears state
+    assert sk.snapshot()["entries"] == []
+
+
+def test_sketch_render_lines_bounded_gauge():
+    sk = HotKeySketch("t_hot4", "d", k=4)
+    for i in range(32):
+        sk.update([((i, 0), i + 1, 0, f"k{i}")])
+    lines = sk.render_lines()
+    assert lines[1] == "# TYPE t_hot4 gauge"
+    series = [ln for ln in lines if ln.startswith("t_hot4{")]
+    assert len(series) == 4  # cardinality bounded by k
+    assert sk.sample_names() == ["t_hot4"]
+    assert sk.summary()["k"] == 4
+
+
+# ---- engine wiring: object path ---------------------------------------------
+
+
+def test_object_path_feeds_hotkeys_and_over_limit(engine):
+    # 30 hits on "hot", 1 on each of 5 cold keys; "blocked" goes over
+    reqs = [mk("hot") for _ in range(30)]
+    reqs += [mk(f"cold{i}") for i in range(5)]
+    reqs += [mk("blocked", limit=1) for _ in range(4)]
+    for r in engine.check_batch(reqs):
+        assert not r.error
+    snap = engine.hotkeys_snapshot()
+    assert snap["k"] == 16
+    by_key = {e["key"]: e for e in snap["entries"]}
+    assert by_key["t_hot"]["hits"] == 30
+    # limit=1 with burst: first hit under, rest over
+    assert by_key["t_blocked"]["over_limit"] >= 2
+    assert by_key["t_blocked"]["hits"] == 4
+    # /metrics exposure rides the engine histogram registration
+    lines = engine.metrics.hotkeys.render_lines()
+    assert any("t_hot" in ln for ln in lines)
+
+
+def test_columnar_path_feeds_hotkeys(engine):
+    from gubernator_tpu import wire
+
+    if not wire.available():
+        pytest.skip("native wire parser unavailable")
+    from gubernator_tpu.service import pb
+
+    msg = pb.pb.GetRateLimitsReq()
+    for i in range(12):
+        msg.requests.append(
+            pb.req_to_pb(
+                mk("colhot" if i < 9 else f"colcold{i}", hits=2)
+            )
+        )
+    cols = wire.parse_requests(msg.SerializeToString())
+    assert cols is not None
+    out = engine.check_columns(cols, now=NOW)
+    assert out is not None
+    snap = engine.hotkeys_snapshot()
+    ent = max(snap["entries"], key=lambda e: e["hits"])
+    assert ent["hits"] == 18  # 9 requests x 2 hits
+    # columnar path never decoded strings, but the engine's key-string
+    # dictionary resolves the display name at snapshot time
+    assert ent["key"] in ("t_colhot", f"hash:{ent['key_hash'][0]:x}:"
+                          f"{ent['key_hash'][1]:x}")
+
+
+# ---- stage latency + response metadata --------------------------------------
+
+
+def test_stage_histograms_populated(engine):
+    for r in engine.check_batch([mk(f"s{i}") for i in range(8)]):
+        assert not r.error
+    sums = {
+        labels[0]: s
+        for labels, s in engine.metrics.stage_duration.label_summaries().items()
+    }
+    for stage in ("intake", "assemble", "dispatch", "device_sync",
+                  "resolve"):
+        assert sums.get(stage, {"count": 0})["count"] >= 1, stage
+
+
+def test_stage_metadata_off_by_default(engine):
+    resp = engine.check_batch([mk("nomd")])[0]
+    assert "stage_breakdown_us" not in resp.metadata
+
+
+def test_stage_metadata_breakdown():
+    eng = DeviceEngine(
+        EngineConfig(
+            num_groups=1 << 10, batch_size=64, batch_wait_s=0.0005,
+            stage_metadata=True,
+        ),
+        now_fn=lambda: NOW,
+    )
+    try:
+        resp = eng.check_batch([mk("md1"), mk("md2")])[0]
+        assert not resp.error
+        md = resp.metadata["stage_breakdown_us"]
+        parts = dict(p.split("=") for p in md.split(","))
+        assert {"queue", "assemble", "dispatch", "inflight_wait",
+                "device_sync"} <= set(parts)
+        for v in parts.values():
+            assert int(v) >= 0
+        # single-request path gets the same breakdown
+        resp2 = eng.check_async(mk("md3")).result(timeout=10)
+        assert "queue=" in resp2.metadata["stage_breakdown_us"]
+    finally:
+        eng.close()
+
+
+# ---- flight recorder join keys ----------------------------------------------
+
+
+def test_flight_recorder_carries_ticket_and_trace_id(engine):
+    engine.check_batch([mk("fr1"), mk("fr2")])
+    recs = [
+        r for r in engine.metrics.recorder.snapshot()
+        if r.get("path") == "object"
+    ]
+    assert recs
+    last = recs[-1]
+    assert last["ticket"] >= 1
+    assert last["trace_id"] == ""  # no SDK recording -> empty join key
+    # ticket seqs increase monotonically across flushes
+    engine.check_batch([mk("fr3")])
+    recs2 = [
+        r for r in engine.metrics.recorder.snapshot()
+        if r.get("path") == "object"
+    ]
+    assert recs2[-1]["ticket"] > last["ticket"]
+
+
+def test_debug_snapshot_includes_hotkeys_summary(engine):
+    engine.check_batch([mk("dsnap")])
+    snap = engine.debug_snapshot()
+    assert snap["histograms"]["gubernator_hotkey_hits"]["k"] == 16

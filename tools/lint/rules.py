@@ -627,6 +627,65 @@ class GL006Swallow(Rule):
 
 
 # ---------------------------------------------------------------------------
+# GL007 — span calls must be consciously leveled.
+
+_SPAN_SCOPES = (
+    "gubernator_tpu/runtime/",
+    "gubernator_tpu/parallel/",
+    "gubernator_tpu/service/",
+)
+
+
+class GL007SpanLevel(Rule):
+    code = "GL007"
+    name = "span-level"
+    description = (
+        "tracing.span()/start_span() calls in runtime//parallel//"
+        "service/ must pass an explicit level= — serving-path spans are "
+        "consciously leveled (ERROR/INFO/DEBUG), never default-INFO by "
+        "omission (the reference levels every span at creation, "
+        "config.go:736-752)"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if not scan_path(mod.relpath).startswith(_SPAN_SCOPES):
+            return []
+        out = []
+        for node, stack in walk_scoped(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_span = (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("span", "start_span")
+            ) or (
+                isinstance(f, ast.Name) and f.id in ("span", "start_span")
+            )
+            if not is_span:
+                continue
+            if any(kw.arg == "level" for kw in node.keywords):
+                continue
+            # Positional level (span(name, "DEBUG")) also counts.
+            if len(node.args) >= 2 and isinstance(
+                node.args[1], ast.Constant
+            ):
+                continue
+            fn = func_name(stack)
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"span call without explicit level= in '{fn}' "
+                    f"({unparse(node)[:60]}) — pass "
+                    f"level=\"ERROR|INFO|DEBUG\" so the serving path's "
+                    f"span verbosity is a conscious choice",
+                    f"span-level:{fn}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # --fix-docs support (GL003 auto-stub).
 
 
