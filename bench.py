@@ -27,11 +27,14 @@ import time
 import numpy as np
 
 
-def _engine_telemetry(eng) -> dict:
+def _engine_telemetry(eng, daemon_metrics=None) -> dict:
     """Distribution-shape summary for the ledger row: flush-latency
     p50/p99 and the wave-count histogram, pulled from the engine's
     device-tier telemetry (gubernator_tpu.metrics.Log2Histogram). Means
-    hide bimodality — results.jsonl keeps the shape too."""
+    hide bimodality — results.jsonl keeps the shape too. Pass the
+    daemon's Metrics registry to also carry the GLOBAL propagation-lag
+    p50/p99 (docs/monitoring.md "Consistency") so ledger rows track
+    the consistency window alongside throughput."""
     em = eng.metrics
     fd = em.flush_duration.summary()
     wv = em.flush_waves.summary()
@@ -39,7 +42,7 @@ def _engine_telemetry(eng) -> dict:
     qw = em.queue_wait.summary()
     ov = em.pipeline_overlap.summary()
     fl = em.pipeline_inflight.summary()
-    return {
+    out = {
         "flush_us": {
             "p50": round(fd["p50"] * 1e6, 1),
             "p99": round(fd["p99"] * 1e6, 1),
@@ -71,6 +74,14 @@ def _engine_telemetry(eng) -> dict:
         },
         "cold_compiles": em.cold_compiles,
     }
+    if daemon_metrics is not None:
+        pl = daemon_metrics.global_propagation_lag.summary()
+        out["propagation_ms"] = {
+            "p50": round(pl["p50"] * 1e3, 2),
+            "p99": round(pl["p99"] * 1e3, 2),
+            "count": pl["count"],
+        }
+    return out
 
 
 def bench_engine(pipeline_depth: int = None) -> dict:
@@ -210,7 +221,9 @@ def bench_server() -> dict:
                 dt = time.perf_counter() - t0
                 p50 = float(np.percentile(np.array(lat) * 1000, 50))
                 p99 = float(np.percentile(np.array(lat) * 1000, 99))
-                return total / dt, p50, p99, _engine_telemetry(d.engine)
+                return total / dt, p50, p99, _engine_telemetry(
+                    d.engine, d.svc.metrics
+                )
         finally:
             await d.close()
 

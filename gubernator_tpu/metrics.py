@@ -828,6 +828,65 @@ class Metrics:
         )
         self.register_renderable(self.global_send_keys)
 
+        # Consistency observatory (docs/monitoring.md "Consistency"; no
+        # reference analog — the reference takes GLOBAL reconvergence on
+        # faith, global.go has no propagation telemetry at all).
+        self.global_propagation_lag = Log2Histogram(
+            "gubernator_global_propagation_lag",
+            "End-to-end GLOBAL propagation lag in seconds: origin stamp "
+            "at the hit's enqueue (one sampled probe per flush) to the "
+            "replica applying the owner's broadcast. Cross-node wall "
+            "clocks; read alongside gubernator_peer_clock_skew_ms.",
+            scale=1e-3, n_buckets=24,
+        )
+        self.register_renderable(self.global_propagation_lag)
+        self.global_sync_leg_duration = Log2Histogram(
+            "gubernator_global_sync_leg_duration",
+            "Per-leg GLOBAL sync timings in seconds: hit_queue_wait "
+            "(enqueue to hit-update flush), owner_apply (owner engine "
+            "apply of a relayed batch), broadcast_fanout (owner enqueue "
+            "to broadcast push done), replica_inject (replica applying "
+            "an UpdatePeerGlobals push).",
+            scale=1e-6, n_buckets=24, labelnames=("leg",),
+        )
+        self.register_renderable(self.global_sync_leg_duration)
+        self.global_requeue_age = Log2Histogram(
+            "gubernator_global_requeue_age",
+            "Redelivery attempts at each GLOBAL hit-update requeue — "
+            "pressure before GUBER_GLOBAL_REQUEUE_LIMIT drops begin.",
+            scale=1.0, n_buckets=8,
+        )
+        self.register_renderable(self.global_requeue_age)
+        self.consistency_divergence = counter(
+            "gubernator_consistency_divergence",
+            "Owner-vs-replica divergences found by the background "
+            "auditor, by kind: lag (replica missed the owner's last "
+            "broadcast past the grace window), "
+            "lost (owner key absent at the replica past the grace "
+            "window), conflict (transport current and stamps match but "
+            "remaining differs).",
+            ["kind"],
+        )
+        self.consistency_max_staleness = Gauge(
+            "gubernator_consistency_max_staleness_ms",
+            "Max owner-vs-replica staleness (ms) observed in the last "
+            "audit pass; falls back toward 0 after reconvergence.",
+            registry=r,
+        )
+        self.peer_clock_skew = Gauge(
+            "gubernator_peer_clock_skew_ms",
+            "Estimated wall-clock skew to each peer (remote now minus "
+            "local RPC midpoint, ms) — the honesty bound for the "
+            "stamp-based propagation-lag histogram.",
+            ["peer"],
+            registry=r,
+        )
+        self.ici_full_ticks = counter(
+            "gubernator_ici_full_ticks",
+            "Forced full-table ICI sync ticks (the fingerprint-collision "
+            "backstop, every GUBER_ICI_FULL_TICK_EVERY capped ticks).",
+        )
+
         self._syncs = []
 
     # -- registration --------------------------------------------------------
@@ -958,6 +1017,7 @@ def engine_sync(engine):
             m.global_overflow_keys.set(engine.overflow_keys)
             m.global_overflow_drops.set(engine.overflow_drops)
             m.global_sync_backlog.set(getattr(engine, "sync_backlog", 0))
+            m.ici_full_ticks.set(getattr(engine, "full_ticks", 0))
 
     return _sync
 

@@ -1,0 +1,204 @@
+"""Background divergence auditor (consistency observatory).
+
+Closes the loop GLOBAL replication currently takes on faith
+(docs/monitoring.md "Consistency"; no reference analog — the reference
+never verifies that UpdatePeerGlobals broadcasts actually converged):
+every `consistency_audit_interval_s`, sample keys this owner has
+broadcast (GlobalManager.broadcast_keys, key -> last broadcast wall ms),
+fetch ONE replica's view of them over PeersV1.DebugInfo (its
+broadcast-arrival map plus counter snapshots), and classify each pair
+at the TRANSPORT level first — raw counter state is only comparable
+when the replica stores the owner's stamp verbatim (token buckets);
+leaky injects re-stamp updated_at at arrival:
+
+- lag      — the replica last applied a broadcast OLDER than the
+             owner's last broadcast of the key, past the grace window:
+             a broadcast was dropped (e.g. a partition ate the fan-out
+             leg). Staleness = how far behind the replica's view is.
+- lost     — the replica has never seen the key at all past the grace
+             window: the broadcast never landed.
+- conflict — transport is current and stamps match, but `remaining`
+             differs: the replica advanced state the owner never saw
+             (e.g. hit-updates stranded by a partition).
+
+Cross-node wall clocks feed the lag comparison; the per-peer clock-skew
+gauge (below) is the honesty bound on those stamps.
+
+Findings feed gubernator_consistency_divergence{kind} counters and the
+gubernator_consistency_max_staleness_ms gauge, which is re-set every
+pass — after a partition heals it falls back toward 0, so the gauge IS
+the reconvergence signal. Peer clock skew is estimated as a side effect
+of the DebugInfo RPC itself (parallel/peers.py, RPC-midpoint method).
+
+Deliberately low-frequency and sampled: one RPC to one replica per
+pass, rotating through peers — observability, not anti-entropy repair.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import clock as _clock
+
+log = logging.getLogger("gubernator_tpu.auditor")
+
+
+class ConsistencyAuditor:
+    def __init__(self, svc, behaviors: BehaviorConfig):
+        self.svc = svc
+        self.b = behaviors
+        self.interval_s = float(
+            getattr(behaviors, "consistency_audit_interval_s", 60.0)
+        )
+        self.sample_keys = int(
+            getattr(behaviors, "consistency_audit_keys", 32)
+        )
+        # Grace before an absent replica key counts as "lost": a
+        # broadcast may legitimately still be in flight for up to a
+        # couple of sync intervals.
+        self.grace_ms = int(
+            max(2 * getattr(behaviors, "global_sync_wait_s", 0.1), 1.0) * 1e3
+        )
+        self._task: Optional[asyncio.Task] = None
+        self._pass_n = 0
+        self._rotate = 0
+        self._last_max_ms = 0
+        self._counts: Dict[str, int] = {"lag": 0, "lost": 0, "conflict": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._task is not None:
+            return
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def close(self) -> None:
+        if self._task is None:
+            return
+        self._task.cancel()
+        try:
+            await self._task
+        except (asyncio.CancelledError, Exception):  # guberlint: allow-swallow -- shutdown path; audit errors were already logged per-pass
+            pass
+        self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.audit_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # guberlint: allow-swallow -- auditor must outlive a flaky pass; counted nowhere because the peer leg already recorded the failure
+                log.warning("consistency audit pass failed: %s", e)
+
+    # -- one pass ------------------------------------------------------------
+
+    async def audit_once(self) -> dict:
+        """Run one audit pass; returns a summary dict (also kept as the
+        last-pass state served under /debug/cluster). Callable directly
+        from tests and soak jobs regardless of the interval loop."""
+        self._pass_n += 1
+        found: Dict[str, int] = {"lag": 0, "lost": 0, "conflict": 0}
+        max_ms = 0
+        gm = getattr(self.svc, "global_mgr", None)
+        picker = getattr(self.svc, "picker", None)
+        peers = []
+        if picker is not None:
+            peers = [p for p in picker.peers() if not p.info.is_owner]
+        keys = []
+        if gm is not None and getattr(gm, "broadcast_keys", None):
+            # Most recently broadcast keys first — the live working set.
+            keys = list(gm.broadcast_keys)[-self.sample_keys:]
+        if keys and peers:
+            peer = peers[self._rotate % len(peers)]
+            self._rotate += 1
+            if peer.breaker.allow():
+                owner_view = await self._owner_snapshots(keys)
+                # Breaker-/fault-wrapped like every transport leg; a
+                # failed fetch aborts the pass (raises to _loop).
+                info = await peer.debug_info(
+                    keys=keys,
+                    timeout=getattr(self.b, "global_timeout_s", 0.5),
+                )
+                replica_view = {
+                    str(s.get("key")): s for s in info.get("snapshots", [])
+                }
+                r_applied = {
+                    str(k): int(v)
+                    for k, v in (info.get("global_updates") or {}).items()
+                }
+                now_ms = _clock.now_ms()
+                for key in keys:
+                    s = owner_view.get(key)
+                    bcast_ms = gm.broadcast_keys.get(key)
+                    if s is None or bcast_ms is None:
+                        continue  # expired/evicted at the owner since
+                    kind, stale = self._classify(
+                        int(bcast_ms),
+                        s,
+                        replica_view.get(key),
+                        r_applied.get(key),
+                        now_ms,
+                    )
+                    if kind is None:
+                        continue
+                    found[kind] += 1
+                    max_ms = max(max_ms, stale)
+        m = self.svc.metrics
+        for kind, n in found.items():
+            if n:
+                m.consistency_divergence.labels(kind).inc(n)
+            self._counts[kind] += n
+        # Re-set every pass: falls back toward 0 after reconvergence.
+        m.consistency_max_staleness.set(max_ms)
+        self._last_max_ms = max_ms
+        return self.summary()
+
+    async def _owner_snapshots(self, keys) -> Dict[str, object]:
+        from gubernator_tpu.store.store import snapshots_from_engine
+
+        wanted = set(keys)
+        snaps = await asyncio.get_running_loop().run_in_executor(
+            None, snapshots_from_engine, self.svc.engine
+        )
+        return {s.key: s for s in snaps if s.key in wanted}
+
+    def _classify(self, bcast_ms, owner, replica, r_applied_ms, now_ms):
+        """(kind, staleness_ms) for one key, given the owner's last
+        broadcast time, its snapshot, the replica's snapshot, and the
+        replica's last broadcast-arrival stamp; (None, 0) when the pair
+        is consistent or still within grace."""
+        if r_applied_ms is not None and r_applied_ms >= bcast_ms:
+            # Transport current. Content is only comparable when the
+            # replica stored the owner's stamp verbatim (token buckets)
+            # — a leaky inject re-stamps updated_at at arrival, so its
+            # raw remaining legitimately drifts by the re-leak.
+            if (
+                replica is not None
+                and int(replica.get("stamp", 0)) == int(owner.stamp)
+                and int(replica.get("remaining", 0)) != int(owner.remaining)
+            ):
+                return "conflict", 0
+            return None, 0
+        if now_ms - bcast_ms <= self.grace_ms:
+            return None, 0  # the broadcast may still be in flight
+        if replica is None and r_applied_ms is None:
+            return "lost", max(0, now_ms - bcast_ms)
+        if r_applied_ms is not None:
+            return "lag", max(0, bcast_ms - r_applied_ms)
+        return "lag", max(0, now_ms - bcast_ms)
+
+    # -- introspection -------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Last-pass state for local_debug_info / /debug/cluster."""
+        return {
+            "max_staleness_ms": self._last_max_ms,
+            "divergence": dict(self._counts),
+            "audit_passes": self._pass_n,
+            "audit_interval_s": self.interval_s,
+        }

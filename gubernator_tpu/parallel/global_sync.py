@@ -24,6 +24,7 @@ Transport modes:
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import logging
 import time
@@ -38,7 +39,14 @@ from gubernator_tpu.api.types import (
     has_behavior,
 )
 from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
+
+# Wall-clock origin stamp carried on the wire (request metadata on the
+# hit-update leg, status metadata on the broadcast leg) so the replica
+# can close the end-to-end propagation-lag histogram. Back-compatible:
+# decoders that predate it see an ordinary metadata entry.
+ORIGIN_MD_KEY = "global_origin_ms"
 
 
 class BatchQueue:
@@ -131,6 +139,21 @@ class GlobalManager:
         self._requeue_max_keys = getattr(
             behaviors, "global_requeue_max_keys", 10_000
         )
+        # Consistency observatory: per-key monotonic enqueue stamps for
+        # the hit_queue_wait / broadcast_fanout legs. Side dicts, not
+        # request metadata — any metadata-bearing item demotes the
+        # owner's whole columnar batch off the fast path
+        # (service/fastpath.py), so queued items stay metadata-free and
+        # only ONE sampled probe per flush carries the wire stamp.
+        self._hit_enq: Dict[str, float] = {}
+        # Keys this owner has broadcast (key -> wall ms of last
+        # broadcast), bounded LRU. The divergence auditor samples from
+        # here: exactly the keys whose state SHOULD exist at replicas.
+        self.broadcast_keys: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
+        self._broadcast_keys_max = 8192
+        self._upd_enq: Dict[str, float] = {}
         m = svc.metrics
 
         def hits_error(take, e):
@@ -177,23 +200,32 @@ class GlobalManager:
         if r.hits == 0:
             return
         key = r.hash_key()
+        self._hit_enq.setdefault(key, time.perf_counter())
         existing = self._hits_q.items.get(key)
         if existing is not None:
             if has_behavior(r.behavior, Behavior.RESET_REMAINING):
                 existing.behavior |= Behavior.RESET_REMAINING
             existing.hits += r.hits
         else:
-            self._hits_q.items[key] = dataclasses.replace(
-                r, metadata=dict(r.metadata)
-            )
+            md = dict(r.metadata)
+            # Sampled wire probe: the first key of each flush window
+            # carries the wall-clock origin to the owner (and onward to
+            # every replica via the broadcast status metadata).
+            if not self._hits_q.items and ORIGIN_MD_KEY not in md:
+                md[ORIGIN_MD_KEY] = str(_clock.now_ms())
+            self._hits_q.items[key] = dataclasses.replace(r, metadata=md)
         self._hits_q.notify()
 
     def queue_update(self, r: RateLimitReq) -> None:
         if r.hits == 0:
             return
-        self._upd_q.items[r.hash_key()] = dataclasses.replace(
-            r, metadata=dict(r.metadata)
-        )
+        key = r.hash_key()
+        self._upd_enq.setdefault(key, time.perf_counter())
+        md = dict(r.metadata)
+        # Origin-if-absent: owner-direct hits start their propagation
+        # clock here; relayed hits keep the non-owner's earlier stamp.
+        md.setdefault(ORIGIN_MD_KEY, str(_clock.now_ms()))
+        self._upd_q.items[key] = dataclasses.replace(r, metadata=md)
         self._upd_q.notify()
 
     def queue_from_thread(self, legs) -> None:
@@ -239,6 +271,10 @@ class GlobalManager:
             else:
                 items[key] = r
             self._requeue_counts[key] = attempts
+            # Requeue-age pressure, visible BEFORE requeue_cap drops
+            # begin; the queue-wait clock restarts per residency.
+            m.global_requeue_age.observe(attempts)
+            self._hit_enq.setdefault(key, time.perf_counter())
             requeued += r.hits
         if requeued:
             m.global_requeued_hits.inc(requeued)
@@ -259,6 +295,13 @@ class GlobalManager:
     async def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
         t0 = time.perf_counter()
         self.svc.metrics.global_send_keys.observe(len(hits))
+        wait_leg = self.svc.metrics.global_sync_leg_duration.labels(
+            "hit_queue_wait"
+        )
+        for key in hits:
+            t_enq = self._hit_enq.pop(key, None)
+            if t_enq is not None:
+                wait_leg.observe(t0 - t_enq)
         failed = []  # (reqs, aged) legs to merge back into the queue
         dropped_no_peer = 0
         try:
@@ -333,6 +376,7 @@ class GlobalManager:
             await self._broadcast_traced(updates)
 
     async def _broadcast_traced(self, updates: Dict[str, RateLimitReq]) -> None:
+        enq_stamps = {k: self._upd_enq.pop(k, None) for k in updates}
         peers = [p for p in self.svc.picker.peers() if not p.info.is_owner]
         if not peers:
             # Single-pod deployment: nobody to broadcast to; skip the
@@ -363,16 +407,27 @@ class GlobalManager:
                 for upd in updates.values()
             ]
             statuses = await asyncio.gather(*futs)
-            globals_ = [
-                UpdatePeerGlobal(
-                    key=key,
-                    status=status,
-                    algorithm=upd.algorithm,
-                    duration=upd.duration,
-                    created_at=upd.created_at or 0,
+            globals_ = []
+            for (key, upd), status in zip(updates.items(), statuses):
+                origin = upd.metadata.get(ORIGIN_MD_KEY)
+                if origin is not None:
+                    # The origin rides to every replica on the status
+                    # metadata (RateLimitResp carries a map on the
+                    # UpdatePeerGlobals wire; UpdatePeerGlobal itself
+                    # does not) so update_peer_globals can close the
+                    # end-to-end propagation-lag histogram.
+                    md = dict(status.metadata or {})
+                    md[ORIGIN_MD_KEY] = origin
+                    status = dataclasses.replace(status, metadata=md)
+                globals_.append(
+                    UpdatePeerGlobal(
+                        key=key,
+                        status=status,
+                        algorithm=upd.algorithm,
+                        duration=upd.duration,
+                        created_at=upd.created_at or 0,
+                    )
                 )
-                for (key, upd), status in zip(updates.items(), statuses)
-            ]
 
             sem = asyncio.Semaphore(self.b.global_peer_requests_concurrency)
 
@@ -405,7 +460,25 @@ class GlobalManager:
                                 f"global broadcast to {peer.info.grpc_address}: {e}"
                             )
 
+            # Ledger stamp is captured BEFORE the fan-out: replicas stamp
+            # arrival mid-RPC, so a post-gather stamp would sit a few ms
+            # AFTER every arrival and the auditor would flag phantom lag
+            # (= the RPC duration) on perfectly delivered broadcasts.
+            now_ms = _clock.now_ms()
             await asyncio.gather(*(push(p) for p in peers))
+            t_done = time.perf_counter()
+            fan_leg = self.svc.metrics.global_sync_leg_duration.labels(
+                "broadcast_fanout"
+            )
+            for t_enq in enq_stamps.values():
+                if t_enq is not None:
+                    fan_leg.observe(t_done - t_enq)
+            bk = self.broadcast_keys
+            for key in updates:
+                bk[key] = now_ms
+                bk.move_to_end(key)
+            while len(bk) > self._broadcast_keys_max:
+                bk.popitem(last=False)
             self.svc.metrics.broadcast_counter.inc()
         finally:
             self.svc.metrics.broadcast_duration.observe(time.perf_counter() - t0)

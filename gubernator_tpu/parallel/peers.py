@@ -254,6 +254,46 @@ class Peer:
         )
         return pb.transfer_resp_from_bytes(raw)
 
+    async def debug_info(
+        self, keys: Optional[Sequence[str]] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """Fetch this peer's local debug blob (consistency observatory):
+        /debug/cluster fan-out and the divergence auditor's replica-view
+        fetch. Breaker- and fault-wrapped like every transport leg. Also
+        estimates this peer's wall-clock skew from the RPC midpoint
+        (remote now_ms minus our send/receive midpoint) — the honesty
+        bound for the stamp-based propagation-lag histogram."""
+        try:
+            if faults.active():
+                await faults.inject(self.info.grpc_address, faults.OP_PEER_DEBUG)
+            t0 = _clock.now_ms()
+            info = await self._rpc_debug_info(keys, timeout)
+            t1 = _clock.now_ms()
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        remote_now = info.get("now_ms")
+        if isinstance(remote_now, (int, float)):
+            skew_ms = float(remote_now) - (t0 + t1) / 2.0
+            m = self.metrics
+            if m is not None and hasattr(m, "peer_clock_skew"):
+                m.peer_clock_skew.labels(self.info.grpc_address).set(skew_ms)
+        return info
+
+    async def _rpc_debug_info(
+        self, keys: Optional[Sequence[str]], timeout: Optional[float]
+    ) -> dict:
+        stub = self._ensure_stub()
+        md: Dict[str, str] = {}
+        tracing.propagate_inject(md)
+        raw = await stub.debug_info(
+            pb.debug_req_to_bytes(keys=keys, metadata=md),
+            timeout=timeout or self.behaviors.global_timeout_s,
+        )
+        return pb.debug_resp_from_bytes(raw)
+
     # -- batch pump (reference peer_client.go:284-404) -----------------------
 
     async def _run_batch(self) -> None:

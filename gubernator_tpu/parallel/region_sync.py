@@ -46,7 +46,8 @@ from gubernator_tpu.api.types import (
     UpdatePeerGlobal,
     has_behavior,
 )
-from gubernator_tpu.parallel.global_sync import BatchQueue
+from gubernator_tpu.parallel.global_sync import ORIGIN_MD_KEY, BatchQueue
+from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.parallel.hash_ring import fnv1a_64
 from gubernator_tpu.service.config import BehaviorConfig
 
@@ -90,6 +91,11 @@ class RegionManager:
         # events are loop-affine — off-loop producers (the columnar
         # serving executor) must enter via observe_from_thread.
         self._loop = asyncio.get_running_loop()
+        # Consistency observatory: monotonic enqueue stamps for the DCN
+        # tier's queue-wait / fan-out legs (same side-dict design as
+        # GlobalManager — queued items stay metadata-free).
+        self._hit_enq: Dict[str, float] = {}
+        self._upd_enq: Dict[str, float] = {}
 
         def hits_error(take, e):
             log.exception("MULTI_REGION hit-delta flush failed")
@@ -185,6 +191,7 @@ class RegionManager:
         if self._is_noop(r):
             return
         key = r.hash_key()
+        self._hit_enq.setdefault(key, time.perf_counter())
         existing = self._hits_q.items.get(key)
         if existing is not None:
             if has_behavior(r.behavior, Behavior.RESET_REMAINING):
@@ -199,9 +206,14 @@ class RegionManager:
     def queue_update(self, r: RateLimitReq) -> None:
         if self._is_noop(r):
             return
-        self._upd_q.items[r.hash_key()] = dataclasses.replace(
-            r, metadata=dict(r.metadata)
-        )
+        key = r.hash_key()
+        self._upd_enq.setdefault(key, time.perf_counter())
+        md = dict(r.metadata)
+        # Origin-if-absent (GlobalManager.queue_update): the home-region
+        # broadcast carries the stamp so receiving regions feed the same
+        # propagation-lag histogram.
+        md.setdefault(ORIGIN_MD_KEY, str(_clock.now_ms()))
+        self._upd_q.items[key] = dataclasses.replace(r, metadata=md)
         self._upd_q.notify()
 
     # -- hit-delta leg (global.go:144-187 shape, DCN targets) ----------------
@@ -214,6 +226,13 @@ class RegionManager:
 
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
         t0 = time.perf_counter()
+        wait_leg = self.svc.metrics.global_sync_leg_duration.labels(
+            "hit_queue_wait"
+        )
+        for key in hits:
+            t_enq = self._hit_enq.pop(key, None)
+            if t_enq is not None:
+                wait_leg.observe(t0 - t_enq)
         try:
             by_peer: Dict[str, Tuple[object, List[RateLimitReq]]] = {}
             regions = self._all_regions()
@@ -276,6 +295,7 @@ class RegionManager:
     # -- broadcast leg (global.go:234-283 shape, one peer per region) --------
 
     async def _broadcast(self, updates: Dict[str, RateLimitReq]) -> None:
+        enq_stamps = {k: self._upd_enq.pop(k, None) for k in updates}
         other_regions = [
             r for r in self._all_regions() if r != self._local_region()
         ]
@@ -303,16 +323,22 @@ class RegionManager:
                 for upd in updates.values()
             ]
             statuses = await asyncio.gather(*futs)
-            globals_ = [
-                UpdatePeerGlobal(
-                    key=key,
-                    status=status,
-                    algorithm=upd.algorithm,
-                    duration=upd.duration,
-                    created_at=upd.created_at or 0,
+            globals_ = []
+            for (key, upd), status in zip(updates.items(), statuses):
+                origin = upd.metadata.get(ORIGIN_MD_KEY)
+                if origin is not None:
+                    md = dict(status.metadata or {})
+                    md[ORIGIN_MD_KEY] = origin
+                    status = dataclasses.replace(status, metadata=md)
+                globals_.append(
+                    UpdatePeerGlobal(
+                        key=key,
+                        status=status,
+                        algorithm=upd.algorithm,
+                        duration=upd.duration,
+                        created_at=upd.created_at or 0,
+                    )
                 )
-                for (key, upd), status in zip(updates.items(), statuses)
-            ]
 
             # Group by (region, target peer): the key's in-region owner
             # receives the authoritative state for its region.
@@ -349,6 +375,13 @@ class RegionManager:
                         self.svc.metrics.region_broadcast_errors.inc()
 
             await asyncio.gather(*(push(p, gs) for p, gs in by_peer.values()))
+            t_done = time.perf_counter()
+            fan_leg = self.svc.metrics.global_sync_leg_duration.labels(
+                "broadcast_fanout"
+            )
+            for t_enq in enq_stamps.values():
+                if t_enq is not None:
+                    fan_leg.observe(t_done - t_enq)
             self.svc.metrics.region_broadcast_counter.inc()
         finally:
             self.svc.metrics.region_broadcast_duration.observe(

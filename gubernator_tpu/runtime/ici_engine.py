@@ -95,6 +95,14 @@ class IciEngineConfig:
     # keeping the 100ms cadence at 10M+ key geometries. None = merge
     # the full table every tick.
     max_sync_groups: "int | None" = 65536
+    # Fingerprint-collision backstop (GUBER_ICI_FULL_TICK_EVERY): the
+    # capped tick selects groups by comparing two salted
+    # non-cryptographic fingerprints across replicas — a collision makes
+    # a diverged group look converged and strands it forever. Forcing a
+    # full-table tick every N capped ticks bounds that window to
+    # N * sync_wait_s. 0 = off; ignored when max_sync_groups is None
+    # (the uncapped tick already merges the full table).
+    full_tick_every: int = 64
     # Continuous-batching pipeline depth (GUBER_PIPELINE_DEPTH): max
     # flushes dispatched-but-unsynced at once; 1 = serial pump. Same
     # semantics as EngineConfig.pipeline_depth — both ici tiers'
@@ -146,6 +154,20 @@ class IciEngine(EngineBase):
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout,
             max_sync_groups=cfg.max_sync_groups,
         )
+        # Collision backstop: a second, unbounded sync program selected
+        # every `full_tick_every`-th tick. Only built when the regular
+        # tick is actually capped (an uncapped tick IS the full tick;
+        # a cap >= group count compiles to the uncapped program too).
+        self._sync_full = None
+        if (
+            cfg.max_sync_groups is not None
+            and cfg.max_sync_groups < self.num_rgroups
+            and cfg.full_tick_every > 0
+        ):
+            self._sync_full = ici.make_sync_step(
+                self.mesh, cfg.num_slots, cfg.replica_ways,
+                layout=cfg.layout, max_sync_groups=None,
+            )
         self._inject_replicas = ici.make_inject_replicas(
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
         )
@@ -159,6 +181,10 @@ class IciEngine(EngineBase):
         self.overflow_keys = 0
         self.overflow_drops = 0
         self.sync_backlog = 0
+        # Backstop bookkeeping (gubernator_ici_full_ticks): host-side
+        # capped-tick counter and a running total of forced full ticks.
+        self.full_ticks = 0
+        self._capped_ticks = 0
 
         self._warmup()
         self._init_base("ici-engine")
@@ -182,7 +208,17 @@ class IciEngine(EngineBase):
             with _telemetry.serving_scope(self.metrics), tracing.span(
                 "ici.sync_tick", level="DEBUG"
             ) as tick_span:
-                self.ici_state, diag = self._sync(self.ici_state, now)
+                sync = self._sync
+                if self._sync_full is not None:
+                    self._capped_ticks += 1
+                    if self._capped_ticks >= self.cfg.full_tick_every:
+                        # Collision backstop: merge the FULL table this
+                        # tick, healing any group a fingerprint collision
+                        # hid from the capped selector.
+                        self._capped_ticks = 0
+                        self.full_ticks += 1
+                        sync = self._sync_full
+                self.ici_state, diag = sync(self.ici_state, now)
                 d = np.asarray(diag)
             # kept/dropped cover groups merged THIS tick; under a capped
             # backlog, retained keys in unmerged groups surface when
@@ -491,6 +527,10 @@ class IciEngine(EngineBase):
         self.ici_state, out2 = self._replica(self.ici_state, wb, home, now)
         np.asarray(out2.status)
         self.ici_state, _diag = self._sync(self.ici_state, now)
+        if self._sync_full is not None:
+            # Warm the backstop program too — its first forced tick must
+            # not pay a cold compile on the 100ms cadence.
+            self.ici_state, _diag = self._sync_full(self.ici_state, now)
         jax.block_until_ready(self.ici_state.pending)
 
     def _sync_loop(self) -> None:

@@ -88,6 +88,18 @@ class BehaviorConfig:
     # GUBER_HANDOVER_CHUNK: keys per TransferSnapshots RPC leg.
     handover_chunk: int = 512
 
+    # -- consistency observatory (docs/monitoring.md "Consistency"; no
+    # reference analog: the reference takes GLOBAL reconvergence on
+    # faith) --------------------------------------------------------------
+
+    # GUBER_CONSISTENCY_AUDIT_INTERVAL: cadence of the background
+    # divergence auditor (samples owned GLOBAL keys, fetches one
+    # replica's view over PeersV1.DebugInfo, classifies lag/lost/
+    # conflict). 0 disables the auditor.
+    consistency_audit_interval_s: float = 60.0
+    # GUBER_CONSISTENCY_AUDIT_KEYS: max owned keys sampled per pass.
+    consistency_audit_keys: int = 32
+
 
 @dataclasses.dataclass
 class EtcdConfig:

@@ -234,6 +234,18 @@ class Daemon:
 
         wire_peers(self, global_mode=conf.global_mode)
 
+        # Background divergence auditor (consistency observatory,
+        # docs/monitoring.md "Consistency"): samples broadcast keys and
+        # verifies one replica's view per pass. Off when the audit
+        # interval is 0 or the daemon has no GLOBAL manager to audit.
+        self._auditor = None
+        if self.svc.global_mgr is not None:
+            from gubernator_tpu.parallel.auditor import ConsistencyAuditor
+
+            self._auditor = ConsistencyAuditor(self.svc, conf.behaviors)
+            self.svc.auditor = self._auditor
+            self._auditor.start()
+
         # Discovery pool pushes membership through set_peers
         # (reference daemon.go:208-243). Unknown/unavailable backends fail
         # fast rather than silently serving as a cluster of one.
@@ -350,6 +362,10 @@ class Daemon:
         self.state = "draining"
         if self.svc is not None:
             self.svc.draining = True
+        # Auditor first: an audit RPC racing the drain would read peers
+        # that are mid-handover and report phantom divergence.
+        if getattr(self, "_auditor", None) is not None:
+            await self._auditor.close()
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
         # preStop settle (the k8s preStop-sleep analog): calls already on

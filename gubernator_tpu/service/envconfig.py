@@ -115,6 +115,12 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         handover=_env_bool("GUBER_HANDOVER", True),
         handover_max_keys=_env_int("GUBER_HANDOVER_MAX_KEYS", 100_000),
         handover_chunk=_env_int("GUBER_HANDOVER_CHUNK", 512),
+        # Consistency observatory (docs/monitoring.md "Consistency"):
+        # divergence-auditor cadence and sample size; interval 0 disables.
+        consistency_audit_interval_s=parse_duration_s(
+            _env("GUBER_CONSISTENCY_AUDIT_INTERVAL"), 60.0
+        ),
+        consistency_audit_keys=_env_int("GUBER_CONSISTENCY_AUDIT_KEYS", 32),
     )
     if behaviors.owner_unreachable not in ("error", "local"):
         raise ValueError(
@@ -219,6 +225,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             max_sync_groups=(
                 _env_int("GUBER_ICI_SYNC_GROUPS", base.max_sync_groups or 0)
                 or None
+            ),
+            # Fingerprint-collision backstop for the capped tick: force
+            # one full-table tick every N capped ticks (0 = off).
+            full_tick_every=_env_int(
+                "GUBER_ICI_FULL_TICK_EVERY", base.full_tick_every
             ),
         )
 

@@ -79,6 +79,13 @@ def enabled(svc) -> bool:
         and getattr(svc, "fast_edge", False)
         and wire.available()
         and hasattr(svc.engine, "check_columns")
+        # GUBER_STAGE_METADATA promises per-response diagnostics
+        # (stage_breakdown_us, global_staleness_ms) that only the object
+        # path attaches — a diagnostics mode, so it trades the fast edge
+        # for the richer responses rather than silently dropping them.
+        and not getattr(
+            getattr(svc.engine, "cfg", None), "stage_metadata", False
+        )
     )
 
 

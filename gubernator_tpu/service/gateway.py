@@ -98,9 +98,47 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         # Host-side sketch snapshot — no device work, no engine lock.
         return web.json_response(svc.engine.hotkeys_snapshot())
 
+    async def debug_cluster(request: web.Request) -> web.Response:
+        """Cluster-wide debug view (docs/monitoring.md "Consistency"):
+        this node's local_debug_info plus a breaker-gated, shared-deadline
+        fan-out of PeersV1.DebugInfo to every live peer — the whole mesh's
+        health, breakers, occupancy, hot keys, and consistency gauges
+        from any single node. Skipped (circuit open) and failed peers
+        appear as {"error": ...} rows, never as a whole-call failure."""
+        loop = asyncio.get_running_loop()
+        local = await loop.run_in_executor(None, svc.local_debug_info)
+        out = {"local": local, "peers": {}}
+        peers = []
+        if svc.picker is not None:
+            peers = [p for p in svc.picker.peers() if not p.info.is_owner]
+        if peers:
+            budget_s = 2.0
+            if svc.forwarder is not None:
+                budget_s = float(
+                    getattr(svc.forwarder.behaviors, "forward_deadline_s", 2.0)
+                )
+            deadline = loop.time() + budget_s
+
+            async def fetch(peer):
+                addr = peer.info.grpc_address
+                if not peer.breaker.allow():
+                    return addr, {"error": "circuit open"}
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    return addr, {"error": "deadline exceeded"}
+                try:
+                    return addr, await peer.debug_info(timeout=remaining)
+                except Exception as e:  # guberlint: allow-swallow -- failure becomes this peer's {"error": ...} row; the peer leg already counted it
+                    return addr, {"error": str(e)}
+
+            for addr, blob in await asyncio.gather(*(fetch(p) for p in peers)):
+                out["peers"][addr] = blob
+        return web.json_response(out)
+
     app.router.add_get("/debug/engine", debug_engine)
     app.router.add_get("/debug/hotkeys", debug_hotkeys)
     app.router.add_get("/debug/profile", debug_profile)
+    app.router.add_get("/debug/cluster", debug_cluster)
 
 
 def add_probe_routes(app: web.Application, svc: V1Service) -> None:

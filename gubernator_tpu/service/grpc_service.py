@@ -176,3 +176,31 @@ class PeersV1Servicer:
                         snaps
                     )
             return pb.transfer_resp_to_bytes(accepted, stale)
+
+    async def DebugInfo(self, request_bytes, context):
+        """Consistency observatory: serve this node's debug blob — LOCAL
+        state only, so the /debug/cluster fan-out cannot recurse. With
+        `keys`, includes those keys' counter snapshots (the divergence
+        auditor's replica-view fetch)."""
+        from gubernator_tpu.utils import tracing
+
+        async with _instrumented(
+            self.svc.metrics, "/pb.gubernator.PeersV1/DebugInfo"
+        ):
+            try:
+                keys, md = pb.debug_req_from_bytes(request_bytes)
+            except (ValueError, TypeError):
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "malformed debug info request",
+                )
+            ctx = tracing.propagate_extract(md)
+            with tracing.attached(ctx):
+                with tracing.span(
+                    "PeersV1.DebugInfo", level="DEBUG", keys=len(keys)
+                ):
+                    # Engine readbacks + table snapshot off the loop.
+                    info = await asyncio.get_running_loop().run_in_executor(
+                        None, self.svc.local_debug_info, keys or None
+                    )
+            return pb.debug_resp_to_bytes(info)

@@ -63,6 +63,15 @@ def peers_handler(servicer) -> grpc.GenericRpcHandler:
                 request_deserializer=None,
                 response_serializer=None,
             ),
+            # Consistency observatory (docs/monitoring.md): one node's
+            # debug blob for /debug/cluster fan-out and the divergence
+            # auditor's replica-view fetch. BYTES mode, hand-encoded
+            # payload (pb.debug_req_to_bytes / pb.debug_resp_to_bytes).
+            "DebugInfo": grpc.unary_unary_rpc_method_handler(
+                servicer.DebugInfo,
+                request_deserializer=None,
+                response_serializer=None,
+            ),
         },
     )
 
@@ -100,6 +109,12 @@ class PeersV1Stub:
         # BYTES mode both ways (payload is pb.snapshots_to_bytes output).
         self.transfer_snapshots = channel.unary_unary(
             f"/{PEERS_SERVICE}/TransferSnapshots",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        # BYTES mode both ways (payload is pb.debug_req_to_bytes output).
+        self.debug_info = channel.unary_unary(
+            f"/{PEERS_SERVICE}/DebugInfo",
             request_serializer=None,
             response_deserializer=None,
         )

@@ -11,7 +11,9 @@ reconciled asynchronously.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 from gubernator_tpu.api.types import (
@@ -25,9 +27,15 @@ from gubernator_tpu.api.types import (
     has_behavior,
 )
 from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.parallel.global_sync import ORIGIN_MD_KEY
 from gubernator_tpu.runtime.engine import DeviceEngine
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
+
+# Bound on the replica-staleness map (key -> last owner-broadcast wall ms).
+# LRU eviction: staleness metadata is best-effort observability, so the
+# oldest-touched keys fall out first rather than growing without bound.
+_STALENESS_MAP_MAX = 8192
 
 
 class ApiError(Exception):
@@ -64,6 +72,12 @@ class V1Service:
         # pod early; the node keeps serving while it drains.
         self.draining = False
         self._peers_lock = asyncio.Lock()
+        # Consistency observatory seams (docs/monitoring.md "Consistency"):
+        # last owner-broadcast arrival per GLOBAL key (feeds the
+        # global_staleness_ms response metadata under GUBER_STAGE_METADATA)
+        # and the background divergence auditor, wired by the daemon.
+        self._global_last_update: "OrderedDict[str, int]" = OrderedDict()
+        self.auditor = None  # ConsistencyAuditor; None when not wired
         # pre-resolved metric children (labels() lookups are hot-loop cost)
         m = self.metrics
         self._m_local = m.getratelimit_counter.labels("local")
@@ -166,6 +180,7 @@ class V1Service:
             local_fut = self.engine.check_bulk([r for _, r in local_items])
 
         if global_fut is not None:
+            stage_md = bool(getattr(self.engine.cfg, "stage_metadata", False))
             try:
                 results = await asyncio.wrap_future(global_fut)
                 for (i, req, owner), resp in zip(global_items, results):
@@ -174,6 +189,16 @@ class V1Service:
                     # Merge, don't replace: the engine may have attached
                     # stage_breakdown_us (GUBER_STAGE_METADATA) already.
                     resp.metadata["owner"] = owner.grpc_address
+                    if stage_md:
+                        # Replica-staleness bound: age of the last owner
+                        # broadcast applied locally for this key. Absent
+                        # until the first broadcast lands (a fresh replica
+                        # has no bound to honestly report).
+                        ts = self._global_last_update.get(req.hash_key())
+                        if ts is not None:
+                            resp.metadata["global_staleness_ms"] = str(
+                                max(0, now - ts)
+                            )
                     responses[i] = resp
             except Exception as e:
                 for i, _, _ in global_items:
@@ -235,6 +260,7 @@ class V1Service:
             )
         from gubernator_tpu.utils import tracing
 
+        has_global = False
         for req in reqs:
             # Extract the forwarding peer's trace context from the item's
             # metadata (reference gubernator.go:503-504).
@@ -253,12 +279,20 @@ class V1Service:
                 # Owner handling a relayed GLOBAL hit always drains
                 # (reference gubernator.go:510-512) and queues a broadcast.
                 req.behavior |= Behavior.DRAIN_OVER_LIMIT
+                has_global = True
             if req.created_at is None or req.created_at == 0:
                 req.created_at = self.now_fn()
+        t_apply = time.perf_counter()
         try:
             results = await asyncio.wrap_future(self.engine.check_bulk(list(reqs)))
         except Exception as e:
             return [RateLimitResp(error=str(e)) for _ in reqs]
+        if has_global:
+            # owner_apply leg: relayed-hit batch arrival to engine apply
+            # done — the owner's contribution to propagation lag.
+            self.metrics.global_sync_leg_duration.labels("owner_apply").observe(
+                time.perf_counter() - t_apply
+            )
         for req, resp in zip(reqs, results):
             if resp.error:
                 continue
@@ -278,8 +312,40 @@ class V1Service:
     # ---- PeersV1.UpdatePeerGlobals (reference gubernator.go:425-459) -------
 
     async def update_peer_globals(self, globals_: Sequence[UpdatePeerGlobal]) -> None:
+        m = self.metrics
+        now_ms = self.now_fn()
+        trace_id = tracing.trace_id_of(tracing.current_span())
+        for g in globals_:
+            md = getattr(g.status, "metadata", None)
+            origin = md.pop(ORIGIN_MD_KEY, None) if md else None
+            if origin is not None:
+                # Close the end-to-end loop: origin stamp (sampled at the
+                # hit's first enqueue) to this replica applying the owner's
+                # broadcast. Cross-node wall clocks — read alongside
+                # gubernator_peer_clock_skew_ms; clamp at 0 so a skewed
+                # clock can't underflow the histogram.
+                try:
+                    lag_s = max(0.0, (now_ms - int(origin)) / 1000.0)
+                except ValueError:
+                    pass
+                else:
+                    m.global_propagation_lag.observe(lag_s, trace_id=trace_id)
+            self._note_global_update(g.key, now_ms)
         loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
         await loop.run_in_executor(None, self.engine.inject_globals, globals_)
+        m.global_sync_leg_duration.labels("replica_inject").observe(
+            time.perf_counter() - t0
+        )
+
+    def _note_global_update(self, key: str, now_ms: int) -> None:
+        """Record an owner-broadcast arrival for the staleness map (LRU,
+        bounded at _STALENESS_MAP_MAX; event-loop only, no lock needed)."""
+        mp = self._global_last_update
+        mp[key] = now_ms
+        mp.move_to_end(key)
+        while len(mp) > _STALENESS_MAP_MAX:
+            mp.popitem(last=False)
 
     # ---- PeersV1.TransferSnapshots (ownership handover) --------------------
 
@@ -372,6 +438,56 @@ class V1Service:
             "peers": len(summary),
             "open_circuits": open_circuits,
         }
+
+    # ---- consistency observatory (docs/monitoring.md "Consistency") --------
+
+    def local_debug_info(self, keys: Optional[Sequence[str]] = None) -> dict:
+        """One node's slice of the cluster debug view: health, breaker
+        states, occupancy, hot keys, and consistency gauges in a single
+        JSON-able blob. Served locally under /debug/cluster (gateway) and
+        remotely over PeersV1.DebugInfo — always LOCAL state only, so the
+        fan-out cannot recurse. With `keys`, also returns those keys'
+        counter snapshots (the divergence auditor's replica-view fetch).
+        Runs engine readbacks; call from an executor on hot paths."""
+        m = self.metrics
+        info: dict = {
+            "v": 1,
+            "now_ms": self.now_fn(),
+            "address": self.local_info.grpc_address,
+            "readiness": self.readiness(),
+        }
+        if self.forwarder is not None and hasattr(self.forwarder, "breaker_summary"):
+            info["breakers"] = self.forwarder.breaker_summary()
+        if hasattr(self.engine, "occupancy_stats"):
+            info["occupancy"] = self.engine.occupancy_stats()
+        if hasattr(self.engine, "hotkeys_snapshot"):
+            info["hotkeys"] = self.engine.hotkeys_snapshot()
+        consistency: dict = {
+            "propagation_lag": m.global_propagation_lag.summary(),
+            "staleness_keys_tracked": len(self._global_last_update),
+        }
+        if self.auditor is not None:
+            consistency.update(self.auditor.summary())
+        info["consistency"] = consistency
+        if keys:
+            from gubernator_tpu.store.store import snapshots_from_engine
+
+            wanted = set(keys)
+            info["snapshots"] = [
+                dataclasses.asdict(s)
+                for s in snapshots_from_engine(self.engine)
+                if s.key in wanted
+            ]
+            # Per-key broadcast-arrival stamps: the transport-level
+            # replica view the auditor compares against the owner's
+            # broadcast ledger (algorithm-agnostic, unlike raw counter
+            # state — leaky injects re-stamp updated_at on arrival).
+            info["global_updates"] = {
+                k: self._global_last_update[k]
+                for k in keys
+                if k in self._global_last_update
+            }
+        return info
 
     # ---- peer membership (reference gubernator.go:616-711) -----------------
 

@@ -1,0 +1,36 @@
+"""GL008 violation fixture: /debug/* routes registered outside
+add_debug_routes() — they serve on one listener and 404 on the other."""
+
+
+async def _handler(request):
+    return None
+
+
+def build_app(app):
+    # fires: a debug route wired directly into ONE app builder
+    app.router.add_get("/debug/engine2", _handler)
+    # fires: method-form registration is a debug route all the same
+    app.router.add_route("GET", "/debug/raw", _handler)
+    # ok: non-debug routes may register anywhere
+    app.router.add_get("/metrics2", _handler)
+    return app
+
+
+def build_status_app(app):
+    # fires: duplicating the route per-listener is exactly the drift
+    # add_debug_routes exists to prevent
+    app.router.add_post("/debug/trigger", _handler)
+    return app
+
+
+def add_debug_routes(app):
+    # ok: the single registrar both listeners call
+    app.router.add_get("/debug/engine", _handler)
+    app.router.add_route("GET", "/debug/cluster", _handler)
+
+    def nested(sub):
+        # ok: still lexically inside add_debug_routes
+        sub.router.add_get("/debug/nested", _handler)
+
+    nested(app)
+    return app

@@ -266,6 +266,141 @@ def test_slow_peer_brownout_within_deadline(fi_cluster, loop_thread):
     assert metric_value(hitter, "gubernator_forward_deadline_exceeded") == 0
 
 
+def test_partition_divergence_audited_then_reconverges(fi_cluster, loop_thread):
+    """Consistency observatory under partition (ISSUE PR 9): a replica
+    that missed a broadcast is REPORTED — the divergence auditor finds
+    `lag` with positive staleness after the heal — and reconvergence is
+    visible as the max-staleness gauge falling back to 0 and the
+    propagation-lag histogram resuming at the healed replica.
+
+    Leaky bucket on purpose: its inject re-stamps updated_at at the
+    replica and re-leaks remaining, so raw counter state NEVER matches
+    the owner's byte-for-byte — only the transport-level classification
+    (owner broadcast ledger vs replica arrival map) stays quiet on a
+    healthy cluster while still catching the dropped fan-out leg."""
+    from gubernator_tpu.api.types import Algorithm, MINUTE
+    from gubernator_tpu.service import pb
+
+    name, key = "chaos_audit", "ca1"
+
+    def leaky_hit(daemon, hits):
+        async def call():
+            msg = pb.pb.GetRateLimitsReq()
+            msg.requests.append(
+                pb.pb.RateLimitReq(
+                    name=name,
+                    unique_key=key,
+                    algorithm=Algorithm.LEAKY_BUCKET,
+                    behavior=int(Behavior.GLOBAL),
+                    duration=3 * MINUTE,
+                    limit=LIMIT,
+                    hits=hits,
+                )
+            )
+            resp = await daemon.client().get_rate_limits(msg, timeout=10)
+            return resp.responses[0]
+
+        return loop_thread.run(call())
+
+    owner = fi_cluster.find_owning_daemon(name, key)
+    non_owners = fi_cluster.list_non_owning_daemons(name, key)
+    hitter, victim = non_owners[0], non_owners[1]
+
+    def audit_victim():
+        """One audit pass pinned to the victim (the auditor normally
+        rotates through peers)."""
+        auditor = owner.svc.auditor
+        peers = [
+            p for p in owner.svc.picker.peers() if not p.info.is_owner
+        ]
+        idx = next(
+            i
+            for i, p in enumerate(peers)
+            if p.info.grpc_address == victim.grpc_address
+        )
+        auditor._rotate = idx
+        return loop_thread.run(auditor.audit_once())
+
+    # Converge first: the victim holds a replica of the key.
+    r = leaky_hit(hitter, 5)
+    assert r.error == ""
+    assert wait_until(
+        lambda: metric_value(
+            victim, "gubernator_global_propagation_lag_count"
+        )
+        >= 1,
+        timeout=5,
+    ), "broadcast never reached the victim pre-partition"
+    lag_count_before = metric_value(
+        victim, "gubernator_global_propagation_lag_count"
+    )
+    # A converged cluster audits clean.
+    s0 = audit_victim()
+    assert s0["max_staleness_ms"] == 0
+
+    # Cut the victim off: broadcasts TOWARD it fail, everything else
+    # flows.
+    faults.INJECTOR.partition(victim.grpc_address)
+    r = leaky_hit(hitter, 3)
+    assert r.error == ""
+    assert wait_until(
+        lambda: metric_value(
+            owner, "gubernator_global_broadcast_errors"
+        )
+        >= 1,
+        timeout=5,
+    ), "dead broadcast leg was not counted"
+    assert (
+        metric_value(victim, "gubernator_global_propagation_lag_count")
+        == lag_count_before
+    ), "victim observed a broadcast through the partition"
+
+    # Heal the transport. The victim's copy is still stale — nothing
+    # re-broadcasts a quiet key — and the auditor must SAY so, once the
+    # in-flight grace window (2 sync intervals, >= 1s) has passed.
+    faults.INJECTOR.clear()
+    time.sleep(owner.svc.auditor.grace_ms / 1e3 + 0.2)
+    s1 = audit_victim()
+    assert s1["divergence"]["lag"] >= 1, s1
+    assert s1["max_staleness_ms"] > 0, s1
+    assert (
+        metric_value(
+            owner, 'gubernator_consistency_divergence{kind="lag"}'
+        )
+        >= 1
+    )
+    assert (
+        metric_value(owner, "gubernator_consistency_max_staleness_ms")
+        > 0
+    )
+
+    # New traffic re-broadcasts the key; the healed victim applies it
+    # (propagation histogram resumes) and the audit reports
+    # reconvergence: max staleness falls back to 0. No verification
+    # reads here — a leaky 0-hit decide advances updated_at at whichever
+    # node serves it, which would itself read as divergence.
+    r = leaky_hit(hitter, 1)
+    assert r.error == ""
+    assert wait_until(
+        lambda: metric_value(
+            victim, "gubernator_global_propagation_lag_count"
+        )
+        > lag_count_before,
+        timeout=10,
+    ), "healed victim never applied a fresh broadcast"
+
+    def audits_clean():
+        return audit_victim()["max_staleness_ms"] == 0
+
+    assert wait_until(audits_clean, timeout=10, interval=0.2), (
+        "auditor kept reporting staleness after reconvergence"
+    )
+    assert (
+        metric_value(owner, "gubernator_consistency_max_staleness_ms")
+        == 0
+    )
+
+
 @pytest.mark.slow
 def test_flapping_peer_soak(loop_thread):
     """Soak: a peer flapping through several partition/heal cycles.

@@ -65,7 +65,7 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-        "GL007",
+        "GL007", "GL008",
     }
 
 
@@ -123,6 +123,12 @@ _CASES = [
         {"unlabeled_attr_call", "unlabeled_bare_call",
          "unlabeled_start_span"},
         3,  # leveled kwarg/positional + pragma'd sites don't fire
+    ),
+    (
+        "GL008",
+        fixture("service", "gl008_debug_routes.py"),
+        {"/debug/engine2", "/debug/raw", "/debug/trigger"},
+        3,  # routes inside add_debug_routes (nested included) don't fire
     ),
 ]
 

@@ -686,6 +686,66 @@ class GL007SpanLevel(Rule):
 
 
 # ---------------------------------------------------------------------------
+# GL008 — /debug/* routes register through add_debug_routes only.
+
+_DEBUG_ROUTE_SCOPES = ("gubernator_tpu/service/",)
+_ROUTE_ADDERS = ("add_get", "add_post", "add_put", "add_delete", "add_route")
+
+
+class GL008DebugRouteParity(Rule):
+    code = "GL008"
+    name = "debug-route-parity"
+    description = (
+        "/debug/* HTTP routes in service/ must be registered inside "
+        "add_debug_routes() — it is the single registrar both the main "
+        "gateway and the status listener call, so a route added "
+        "anywhere else silently serves on one listener and 404s on the "
+        "other (docs/monitoring.md \"Debug endpoints\")"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if not scan_path(mod.relpath).startswith(_DEBUG_ROUTE_SCOPES):
+            return []
+        out = []
+        for node, stack in walk_scoped(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute) and f.attr in _ROUTE_ADDERS
+            ):
+                continue
+            args = node.args
+            path_arg = None
+            # add_route(method, path, ...) carries the path second.
+            idx = 1 if f.attr == "add_route" else 0
+            if len(args) > idx and isinstance(args[idx], ast.Constant):
+                path_arg = args[idx].value
+            if not (
+                isinstance(path_arg, str) and path_arg.startswith("/debug/")
+            ):
+                continue
+            if any(
+                isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and s.name == "add_debug_routes"
+                for s in stack
+            ):
+                continue
+            fn = func_name(stack)
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"debug route '{path_arg}' registered in '{fn}' "
+                    f"instead of add_debug_routes() — it will be "
+                    f"missing from the other listener",
+                    f"debug-route:{path_arg}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # --fix-docs support (GL003 auto-stub).
 
 
