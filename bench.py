@@ -74,6 +74,29 @@ def _engine_telemetry(eng, daemon_metrics=None) -> dict:
         },
         "cold_compiles": em.cold_compiles,
     }
+    if hasattr(eng, "table_census"):
+        # Table-observatory summary (docs/monitoring.md "Table census"):
+        # how resident/cold/wasted the table ended up under this load
+        # shape, and how fast slots churned — the capacity numbers the
+        # paged-table design reads off BENCH rows.
+        c = eng.table_census(max_age_s=0)
+        churn = c.get("churn") or {}
+        cold4 = next(
+            (e for e in c["cold"] if e["multiplier"] == 4),
+            c["cold"][-1] if c["cold"] else {"slots": 0, "frac": 0.0},
+        )
+        out["census"] = {
+            "occupancy": round(c["occupancy"], 4),
+            "live": c["live"],
+            "cold_frac_4x": round(cold4["frac"], 4),
+            "waste_frac": round(c["waste_frac"], 4),
+            "max_full_run": c["max_full_run"],
+            "churn_per_s": {
+                "insert": churn.get("insert_per_s", 0.0),
+                "evict": churn.get("evict_per_s", 0.0),
+                "recycle": churn.get("recycle_per_s", 0.0),
+            },
+        }
     if daemon_metrics is not None:
         pl = daemon_metrics.global_propagation_lag.summary()
         out["propagation_ms"] = {

@@ -310,6 +310,58 @@ class Log2Histogram:
         return val
 
 
+# Log2-ms bin count of the table census (ops/census.py CENSUS_BUCKETS;
+# mirrored literally so this module stays jax-free — the census module
+# imports jax, and catalog_names() must import without it).
+CENSUS_BUCKETS = 32
+
+
+class CensusSnapshotHistogram:
+    """Table-census age/idle distribution as Prometheus histogram series.
+
+    Unlike Log2Histogram this is a SNAPSHOT, not an event stream: each
+    census publishes the full per-bin slot counts (how many resident
+    slots currently have age/idle in [2^(i-1), 2^i) ms), and render
+    replaces — never accumulates — the series. `le` bounds are seconds
+    (0.001 * 2**i); the last census bin is the +Inf bucket; `_count` is
+    the live slot population and `_sum` the total age/idle seconds.
+    Registered through Metrics.register_renderable like the engine's
+    Log2Histograms, fed by engine_sync from the TTL-cached census."""
+
+    def __init__(self, name: str, doc: str):
+        self.name = name
+        self.doc = doc
+        self._lock = lockorder.make_lock("metrics.census")
+        self._hist_ms: list = [0] * CENSUS_BUCKETS
+        self._sum_ms = 0
+
+    def sample_names(self) -> list:
+        return [self.name, f"{self.name}_bucket",
+                f"{self.name}_sum", f"{self.name}_count"]
+
+    def update(self, hist_ms, sum_ms) -> None:
+        with self._lock:
+            self._hist_ms = list(hist_ms)
+            self._sum_ms = int(sum_ms)
+
+    def render_lines(self, openmetrics: bool = False) -> list:
+        with self._lock:
+            counts = list(self._hist_ms)
+            total_s = self._sum_ms / 1000.0
+        out = [f"# HELP {self.name} {self.doc}",
+               f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            cum += c
+            le = 0.001 * (1 << i)
+            out.append(f'{self.name}_bucket{{le="{le:.12g}"}} {cum}')
+        cum += counts[-1] if counts else 0
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {total_s}")
+        out.append(f"{self.name}_count {cum}")
+        return out
+
+
 class HotKeySketch:
     """Top-K hot-key attribution via a weighted space-saving (Misra-
     Gries) sketch: at most `k` tracked keys, each entry carrying its
@@ -815,6 +867,86 @@ class Metrics:
             "way occupied (an insert into a full group must evict).",
             registry=r,
         )
+        # Table-census families (docs/monitoring.md "Table census"):
+        # residency/coldness/churn telemetry for the paged-table roadmap,
+        # fed from the engine's TTL-cached table_census() at scrape time.
+        self.table_slots = Gauge(
+            "gubernator_table_slots",
+            "Total device slot-table capacity in slots (all tiers).",
+            registry=r,
+        )
+        self.table_waste_slots = Gauge(
+            "gubernator_table_waste_slots",
+            "Expired-but-still-resident slots: used slots whose rate "
+            "window has fully elapsed (reclaimable without eviction).",
+            registry=r,
+        )
+        self.table_waste_ratio = Gauge(
+            "gubernator_table_waste_ratio",
+            "gubernator_table_waste_slots as a fraction of capacity.",
+            registry=r,
+        )
+        self.table_cold_slots = Gauge(
+            "gubernator_table_cold_slots",
+            "Used slots idle for more than `multiplier` x their own "
+            "duration — the cold set a paged table would demote.",
+            ["multiplier"],
+            registry=r,
+        )
+        self.table_cold_reclaimable_bytes = Gauge(
+            "gubernator_table_cold_reclaimable_bytes",
+            "HBM a cold tier would reclaim at this idleness multiplier "
+            "(cold slots x bytes_per_slot).",
+            ["multiplier"],
+            registry=r,
+        )
+        self.table_heatmap_region_min = Gauge(
+            "gubernator_table_heatmap_region_min",
+            "Used slots in the least-occupied census heatmap region "
+            "(the future page axis; full vector at /debug/table).",
+            registry=r,
+        )
+        self.table_heatmap_region_max = Gauge(
+            "gubernator_table_heatmap_region_max",
+            "Used slots in the most-occupied census heatmap region.",
+            registry=r,
+        )
+        self.table_max_full_run = Gauge(
+            "gubernator_table_max_full_run",
+            "Longest run of consecutive completely-full groups (probe "
+            "pressure hotspot; inserts there must evict).",
+            registry=r,
+        )
+        self.table_churn_inserts_per_s = Gauge(
+            "gubernator_table_churn_inserts_per_s",
+            "Census churn ledger: slot insertions per second over the "
+            "last census interval.",
+            registry=r,
+        )
+        self.table_churn_evictions_per_s = Gauge(
+            "gubernator_table_churn_evictions_per_s",
+            "Census churn ledger: unexpired evictions per second over "
+            "the last census interval.",
+            registry=r,
+        )
+        self.table_churn_recycles_per_s = Gauge(
+            "gubernator_table_churn_recycles_per_s",
+            "Census churn ledger: overwrite-recycles per second "
+            "(inserts that reclaimed an expired/freed resident slot).",
+            registry=r,
+        )
+        self.table_slot_age_seconds = CensusSnapshotHistogram(
+            "gubernator_table_slot_age_seconds",
+            "Census snapshot: resident slots by age (now - stamp; time "
+            "since the counter window was created/updated).",
+        )
+        self.register_renderable(self.table_slot_age_seconds)
+        self.table_slot_idle_seconds = CensusSnapshotHistogram(
+            "gubernator_table_slot_idle_seconds",
+            "Census snapshot: resident slots by idle time (now - lru; "
+            "time since the slot last served a request).",
+        )
+        self.register_renderable(self.table_slot_idle_seconds)
         self.global_broadcast_keys = Log2Histogram(
             "gubernator_global_broadcast_keys",
             "Keys per GLOBAL authoritative broadcast flush.",
@@ -993,7 +1125,10 @@ def engine_sync(engine):
     """Sync callback exporting DeviceEngine counters under the reference's
     cache/worker metric names (reference lrucache.go:48-59,
     gubernator.go:86-93), plus the device-tier gauges this port adds
-    (occupancy / probe pressure / cold compiles)."""
+    (occupancy / probe pressure / cold compiles / the table-census
+    families). Table residency reads the engine's TTL-cached
+    table_census() — a scrape never triggers device work itself
+    (guberlint GL009; docs/monitoring.md "Table census")."""
 
     def _sync(m: "Metrics") -> None:
         em = engine.metrics
@@ -1004,9 +1139,34 @@ def engine_sync(engine):
         m.command_counter.set(em.requests)
         m.worker_queue_length.set(engine.queue_depth())
         m.engine_cold_compiles.set(getattr(em, "cold_compiles", 0))
-        if hasattr(engine, "occupancy_stats"):
-            # One set of device-scalar reductions per scrape — table
-            # residency defines these, not host bookkeeping.
+        if hasattr(engine, "table_census"):
+            c = engine.table_census()
+            m.cache_size.set(c["live"])
+            m.engine_table_occupancy.set(c["occupancy"])
+            m.engine_full_group_ratio.set(c["full_group_ratio"])
+            m.table_slots.set(c["slots"])
+            m.table_waste_slots.set(c["waste"])
+            m.table_waste_ratio.set(c["waste_frac"])
+            for entry in c["cold"]:
+                mult = str(entry["multiplier"])
+                m.table_cold_slots.labels(mult).set(entry["slots"])
+                m.table_cold_reclaimable_bytes.labels(mult).set(
+                    entry["reclaimable_bytes"]
+                )
+            heat = c["heatmap"]
+            if heat:
+                m.table_heatmap_region_min.set(min(heat))
+                m.table_heatmap_region_max.set(max(heat))
+            m.table_max_full_run.set(c["max_full_run"])
+            churn = c.get("churn") or {}
+            m.table_churn_inserts_per_s.set(churn.get("insert_per_s", 0.0))
+            m.table_churn_evictions_per_s.set(churn.get("evict_per_s", 0.0))
+            m.table_churn_recycles_per_s.set(churn.get("recycle_per_s", 0.0))
+            m.table_slot_age_seconds.update(c["age_ms_hist"], c["age_ms_sum"])
+            m.table_slot_idle_seconds.update(
+                c["idle_ms_hist"], c["idle_ms_sum"]
+            )
+        elif hasattr(engine, "occupancy_stats"):
             stats = engine.occupancy_stats()
             m.cache_size.set(stats["live"])
             m.engine_table_occupancy.set(stats["occupancy"])

@@ -186,6 +186,20 @@ class RawKernels(NamedTuple):
     from_wide: object  # SlotTable -> table (traceable)
 
 
+def get_census(layout: str, ways: int, **kwargs):
+    """Census program for `layout` (ops/census.py): one jitted,
+    NON-donating scan per (layout, geometry) returning O(buckets)
+    device scalars — the table-observatory entry point, registered
+    here alongside the kernel registry so every layout-selection
+    surface resolves both from one place. Lazy import: census is a
+    scrape-cadence diagnostic, not a serving dependency."""
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown table layout: {layout!r}")
+    from gubernator_tpu.ops.census import make_census
+
+    return make_census(layout, ways, **kwargs)
+
+
 def get_raw_kernels(layout: str) -> RawKernels:
     if layout == "wide":
         from gubernator_tpu.ops.decide import _decide_impl

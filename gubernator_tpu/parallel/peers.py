@@ -258,9 +258,12 @@ class Peer:
         self, keys: Optional[Sequence[str]] = None,
         timeout: Optional[float] = None,
     ) -> dict:
-        """Fetch this peer's local debug blob (consistency observatory):
-        /debug/cluster fan-out and the divergence auditor's replica-view
-        fetch. Breaker- and fault-wrapped like every transport leg. Also
+        """Fetch this peer's local debug blob (consistency + table
+        observatories): /debug/cluster fan-out and the divergence
+        auditor's replica-view fetch. The free-form dict carries the
+        peer's `table_census` snapshot (server.local_debug_info), so
+        the fan-out aggregates a fleet-wide census with no wire-format
+        bump. Breaker- and fault-wrapped like every transport leg. Also
         estimates this peer's wall-clock skew from the RPC midpoint
         (remote now_ms minus our send/receive midpoint) — the honesty
         bound for the stamp-based propagation-lag histogram."""

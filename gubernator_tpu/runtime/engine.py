@@ -43,7 +43,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
-from gubernator_tpu.ops.kernels import get_kernels
+from gubernator_tpu.ops.kernels import get_census, get_kernels
 from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
@@ -126,6 +126,20 @@ class EngineConfig:
     # ops/narrow.py). All are oracle-exact; Loader snapshots are
     # portable across them (ops/kernels.py LAYOUTS).
     layout: str = "fused"
+    # Table observatory (docs/monitoring.md "Table census"): TTL of the
+    # cached census snapshot (GUBER_TABLE_CENSUS_TTL) — every scrape
+    # surface (occupancy gauges, /debug/table, DebugInfo) reads the
+    # cache, so at most ONE census program runs per interval and a
+    # slow/concurrent scrape can never stall the pump.
+    census_ttl_s: float = 5.0
+    # Cold-set idleness thresholds (GUBER_TABLE_CENSUS_THRESHOLDS): a
+    # used slot is "cold at kx" when its idle time exceeds k x its own
+    # duration; each threshold reports count + reclaimable bytes.
+    census_thresholds: tuple = (1, 4, 16)
+    # Occupancy heatmap width (GUBER_TABLE_CENSUS_HEATMAP): the group
+    # axis aggregates into this many contiguous regions — the future
+    # paged-table "page" axis (ROADMAP item 1).
+    census_heatmap_width: int = 64
 
 
 class EngineMetrics:
@@ -351,6 +365,14 @@ class EngineBase:
         # resolved by whichever thread completes their last member.
         self._bulks: List[_Bulk] = []
         self._bulks_lock = lockorder.make_lock("engine.bulks")
+        # Table-census cache (docs/monitoring.md "Table census"): every
+        # scrape surface reads this snapshot, so at most one census
+        # program runs per TTL interval and scrapes never hold the
+        # serving lock through device work (guberlint GL009).
+        self._census_lock = lockorder.make_lock("engine.census")
+        self._census_cache: Optional[dict] = None
+        self._census_ts = 0.0
+        self._census_prev = None  # (t_mono, misses, evictions, live)
         # Cumulative pump time spent in _dispatch (host encode + launch);
         # pump-thread-only writer, read by the completion stage for the
         # host/device overlap ratio.
@@ -728,6 +750,75 @@ class EngineBase:
             snap["occupancy"] = self.occupancy_stats()
         return snap
 
+    # -- table census (docs/monitoring.md "Table census") --------------------
+
+    def table_census(self, max_age_s: Optional[float] = None) -> dict:
+        """TTL-cached table census — the table observatory's single
+        entry point (occupancy gauges, /debug/table, DebugInfo, and the
+        occupancy_stats()/live_count() back-compat views all read it).
+
+        The scan runs OFF the hot path and OUTSIDE the pump-critical
+        lock section: the engine lock is held only long enough to
+        dispatch the NON-donating census program against the live table
+        reference (JAX async dispatch — no host sync under the lock);
+        the O(buckets) materialization happens after release, in
+        _census_scan. Pass max_age_s=0 to force a fresh scan."""
+        ttl = (
+            float(getattr(self.cfg, "census_ttl_s", 5.0))
+            if max_age_s is None
+            else float(max_age_s)
+        )
+        with self._census_lock:
+            if (
+                self._census_cache is not None
+                and time.monotonic() - self._census_ts < ttl
+            ):
+                return self._census_cache
+            snap = self._census_scan()
+            snap["churn"] = self._census_churn(snap)
+            self._census_cache = snap
+            self._census_ts = time.monotonic()
+            return snap
+
+    def _census_churn(self, snap: dict) -> dict:
+        """Churn ledger: interval deltas of the flush bookkeeping the
+        engine already keeps, turned into rates at census cadence.
+        `overwrite_recycles` (inserts that reclaimed an expired/freed
+        resident slot) is derived by conservation: every insert either
+        lands on an empty slot (live grows), evicts an unexpired
+        occupant (counted), or recycles a dead resident — the
+        remainder. Called with _census_lock held."""
+        em = self.metrics
+        with em.lock:
+            misses, evics = em.cache_misses, em.unexpired_evictions
+        t = time.monotonic()
+        prev = self._census_prev
+        self._census_prev = (t, misses, evics, snap["live"])
+        if prev is None:
+            return {
+                "interval_s": 0.0,
+                "insertions": 0,
+                "evictions": 0,
+                "overwrite_recycles": 0,
+                "insert_per_s": 0.0,
+                "evict_per_s": 0.0,
+                "recycle_per_s": 0.0,
+            }
+        dt = max(t - prev[0], 1e-9)
+        d_ins = max(misses - prev[1], 0)
+        d_ev = max(evics - prev[2], 0)
+        d_live = snap["live"] - prev[3]
+        d_rec = max(d_ins - d_ev - max(d_live, 0), 0)
+        return {
+            "interval_s": round(dt, 6),
+            "insertions": d_ins,
+            "evictions": d_ev,
+            "overwrite_recycles": d_rec,
+            "insert_per_s": round(d_ins / dt, 3),
+            "evict_per_s": round(d_ev / dt, 3),
+            "recycle_per_s": round(d_rec / dt, 3),
+        }
+
     # -- pump ----------------------------------------------------------------
 
     def _pump(self) -> None:
@@ -884,6 +975,113 @@ class EngineBase:
         return pending
 
 
+def _census_tier_snapshot(
+    out, *, now, layout, groups, ways, bytes_per_slot, thresholds,
+    heatmap_width,
+) -> dict:
+    """Materialize one tier's CensusOutput (O(buckets) scalars) into a
+    JSON-safe dict. Runs OUTSIDE the engine lock — the program was
+    dispatched under it; this is the publish step."""
+    a = {
+        f: np.asarray(getattr(out, f)).tolist()  # guberlint: allow-host-sync -- census readback: O(buckets) scalars at TTL cadence, outside the serving lock
+        for f in out._fields
+    }
+    slots = groups * ways
+    live = a["live"]
+    waste = a["waste"]
+    full_groups = a["full_groups"]
+    return {
+        "layout": layout,
+        "groups": groups,
+        "ways": ways,
+        "slots": slots,
+        "bytes_per_slot": bytes_per_slot,
+        "now_ms": int(now),
+        "live": live,
+        "occupancy": live / float(slots) if slots else 0.0,
+        "full_groups": full_groups,
+        "full_group_ratio": full_groups / float(groups) if groups else 0.0,
+        "waste": waste,
+        "waste_frac": waste / float(slots) if slots else 0.0,
+        "age_ms_hist": a["age_hist"],
+        "age_ms_sum": a["age_sum"],
+        "idle_ms_hist": a["idle_hist"],
+        "idle_ms_sum": a["idle_sum"],
+        "heatmap": a["heatmap"],
+        "heatmap_groups_per_region": -(-groups // heatmap_width),
+        "fill_hist": a["fill_hist"],
+        "max_full_run": a["max_full_run"],
+        "cold": [
+            {
+                "multiplier": int(k),
+                "slots": c,
+                "frac": c / float(slots) if slots else 0.0,
+                "reclaimable_bytes": c * bytes_per_slot,
+            }
+            for k, c in zip(thresholds, a["cold"])
+        ],
+    }
+
+
+def _census_combine(tiers: Dict[str, dict], primary: str) -> dict:
+    """Top-level census snapshot: tier-summed residency/age/cold
+    numbers (what capacity planning wants) plus the primary tier's
+    structural fields (heatmap, fill histogram, probe pressure —
+    geometry-specific, meaningless summed across different group/way
+    shapes). Full per-tier payloads ride under "tiers"."""
+    p = tiers[primary]
+    live = sum(t["live"] for t in tiers.values())
+    slots = sum(t["slots"] for t in tiers.values())
+    waste = sum(t["waste"] for t in tiers.values())
+
+    def vsum(field):
+        its = [t[field] for t in tiers.values()]
+        return [sum(vals) for vals in zip(*its)]
+
+    cold = []
+    for i, entry in enumerate(p["cold"]):
+        cold.append(
+            {
+                "multiplier": entry["multiplier"],
+                "slots": sum(t["cold"][i]["slots"] for t in tiers.values()),
+                "frac": (
+                    sum(t["cold"][i]["slots"] for t in tiers.values())
+                    / float(slots)
+                    if slots
+                    else 0.0
+                ),
+                "reclaimable_bytes": sum(
+                    t["cold"][i]["reclaimable_bytes"] for t in tiers.values()
+                ),
+            }
+        )
+    return {
+        "v": 1,
+        "layout": p["layout"],
+        "groups": p["groups"],
+        "ways": p["ways"],
+        "slots": slots,
+        "bytes_per_slot": p["bytes_per_slot"],
+        "now_ms": p["now_ms"],
+        "live": live,
+        "occupancy": live / float(slots) if slots else 0.0,
+        "full_groups": p["full_groups"],
+        "full_group_ratio": p["full_group_ratio"],
+        "waste": waste,
+        "waste_frac": waste / float(slots) if slots else 0.0,
+        "age_ms_hist": vsum("age_ms_hist"),
+        "age_ms_sum": sum(t["age_ms_sum"] for t in tiers.values()),
+        "idle_ms_hist": vsum("idle_ms_hist"),
+        "idle_ms_sum": sum(t["idle_ms_sum"] for t in tiers.values()),
+        "heatmap": p["heatmap"],
+        "heatmap_groups_per_region": p["heatmap_groups_per_region"],
+        "fill_hist": p["fill_hist"],
+        "max_full_run": p["max_full_run"],
+        "cold": cold,
+        "tiers": tiers,
+    }
+
+
 class DeviceEngine(EngineBase):
     """Owns the device slot table; turns request streams into decisions.
 
@@ -916,6 +1114,19 @@ class DeviceEngine(EngineBase):
         self.K = get_kernels(config.layout)
         with jax.default_device(dev) if dev is not None else _nullcontext():
             self.table = self.K.create(config.num_groups, config.ways)
+
+        # Table-observatory program (ops/census.py): one jitted,
+        # non-donating scan per (layout, geometry, knobs); warmed in
+        # _warmup so the first scrape never compiles.
+        self._census_thresholds = tuple(
+            int(k) for k in config.census_thresholds
+        )
+        self._census = get_census(
+            config.layout,
+            config.ways,
+            heatmap_width=int(config.census_heatmap_width),
+            thresholds=self._census_thresholds,
+        )
 
         self._warmup()
         self._init_base("gubernator-tpu-engine")
@@ -1023,6 +1234,10 @@ class DeviceEngine(EngineBase):
             table, InjectBatch.zeros(self.cfg.batch_size), now, self.cfg.ways
         )
         np.asarray(table.used[:1])
+        # Census compiles here too: the first /metrics or /debug/table
+        # scrape must dispatch a warm program, not pay a compile.
+        c = self._census(table, now)
+        np.asarray(c.live)  # guberlint: allow-host-sync -- warmup: compile the census program before serving
         self.table = table
 
     def warm_store_path(self) -> None:
@@ -1062,26 +1277,95 @@ class DeviceEngine(EngineBase):
 
     def live_count(self) -> int:
         """Number of occupied slots (gubernator_cache_size analog).
-        One device reduction; intended for scrape cadence, not hot path."""
-        with self._lock:
-            return int(jax.numpy.sum(self.table.used))
+        Thin view over the TTL-cached census: scrapes never run a
+        device reduction under the engine lock (guberlint GL009)."""
+        return self.table_census()["live"]
 
     def occupancy_stats(self) -> dict:
-        """Table occupancy + probe pressure as device-scalar reductions
-        (two tiny cached programs, scalars only to host). Scrape-time
-        cost — metrics.engine_sync samples this per exposition."""
-        jnp = jax.numpy
-        G, W = self.cfg.num_groups, self.cfg.ways
-        with self._lock:
-            used = self.table.used
-            live = int(jnp.sum(used))
-            full = int(jnp.sum(jnp.all(used.reshape(G, W), axis=1)))
+        """Back-compat occupancy dict (/debug/engine, DebugInfo): a
+        thin view over the TTL-cached census — same shape as the old
+        per-scrape device reductions, zero scrape-triggered device
+        work (docs/monitoring.md "Table census")."""
+        c = self.table_census()
         return {
-            "live": live,
-            "slots": G * W,
-            "occupancy": live / float(G * W),
-            "full_group_ratio": full / float(G),
+            "live": c["live"],
+            "slots": c["slots"],
+            "occupancy": c["occupancy"],
+            "full_group_ratio": c["full_group_ratio"],
         }
+
+    def _census_scan(self) -> dict:
+        """One census pass (called by table_census with _census_lock
+        held): dispatch the non-donating program on the live table
+        reference under the engine lock, materialize after release."""
+        cfg = self.cfg
+        now = self.now_fn()
+        with self._lock:
+            out = self._census(self.table, now)
+        tier = _census_tier_snapshot(
+            out,
+            now=now,
+            layout=cfg.layout,
+            groups=cfg.num_groups,
+            ways=cfg.ways,
+            bytes_per_slot=self.K.bytes_per_slot,
+            thresholds=self._census_thresholds,
+            heatmap_width=int(cfg.census_heatmap_width),
+        )
+        return _census_combine({"device": tier}, primary="device")
+
+    def hotkeys_snapshot(self) -> dict:
+        """/debug/hotkeys payload with the census join: each sketch row
+        gains the key's residency bucket — `resident`, `cold` (idle
+        past the census cold threshold), `expired` (window elapsed but
+        slot still held), or `evicted` — so operators can see whether
+        hot keys are fighting cold residents for slots."""
+        snap = super().hotkeys_snapshot()
+        entries = snap.get("entries") or []
+        hashes = [e.get("key_hash") for e in entries]
+        if not hashes or any(h is None for h in hashes):
+            return snap  # no sketch / legacy rows: nothing to join on
+        cfg = self.cfg
+        W = cfg.ways
+        hi = np.array([h[0] for h in hashes], dtype=np.int64)
+        lo = np.array([h[1] for h in hashes], dtype=np.int64)
+        grp = np.array(
+            [group_of(int(l), cfg.num_groups) for l in lo], dtype=np.int64
+        )
+        slots = (
+            grp[:, None] * np.int64(W)
+            + np.arange(W, dtype=np.int64)[None, :]
+        ).reshape(-1)
+        with self._lock:
+            rows = self.K.gather_rows(self.table, slots)
+        # Bounded O(K x ways) readback at debug-poll cadence; the
+        # census bucket thresholds mirror table_census semantics.
+        n = len(hashes)
+
+        def mat(col):
+            return np.asarray(col).reshape(n, W)  # guberlint: allow-host-sync -- hotkeys census join: O(K x ways) rows at debug cadence, outside the serving lock
+
+        r_hi, r_lo = mat(rows.key_hi), mat(rows.key_lo)
+        r_used, r_lru = mat(rows.used), mat(rows.lru)
+        r_dur, r_exp = mat(rows.duration), mat(rows.expire_at)
+        now = self.now_fn()
+        cold_k = self._census_thresholds[
+            min(1, len(self._census_thresholds) - 1)
+        ]
+        for i, e in enumerate(entries):
+            match = r_used[i] & (r_hi[i] == hi[i]) & (r_lo[i] == lo[i])
+            if not match.any():
+                e["census"] = "evicted"
+                continue
+            w = int(np.argmax(match))
+            if r_exp[i, w] <= now:
+                e["census"] = "expired"
+            elif now - r_lru[i, w] > cold_k * r_dur[i, w]:
+                e["census"] = "cold"
+            else:
+                e["census"] = "resident"
+        snap["cold_multiplier"] = int(cold_k)
+        return snap
 
     # ---- wave assembly + kernel dispatch -----------------------------------
 

@@ -42,6 +42,7 @@ from gubernator_tpu.utils import lockorder
 from gubernator_tpu.api.keys import group_of, key_hash128_batch
 from gubernator_tpu.api.types import Behavior, RateLimitResp
 from gubernator_tpu.ops.encode import EncodeError, encode_one
+from gubernator_tpu.ops.kernels import BYTES_PER_SLOT, get_census
 from gubernator_tpu.ops.layout import RequestBatch
 from gubernator_tpu.parallel import ici
 from gubernator_tpu.parallel import mesh as pmesh
@@ -52,6 +53,8 @@ from gubernator_tpu.runtime.engine import (
     _FlushTicket,
     _WaveAssembler,
     _assemble_column_waves,
+    _census_combine,
+    _census_tier_snapshot,
     _materialize_out,
     _note_hotkeys_columnar,
     _select_columns,
@@ -83,6 +86,12 @@ class IciEngineConfig:
     hotkeys_k: int = 128
     stage_metadata: bool = False
     exemplars: bool = True
+    # Table-census knobs — same semantics as EngineConfig
+    # (GUBER_TABLE_CENSUS_TTL / _THRESHOLDS / _HEATMAP; the census runs
+    # over BOTH tiers: sharded table + replica 0 of the GLOBAL tier).
+    census_ttl_s: float = 5.0
+    census_thresholds: tuple = (1, 4, 16)
+    census_heatmap_width: int = 64
     # Table layout for BOTH the sharded and replica tiers (the
     # ops/kernels.py LAYOUTS registry; "narrow" halves probe DMA at
     # large tables); fused is the TPU production layout (VERDICT r4
@@ -170,6 +179,25 @@ class IciEngine(EngineBase):
             )
         self._inject_replicas = ici.make_inject_replicas(
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
+        )
+
+        # Table observatory (ops/census.py): one non-donating program per
+        # tier — the sharded table scans as-is; the replica tier's leaves
+        # carry a leading device axis, so it uses the stacked variant
+        # (replica 0; post-sync replicas mirror each other).
+        self._census_thresholds = tuple(
+            int(k) for k in cfg.census_thresholds
+        )
+        self._census_sharded = get_census(
+            cfg.layout, cfg.ways,
+            heatmap_width=int(cfg.census_heatmap_width),
+            thresholds=self._census_thresholds,
+        )
+        self._census_replica = get_census(
+            cfg.layout, cfg.replica_ways,
+            heatmap_width=int(cfg.census_heatmap_width),
+            thresholds=self._census_thresholds,
+            stacked=True,
         )
 
         self._lock = lockorder.make_lock("ici_engine.state")
@@ -480,36 +508,64 @@ class IciEngine(EngineBase):
         return self._queue.qsize()
 
     def live_count(self) -> int:
-        """Occupied slots: sharded table + each replica's owned region."""
-        with self._lock:
-            sharded = int(jax.numpy.sum(self.table.used))
-            replica = int(jax.numpy.sum(self.ici_state.table.used)) // max(self.n_dev, 1)
-        return sharded + replica
+        """Occupied slots: sharded table + one replica's worth of the
+        GLOBAL tier. Thin view over the TTL-cached census (GL009: no
+        device reductions on the scrape path)."""
+        return self.table_census()["live"]
 
     def occupancy_stats(self) -> dict:
-        """Occupancy + probe pressure across BOTH tiers: the sharded
+        """Back-compat occupancy dict across BOTH tiers: the sharded
         authoritative table plus one replica's worth of the GLOBAL tier
         (replicas mirror each other post-sync). Probe pressure is
         reported for the sharded tier, where a full group forces an
-        eviction on insert. Device-scalar reductions only (scrape
-        cadence; see metrics.engine_sync)."""
-        jnp = jax.numpy
-        cfg = self.cfg
-        G, W = cfg.num_groups, cfg.ways
-        with self._lock:
-            s_used = self.table.used
-            live_s = int(jnp.sum(s_used))
-            full_s = int(jnp.sum(jnp.all(s_used.reshape(G, W), axis=1)))
-            live_r = int(jnp.sum(self.ici_state.table.used)) // max(
-                self.n_dev, 1
-            )
-        slots = G * W + cfg.num_slots
+        eviction on insert. A thin view over the TTL-cached census —
+        zero scrape-triggered device work (see metrics.engine_sync)."""
+        c = self.table_census()
         return {
-            "live": live_s + live_r,
-            "slots": slots,
-            "occupancy": (live_s + live_r) / float(slots),
-            "full_group_ratio": full_s / float(G),
+            "live": c["live"],
+            "slots": c["slots"],
+            "occupancy": c["occupancy"],
+            "full_group_ratio": c["full_group_ratio"],
         }
+
+    def _census_scan(self) -> dict:
+        """One census pass over both tiers (called by table_census with
+        _census_lock held): dispatch both non-donating programs under
+        the engine lock (async — no host sync while the pump or sync
+        tick could be waiting), materialize after release. The combined
+        view takes structural fields (heatmap, probe pressure) from the
+        sharded tier — the authoritative table a paged cold tier would
+        page — while additive fields (live, waste, cold sets,
+        histograms) sum across tiers."""
+        cfg = self.cfg
+        now = self.now_fn()
+        with self._lock:
+            out_s = self._census_sharded(self.table, now)
+            out_r = self._census_replica(self.ici_state.table, now)
+        bps = BYTES_PER_SLOT[cfg.layout]
+        tiers = {
+            "sharded": _census_tier_snapshot(
+                out_s,
+                now=now,
+                layout=cfg.layout,
+                groups=cfg.num_groups,
+                ways=cfg.ways,
+                bytes_per_slot=bps,
+                thresholds=self._census_thresholds,
+                heatmap_width=int(cfg.census_heatmap_width),
+            ),
+            "replica": _census_tier_snapshot(
+                out_r,
+                now=now,
+                layout=cfg.layout,
+                groups=self.num_rgroups,
+                ways=cfg.replica_ways,
+                bytes_per_slot=bps,
+                thresholds=self._census_thresholds,
+                heatmap_width=int(cfg.census_heatmap_width),
+            ),
+        }
+        return _census_combine(tiers, primary="sharded")
 
     def close(self) -> None:
         self._stop_sync.set()
@@ -531,6 +587,14 @@ class IciEngine(EngineBase):
             # Warm the backstop program too — its first forced tick must
             # not pay a cold compile on the 100ms cadence.
             self.ici_state, _diag = self._sync_full(self.ici_state, now)
+        # Census compiles here for both tiers: the first /metrics or
+        # /debug/table scrape must dispatch warm programs, not compile.
+        cs = self._census_sharded(self.table, now)
+        cr = self._census_replica(self.ici_state.table, now)
+        np.asarray(cs.live)  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
+        np.asarray(cr.live)  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
+        # Final fence: __init__ returns with every program compiled and
+        # the replica state resident.
         jax.block_until_ready(self.ici_state.pending)
 
     def _sync_loop(self) -> None:

@@ -279,6 +279,17 @@ class DaemonConfig:
     stage_metadata: bool = False
     exemplars: bool = True
 
+    # Table observatory (docs/monitoring.md "Table census"):
+    # GUBER_TABLE_CENSUS_TTL caches the device census scan for this many
+    # seconds (scrapes within the window reuse it — zero device work);
+    # GUBER_TABLE_CENSUS_THRESHOLDS sets the cold-set idleness
+    # multipliers (a slot is "cold at kx" when idle > k x its own
+    # duration); GUBER_TABLE_CENSUS_HEATMAP sets how many group regions
+    # the occupancy heatmap aggregates into (the future page axis).
+    census_ttl_s: float = 5.0
+    census_thresholds: tuple = (1, 4, 16)
+    census_heatmap_width: int = 64
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine
@@ -307,6 +318,9 @@ class DaemonConfig:
             exemplars=self.exemplars,
             drain_timeout_s=self.drain_timeout_s,
             pipeline_depth=self.pipeline_depth,
+            census_ttl_s=self.census_ttl_s,
+            census_thresholds=self.census_thresholds,
+            census_heatmap_width=self.census_heatmap_width,
             # Handover needs routable (string-keyed) snapshots even on
             # the store-less columnar edge; with it off, skip the decode.
             record_columnar_keys=self.behaviors.handover,

@@ -83,6 +83,24 @@ def _env_bool(name: str, default: bool = False) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _parse_census_thresholds(v: str) -> tuple:
+    """GUBER_TABLE_CENSUS_THRESHOLDS: comma-separated idleness
+    multipliers for the census cold-set table (e.g. "1,4,16")."""
+    v = v.strip()
+    if not v:
+        return (1, 4, 16)
+    try:
+        out = tuple(int(p.strip()) for p in v.split(",") if p.strip())
+    except ValueError:
+        out = ()
+    if not out or any(k < 1 for k in out):
+        raise ValueError(
+            f"'GUBER_TABLE_CENSUS_THRESHOLDS={v}' is invalid; expected "
+            "comma-separated positive integers, e.g. '1,4,16'"
+        )
+    return out
+
+
 def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     if config_file:
         load_config_file(config_file)
@@ -167,7 +185,19 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         hotkeys_k=_env_int("GUBER_HOTKEYS_K", 128),
         stage_metadata=_env_bool("GUBER_STAGE_METADATA"),
         exemplars=_env_bool("GUBER_EXEMPLARS", True),
+        # Table observatory (docs/monitoring.md "Table census"): census
+        # scan TTL, cold-set idleness multipliers, heatmap region count.
+        census_ttl_s=parse_duration_s(_env("GUBER_TABLE_CENSUS_TTL"), 5.0),
+        census_thresholds=_parse_census_thresholds(
+            _env("GUBER_TABLE_CENSUS_THRESHOLDS")
+        ),
+        census_heatmap_width=_env_int("GUBER_TABLE_CENSUS_HEATMAP", 64),
     )
+    if conf.census_heatmap_width < 1:
+        raise ValueError(
+            f"'GUBER_TABLE_CENSUS_HEATMAP={conf.census_heatmap_width}' is "
+            "invalid; must be >= 1 heatmap region"
+        )
     if conf.pipeline_depth < 1:
         raise ValueError(
             f"'GUBER_PIPELINE_DEPTH={conf.pipeline_depth}' is invalid; "
@@ -221,6 +251,9 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             hotkeys_k=conf.hotkeys_k,
             stage_metadata=conf.stage_metadata,
             exemplars=conf.exemplars,
+            census_ttl_s=conf.census_ttl_s,
+            census_thresholds=conf.census_thresholds,
+            census_heatmap_width=conf.census_heatmap_width,
             # 0 = unbounded (merge the full table every tick)
             max_sync_groups=(
                 _env_int("GUBER_ICI_SYNC_GROUPS", base.max_sync_groups or 0)

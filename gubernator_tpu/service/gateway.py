@@ -95,8 +95,25 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         return web.json_response(out)
 
     async def debug_hotkeys(request: web.Request) -> web.Response:
-        # Host-side sketch snapshot — no device work, no engine lock.
-        return web.json_response(svc.engine.hotkeys_snapshot())
+        # Sketch snapshot + census residency join: the join gathers the
+        # tracked keys' slot rows under the engine lock — executor, not
+        # event loop.
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.engine.hotkeys_snapshot
+        )
+        return web.json_response(snap)
+
+    async def debug_table(request: web.Request) -> web.Response:
+        """Full table-census snapshot (docs/monitoring.md "Table
+        census"): per-tier age/idle histograms, the group-region
+        occupancy heatmap, waste + cold-set summaries, and the churn
+        ledger. TTL-cached in the engine — scraping this endpoint never
+        triggers device work beyond one census per TTL interval; the
+        cache read still briefly takes engine locks, so executor."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.engine.table_census
+        )
+        return web.json_response(snap)
 
     async def debug_cluster(request: web.Request) -> web.Response:
         """Cluster-wide debug view (docs/monitoring.md "Consistency"):
@@ -137,6 +154,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
 
     app.router.add_get("/debug/engine", debug_engine)
     app.router.add_get("/debug/hotkeys", debug_hotkeys)
+    app.router.add_get("/debug/table", debug_table)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/cluster", debug_cluster)
 

@@ -460,6 +460,11 @@ class V1Service:
             info["breakers"] = self.forwarder.breaker_summary()
         if hasattr(self.engine, "occupancy_stats"):
             info["occupancy"] = self.engine.occupancy_stats()
+        if hasattr(self.engine, "table_census"):
+            # Full census rides the free-form DebugInfo dict, so
+            # /debug/cluster aggregates a fleet-wide table observatory
+            # with no wire-format bump (docs/monitoring.md).
+            info["table_census"] = self.engine.table_census()
         if hasattr(self.engine, "hotkeys_snapshot"):
             info["hotkeys"] = self.engine.hotkeys_snapshot()
         consistency: dict = {

@@ -75,6 +75,30 @@ def test_debug_hotkeys_served_on_both_listeners(daemon):
         assert any(k.startswith("dbg_k") for k in keys), keys
         for e in snap["entries"]:
             assert e["hits"] >= 1 and e["err"] >= 0
+        # census join: every tracked key was just hit, so it resolves
+        # to a live residency bucket
+        assert snap["cold_multiplier"] >= 1
+        for e in snap["entries"]:
+            assert e["census"] in ("resident", "cold", "expired",
+                                   "evicted")
+        assert any(e["census"] == "resident" for e in snap["entries"])
+
+
+def test_debug_table_served_on_both_listeners(daemon):
+    for addr in (daemon.http_address, daemon.status_address):
+        r = requests.get(f"http://{addr}/debug/table", timeout=10)
+        assert r.status_code == 200
+        c = r.json()
+        assert c["v"] == 1
+        assert c["live"] >= 20  # the fixture's 20 distinct keys
+        assert c["slots"] == c["groups"] * c["ways"]
+        assert 0 < c["occupancy"] <= 1
+        assert sum(c["age_ms_hist"]) == c["live"]
+        assert sum(c["idle_ms_hist"]) == c["live"]
+        assert sum(c["heatmap"]) == c["live"]
+        assert [e["multiplier"] for e in c["cold"]] == [1, 4, 16]
+        assert "device" in c["tiers"]
+        assert c["churn"]["interval_s"] >= 0
 
 
 def test_metrics_openmetrics_negotiation(daemon):

@@ -65,7 +65,7 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-        "GL007", "GL008",
+        "GL007", "GL008", "GL009",
     }
 
 
@@ -129,6 +129,13 @@ _CASES = [
         fixture("service", "gl008_debug_routes.py"),
         {"/debug/engine2", "/debug/raw", "/debug/trigger"},
         3,  # routes inside add_debug_routes (nested included) don't fire
+    ),
+    (
+        "GL009",
+        fixture("runtime", "gl009_scrape_device_work.py"),
+        {"'live_count'", "'occupancy_stats'", "'debug_snapshot'",
+         "jax.numpy.sum", "'add_debug_routes'", "'engine_sync'"},
+        6,  # table_census internals, pragma'd gather, helper don't fire
     ),
 ]
 

@@ -17,6 +17,7 @@ import contextlib
 import itertools
 import random
 import threading
+import time
 
 import pytest
 
@@ -374,8 +375,16 @@ def test_failed_ticket_lands_under_flush_trace(spans):
         resp = eng.check_async(mk("fail")).result(timeout=10)
         assert "injected completion failure" in resp.error
         eng._complete = orig
-        done = spans()
-        failed = _by_name(done, "engine.ticket_failed")
+        # The failed future resolves INSIDE the ticket_failed span (the
+        # caller unblocks before recovery runs), so the span may not
+        # have ended yet when .result() returns — wait for the export.
+        deadline = time.monotonic() + 5.0
+        while True:
+            done = spans()
+            failed = _by_name(done, "engine.ticket_failed")
+            if failed or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
         assert failed
         flushes = _by_name(done, "engine.flush")
         flush_ctxs = {_ctx_key(f) for f in flushes}

@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL006.
+"""guberlint rule set GL000-GL009.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -740,6 +740,89 @@ class GL008DebugRouteParity(Rule):
                     f"instead of add_debug_routes() — it will be "
                     f"missing from the other listener",
                     f"debug-route:{path_arg}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL009 — scrape-path device work must go through the cached census.
+
+_SCRAPE_SCOPES = ("gubernator_tpu/runtime/", "gubernator_tpu/service/")
+# Functions a /metrics scrape or /debug/* poll reaches: the engine's
+# snapshot surface, the metrics sync bridge, and every handler closed
+# over by the debug-route registrar. Device work here ran UNDER the
+# engine lock on every exposition until the TTL-cached table_census()
+# (ISSUE 10 satellite 1) — this rule keeps that bug class from
+# regressing.
+_SCRAPE_FUNCS = {
+    "live_count",
+    "occupancy_stats",
+    "debug_snapshot",
+    "hotkeys_snapshot",
+    "local_debug_info",
+}
+_SCRAPE_ENCLOSERS = ("add_debug_routes", "engine_sync")
+
+
+class GL009ScrapeDeviceWork(Rule):
+    code = "GL009"
+    name = "scrape-device-work"
+    description = (
+        "jnp/jax.numpy device work inside scrape-reachable functions "
+        "(metrics sync callbacks, /debug/* handlers, the engine's "
+        "snapshot surface) must go through the TTL-cached "
+        "table_census() — per-scrape device reductions stall the pump "
+        "under the engine lock — or carry an allow-scrape-device-work "
+        "pragma with a reason"
+    )
+    requires_reason = True
+
+    def _scrape_reachable(self, stack: Tuple[ast.AST, ...]) -> Optional[str]:
+        """Innermost scrape-reachable function name, or None."""
+        for node in reversed(stack):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if (
+                node.name in _SCRAPE_FUNCS
+                or node.name.startswith("debug_")
+            ):
+                return node.name
+        # Closures inside the registrar / sync-bridge factories are the
+        # handlers themselves, whatever their names.
+        for node in stack:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in _SCRAPE_ENCLOSERS
+            ):
+                return node.name
+        return None
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if not scan_path(mod.relpath).startswith(_SCRAPE_SCOPES):
+            return []
+        out = []
+        for node, stack in walk_scoped(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            is_jnp = isinstance(
+                node.value, ast.Name
+            ) and node.value.id == "jnp"
+            is_jax_numpy = _is_name_attr(node.value, "jax", "numpy")
+            if not (is_jnp or is_jax_numpy):
+                continue
+            fn = self._scrape_reachable(stack)
+            if fn is None:
+                continue
+            base = "jnp" if is_jnp else "jax.numpy"
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"{base}.{node.attr} in scrape-reachable "
+                    f"'{fn}' runs device work per exposition — read the "
+                    f"TTL-cached table_census() instead",
+                    f"scrape-device:{node.attr}:{fn}",
                 )
             )
         return out
