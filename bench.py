@@ -97,6 +97,21 @@ def _engine_telemetry(eng, daemon_metrics=None) -> dict:
                 "recycle": churn.get("recycle_per_s", 0.0),
             },
         }
+    if hasattr(eng, "device_memory"):
+        # Device-resource observatory (docs/monitoring.md "Device
+        # resources"): per-subsystem HBM attribution + headroom and the
+        # host<->device transfer ledger, so BENCH rows record what the
+        # run cost in device memory and transfer bandwidth.
+        mem = eng.device_memory()
+        dev = {
+            "source": mem["source"],
+            "bytes_in_use": mem["bytes_in_use"],
+            "headroom_frac": round(mem["headroom_frac"], 4),
+            "subsystems": mem["subsystems"],
+        }
+        if hasattr(em, "transfer_snapshot"):
+            dev["transfers"] = em.transfer_snapshot()
+        out["device"] = dev
     if daemon_metrics is not None:
         pl = daemon_metrics.global_propagation_lag.summary()
         out["propagation_ms"] = {
@@ -977,6 +992,33 @@ def bench_latency(layout: str = "fused") -> dict:
     }
 
 
+def _run_gate(args) -> bool:
+    """Perf regression gate (--gate, ROADMAP item 5): freshest ledger
+    row vs the best prior comparable row for this mode/layout. Prints
+    one GATE JSON line so CI logs show the verdict next to the RESULT
+    line; the caller exits non-zero on failure."""
+    from gubernator_tpu.utils import ledger
+
+    verdict = ledger.gate(
+        mode=args.mode,
+        layout=args.layout if args.layout_explicit else "",
+        threshold=args.gate_threshold,
+    )
+    line = {
+        "ok": verdict["ok"],
+        "reason": verdict["reason"],
+        "threshold": verdict["threshold"],
+        "throughput_ratio": verdict["throughput_ratio"],
+        "p99_ratio": verdict["p99_ratio"],
+    }
+    for k in ("current", "best"):
+        rec = verdict.get(k)
+        if rec:
+            line[k] = {"value": rec.get("value"), "iso": rec.get("iso")}
+    print("GATE " + json.dumps(line), flush=True)
+    return bool(verdict["ok"])
+
+
 def main() -> None:
     import os
 
@@ -1011,6 +1053,18 @@ def main() -> None:
         "ledger fallback prefer the FRESHEST row of any layout instead "
         "of pinning to a stale fused measurement",
     )
+    parser.add_argument(
+        "--gate", action="store_true",
+        help="perf regression gate (docs/monitoring.md): after the bench "
+        "emits, compare the freshest ledger row against the best prior "
+        "comparable row (utils/ledger.gate) and exit non-zero on a "
+        "throughput drop or flush-p99 inflation beyond --gate-threshold",
+    )
+    parser.add_argument(
+        "--gate-threshold", type=float, default=None,
+        help="gate tolerance as a fraction (default: GUBER_GATE_THRESHOLD "
+        "env at call time, else 0.15)",
+    )
     args, _ = parser.parse_known_args()
     # Explicit --layout pins both the live run and any ledger fallback;
     # unset keeps the fused default for live runs while the fallback is
@@ -1023,6 +1077,8 @@ def main() -> None:
     if not child_out:
         relayed = _try_runner_relay(args)
         if relayed == "done":
+            if args.gate and not _run_gate(args):
+                sys.exit(1)
             return
         if relayed == "no-claim":
             # A claim-holding runner exists but didn't deliver; a fresh
@@ -1033,7 +1089,11 @@ def main() -> None:
             return
         why = _run_guarded()
         if why == "done":
+            if args.gate and not _run_gate(args):
+                sys.exit(1)
             return
+        # A fallback row is an archived measurement, not a fresh run —
+        # there is nothing new to gate, so --gate is a no-op here.
         _emit_ledger_fallback(args, why)
         return
 
@@ -1051,6 +1111,8 @@ def main() -> None:
             )
         except Exception:
             pass
+        if args.gate and not _run_gate(args):
+            sys.exit(1)
 
     from gubernator_tpu.utils.compilecache import enable_compile_cache
 

@@ -576,6 +576,24 @@ def engine_histograms() -> dict:
             "future resolution).",
             scale=us, n_buckets=24, labelnames=("stage",),
         ),
+        "transfer_duration": Log2Histogram(
+            "gubernator_transfer_duration",
+            "Accounted host<->device transfer wall time in seconds, by "
+            "direction (h2d/d2h) and purpose (serve/snapshot/inject/"
+            "warmup/census). d2h materializations block, so their time "
+            "is the real copy (+ any compute it waits on); h2d puts are "
+            "async on accelerators, so their time is dispatch cost "
+            "(utils/transfer.py).",
+            scale=us, n_buckets=24, labelnames=("direction", "purpose"),
+        ),
+        "transfer_bytes": Log2Histogram(
+            "gubernator_transfer_bytes",
+            "Bytes moved per accounted host<->device transfer, by "
+            "direction and purpose — with transfer_duration, the "
+            "sustainable-bandwidth envelope the paged table's "
+            "promote/demote path will ride (ROADMAP item 1).",
+            scale=64.0, n_buckets=26, labelnames=("direction", "purpose"),
+        ),
         "hotkeys": HotKeySketch(
             "gubernator_hotkey_hits",
             "Estimated hits for the top-K hottest keys (weighted "
@@ -854,6 +872,63 @@ class Metrics:
             "Serving-path kernel dispatches that triggered an XLA "
             "compile. The serving path is warmed at startup and must "
             "never compile; nonzero means the invariant broke.",
+        )
+        # Device-resource observatory (docs/monitoring.md "Device
+        # resources"): HBM accounting gauges fed from the engine's
+        # device_memory() snapshot at scrape time — real allocator
+        # stats on TPU/GPU, the geometry-estimated fallback on CPU
+        # (utils/devicemem.py; the snapshot schema is identical).
+        self.device_bytes_in_use = Gauge(
+            "gubernator_device_bytes_in_use",
+            "Device (HBM) bytes in use: the allocator's number when the "
+            "backend reports one, else the sum of the subsystem "
+            "estimates.",
+            registry=r,
+        )
+        self.device_bytes_limit = Gauge(
+            "gubernator_device_bytes_limit",
+            "Device memory capacity in bytes (allocator limit, or the "
+            "documented single-chip assumption on stat-less backends).",
+            registry=r,
+        )
+        self.device_headroom_bytes = Gauge(
+            "gubernator_device_headroom_bytes",
+            "Device memory headroom: bytes_limit - bytes_in_use, "
+            "floored at 0 — what the paged table can still grow into.",
+            registry=r,
+        )
+        self.device_subsystem_bytes = Gauge(
+            "gubernator_device_subsystem_bytes",
+            "Estimated resident device bytes attributed to each named "
+            "engine subsystem (slot_table, ici_replicas, census, "
+            "pipeline_ring, snapshot_staging).",
+            ["subsystem"],
+            registry=r,
+        )
+        self.device_unattributed_bytes = Gauge(
+            "gubernator_device_unattributed_bytes",
+            "Device bytes in use beyond the subsystem attribution "
+            "(allocator overhead, XLA temporaries; 0 on the estimated "
+            "fallback by construction).",
+            registry=r,
+        )
+        # Compile telemetry (docs/monitoring.md "Device resources"):
+        # process-wide counters bridged from the jax.monitoring
+        # listener in runtime/telemetry.py at scrape time.
+        self.compile_cache_hits = counter(
+            "gubernator_compile_cache_hits",
+            "Persistent-compilation-cache hits (a compile satisfied by "
+            "deserializing a cached executable; utils/compilecache.py).",
+        )
+        self.compile_count = counter(
+            "gubernator_compile_count",
+            "XLA backend compiles observed process-wide — cache misses "
+            "plus uncached programs (every one is a retrace; see "
+            "/debug/device for per-program attribution).",
+        )
+        self.compile_duration_seconds = counter(
+            "gubernator_compile_duration_seconds",
+            "Cumulative wall seconds spent in XLA backend compiles.",
         )
         self.engine_table_occupancy = Gauge(
             "gubernator_engine_table_occupancy",
@@ -1178,6 +1253,26 @@ def engine_sync(engine):
             m.global_overflow_drops.set(engine.overflow_drops)
             m.global_sync_backlog.set(getattr(engine, "sync_backlog", 0))
             m.ici_full_ticks.set(getattr(engine, "full_ticks", 0))
+        if hasattr(engine, "device_memory"):
+            # Host-side arithmetic over static geometry + one allocator
+            # stats query — no device program runs (GL009 stays clean).
+            d = engine.device_memory()
+            m.device_bytes_in_use.set(d["bytes_in_use"])
+            m.device_bytes_limit.set(d["bytes_limit"])
+            m.device_headroom_bytes.set(d["headroom_bytes"])
+            m.device_unattributed_bytes.set(d["unattributed_bytes"])
+            for name, b in d["subsystems"].items():
+                m.device_subsystem_bytes.labels(name).set(b)
+        # Compile telemetry is process-global (the jax.monitoring
+        # listener); bridging it from every engine's sync is an
+        # idempotent monotonic set. Lazy import: the runtime package
+        # pulls jax, and catalog_names() must import without it.
+        from gubernator_tpu.runtime import telemetry as _rt
+
+        cc = _rt.compile_counters()
+        m.compile_cache_hits.set(cc["cache_hits"])
+        m.compile_count.set(cc["compiles"])
+        m.compile_duration_seconds.set(cc["compile_seconds"])
 
     return _sync
 

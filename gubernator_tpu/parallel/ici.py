@@ -47,6 +47,7 @@ from gubernator_tpu.api.types import Behavior
 from gubernator_tpu.models.bucket import FIXED_SHIFT
 from gubernator_tpu.ops.kernels import get_raw_kernels
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
+from gubernator_tpu.utils import transfer
 from gubernator_tpu.utils.jaxcompat import shard_map
 
 AXIS = "owners"
@@ -75,7 +76,8 @@ class IciState(NamedTuple):
 
 
 def create_ici_state(
-    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT
+    mesh: Mesh, num_slots: int, ways: int = 1, layout: str = DEFAULT_LAYOUT,
+    metrics=None,
 ) -> IciState:
     n_dev = mesh.devices.size
     assert num_slots % ways == 0, "num_slots must divide by ways"
@@ -85,16 +87,21 @@ def create_ici_state(
     )
     sharding = NamedSharding(mesh, P(AXIS))
     table = get_raw_kernels(layout).create(num_groups, ways)
-    stacked = jax.tree.map(
-        lambda x: jax.device_put(
-            jnp.broadcast_to(x[None], (n_dev,) + x.shape), sharding
+    # The replica-tier placement rides the accounted transfer wrapper
+    # (utils/transfer.py, GL010): one h2d "warmup" ledger entry for the
+    # stacked replicas, one for each delta buffer.
+    stacked = transfer.put_tree(
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n_dev,) + x.shape), table
         ),
-        table,
+        sharding, metrics=metrics,
     )
-    pending = jax.device_put(
-        jnp.zeros((n_dev, num_slots), dtype=I64), sharding
+    pending = transfer.device_put(
+        jnp.zeros((n_dev, num_slots), dtype=I64), sharding, metrics=metrics
     )
-    tick = jax.device_put(jnp.zeros((n_dev,), dtype=I64), sharding)
+    tick = transfer.device_put(
+        jnp.zeros((n_dev,), dtype=I64), sharding, metrics=metrics
+    )
     return IciState(table=stacked, pending=pending, tick=tick)
 
 

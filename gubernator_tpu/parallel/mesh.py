@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.ops.kernels import get_raw_kernels
 from gubernator_tpu.ops.layout import DecideOutput, RequestBatch, SlotTable
+from gubernator_tpu.utils import transfer
 from gubernator_tpu.utils.jaxcompat import shard_map
 
 AXIS = "owners"
@@ -41,15 +42,18 @@ def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
 
 
 def create_sharded_table(
-    mesh: Mesh, num_groups: int, ways: int = 8, layout: str = DEFAULT_LAYOUT
+    mesh: Mesh, num_groups: int, ways: int = 8, layout: str = DEFAULT_LAYOUT,
+    metrics=None,
 ):
     """Layout-native table sharded along the slot axis; contiguous groups
-    per device (num_groups must divide evenly by mesh size)."""
+    per device (num_groups must divide evenly by mesh size). The shard
+    placement rides the accounted transfer wrapper (utils/transfer.py,
+    GL010): one h2d "warmup" ledger entry for the whole table."""
     n_dev = mesh.devices.size
     assert num_groups % n_dev == 0, "num_groups must be divisible by mesh size"
     sharding = NamedSharding(mesh, P(AXIS))
     table = get_raw_kernels(layout).create(num_groups, ways)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), table)
+    return transfer.put_tree(table, sharding, metrics=metrics)
 
 
 def make_sharded_decide(

@@ -47,6 +47,7 @@ from gubernator_tpu.ops.kernels import get_census, get_kernels
 from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
+from gubernator_tpu.utils import transfer as _transfer
 
 
 class TableCommittedError(RuntimeError):
@@ -190,6 +191,40 @@ class EngineMetrics:
 
     def observe_stage(self, stage: str, dur: float) -> None:
         self._stage[stage].observe(dur)
+
+    def observe_transfer(self, direction: str, purpose: str,
+                         n_bytes: int, dur: float) -> None:
+        """One accounted host<->device transfer (utils/transfer.py):
+        per-(direction, purpose) bytes + latency distributions — the
+        promote/demote bandwidth ledger (docs/monitoring.md "Device
+        resources")."""
+        self.transfer_duration.labels(direction, purpose).observe(dur)
+        self.transfer_bytes.labels(direction, purpose).observe(n_bytes)
+
+    def transfer_snapshot(self) -> dict:
+        """JSON ledger view: per-(direction, purpose) transfer counts,
+        total bytes, and latency quantiles — /debug/device and the
+        bench `device` blob read this."""
+        out = {}
+        for key, s in self.transfer_bytes.label_summaries(qs=()).items():
+            out["/".join(key)] = {
+                "count": s["count"],
+                "bytes": int(s["sum"]),  # guberlint: allow-host-sync -- histogram summary dict, host-only data
+            }
+        for key, s in self.transfer_duration.label_summaries(
+            qs=(0.5, 0.99)
+        ).items():
+            ent = out.setdefault(
+                "/".join(key), {"count": s["count"], "bytes": 0}
+            )
+            ent["seconds"] = s["sum"]
+            ent["p50_s"] = s["p50"]
+            ent["p99_s"] = s["p99"]
+            secs = ent.get("seconds") or 0.0
+            ent["bytes_per_s"] = (
+                ent["bytes"] / secs if secs > 0 else 0.0
+            )
+        return out
 
     def note_cold_compile(self) -> None:
         with self.lock:
@@ -461,7 +496,14 @@ class EngineBase:
         thread-crossing parentage under the flush span."""
         err = None
         try:
-            with tracing.attached(t.otel_ctx):
+            # The completion stage is serving-path device work too: its
+            # materializations must never compile. PR 6 moved them off
+            # the pump thread (whose dispatch-site scope no longer
+            # covers them), so mark this thread for the ticket's
+            # duration or a completion-side retrace goes uncounted.
+            with _telemetry.serving_scope(self.metrics), tracing.attached(
+                t.otel_ctx
+            ):
                 if t.span is not None:
                     with tracing.span(
                         "engine.complete", level="DEBUG", ticket_seq=t.seq
@@ -607,6 +649,26 @@ class EngineBase:
         if hk is None:
             return {"k": 0, "total_hits": 0, "max_error": 0, "entries": []}
         return hk.snapshot()
+
+    def device_memory(self) -> dict:
+        """Per-subsystem HBM attribution + headroom (utils/devicemem.py,
+        docs/monitoring.md "Device resources"). Host arithmetic over
+        geometry sized at init plus one allocator stats query — never
+        dispatches device work, so the scrape-path sync and
+        /debug/device can call it freely (GL009)."""
+        from gubernator_tpu.utils import devicemem
+
+        subs = dict(getattr(self, "_mem_subsystems", None) or {})
+        # snapshot_staging is transient: report the latest staging
+        # high-water mark (bytes the last snapshot()/restore() staged),
+        # not a phantom always-resident copy.
+        subs["snapshot_staging"] = int(
+            getattr(self, "_snapshot_staging_bytes", 0)
+        )
+        subs.setdefault("ici_replicas", 0)
+        return devicemem.snapshot(
+            subs, device=getattr(self.cfg, "device", None)
+        )
 
     # -- public intake -------------------------------------------------------
 
@@ -1128,6 +1190,11 @@ class DeviceEngine(EngineBase):
             thresholds=self._census_thresholds,
         )
 
+        # HBM attribution (utils/devicemem.py): static geometry sized
+        # once; device_memory() folds in allocator stats per call.
+        self._mem_subsystems = self._memory_subsystems()
+        self._snapshot_staging_bytes = 0
+
         self._warmup()
         self._init_base("gubernator-tpu-engine")
         # Columnar-path batch-width buckets compile in the background; the
@@ -1217,6 +1284,39 @@ class DeviceEngine(EngineBase):
                 return  # engine closing / device issue: keep batch_size only
             self._warm_shapes = self._warm_shapes + (B,)
 
+    def _memory_subsystems(self) -> dict:
+        """Static HBM attribution from engine geometry (bytes, computed
+        once — device_memory() reads this every scrape without touching
+        the device). Estimates, not allocator truth: the gap shows up
+        as unattributed_bytes in the snapshot."""
+        cfg = self.cfg
+        slots = cfg.num_groups * cfg.ways
+        table_b = slots * self.K.bytes_per_slot
+        # Census output: two fixed-width histograms (age/idle), the
+        # fill histogram, the heatmap regions, one bucket per coldness
+        # threshold, and a handful of scalars — all int64.
+        census_b = 8 * (
+            2 * 32
+            + (cfg.ways + 1)
+            + int(cfg.census_heatmap_width)
+            + len(self._census_thresholds)
+            + 16
+        )
+        # In-flight decide outputs pinned by the continuous-batching
+        # ring: depth x waves x batch lanes x ~8 int64 output columns.
+        ring_b = (
+            max(int(cfg.pipeline_depth), 1)
+            * cfg.max_waves
+            * cfg.batch_size
+            * 8
+            * 8
+        )
+        return {
+            "slot_table": table_b,
+            "census": census_b,
+            "pipeline_ring": ring_b,
+        }
+
     def _warmup(self) -> None:
         """Compile the decide AND inject kernels before serving: first XLA
         compilation takes seconds (tens of seconds on TPU), which would
@@ -1226,18 +1326,20 @@ class DeviceEngine(EngineBase):
 
         now = self.now_fn()
         wb = RequestBatch.zeros(self.cfg.batch_size)
-        table, out = self.K.decide(
-            self.table, wb, now, self.cfg.ways, self.store is not None
-        )
-        np.asarray(out.status)
-        table, _, _ = self.K.inject(
-            table, InjectBatch.zeros(self.cfg.batch_size), now, self.cfg.ways
-        )
-        np.asarray(table.used[:1])
-        # Census compiles here too: the first /metrics or /debug/table
-        # scrape must dispatch a warm program, not pay a compile.
-        c = self._census(table, now)
-        np.asarray(c.live)  # guberlint: allow-host-sync -- warmup: compile the census program before serving
+        with _transfer.account(self.metrics, "d2h", "warmup") as tx:
+            table, out = self.K.decide(
+                self.table, wb, now, self.cfg.ways, self.store is not None
+            )
+            tx.add(np.asarray(out.status))
+            table, _, _ = self.K.inject(
+                table, InjectBatch.zeros(self.cfg.batch_size), now,
+                self.cfg.ways,
+            )
+            tx.add(np.asarray(table.used[:1]))
+            # Census compiles here too: the first /metrics or /debug/table
+            # scrape must dispatch a warm program, not pay a compile.
+            c = self._census(table, now)
+            tx.add(np.asarray(c.live))  # guberlint: allow-host-sync -- warmup: compile the census program before serving
         self.table = table
 
     def warm_store_path(self) -> None:
@@ -1250,22 +1352,24 @@ class DeviceEngine(EngineBase):
         cfg = self.cfg
         z64 = np.zeros(B, np.int64)
         now = self.now_fn()
-        with self._lock:
+        with self._lock, _transfer.account(
+            self.metrics, "d2h", "warmup"
+        ) as tx:
             table, out = self.K.decide(
                 self.table, RequestBatch.zeros(B), now, cfg.ways, True
             )
-            np.asarray(out.status)
+            tx.add(np.asarray(out.status))
             self.table = table
-            np.asarray(
+            tx.add(np.asarray(
                 self.K.probe_exists(
                     table, z64, z64, np.zeros(B, np.int32), now, cfg.ways
                 )
-            )
-            np.asarray(
+            ))
+            tx.add(np.asarray(
                 self.K.gather_rows(
                     table, np.full(B, table.num_slots, np.int64)
                 ).used
-            )
+            ))
 
     # ---- introspection -----------------------------------------------------
 
@@ -1302,16 +1406,18 @@ class DeviceEngine(EngineBase):
         now = self.now_fn()
         with self._lock:
             out = self._census(self.table, now)
-        tier = _census_tier_snapshot(
-            out,
-            now=now,
-            layout=cfg.layout,
-            groups=cfg.num_groups,
-            ways=cfg.ways,
-            bytes_per_slot=self.K.bytes_per_slot,
-            thresholds=self._census_thresholds,
-            heatmap_width=int(cfg.census_heatmap_width),
-        )
+        with _transfer.account(self.metrics, "d2h", "census") as tx:
+            tier = _census_tier_snapshot(
+                out,
+                now=now,
+                layout=cfg.layout,
+                groups=cfg.num_groups,
+                ways=cfg.ways,
+                bytes_per_slot=self.K.bytes_per_slot,
+                thresholds=self._census_thresholds,
+                heatmap_width=int(cfg.census_heatmap_width),
+            )
+            tx.add(out)
         return _census_combine({"device": tier}, primary="device")
 
     def hotkeys_snapshot(self) -> dict:
@@ -1345,9 +1451,11 @@ class DeviceEngine(EngineBase):
         def mat(col):
             return np.asarray(col).reshape(n, W)  # guberlint: allow-host-sync -- hotkeys census join: O(K x ways) rows at debug cadence, outside the serving lock
 
-        r_hi, r_lo = mat(rows.key_hi), mat(rows.key_lo)
-        r_used, r_lru = mat(rows.used), mat(rows.lru)
-        r_dur, r_exp = mat(rows.duration), mat(rows.expire_at)
+        with _transfer.account(self.metrics, "d2h", "census") as tx:
+            r_hi, r_lo = mat(rows.key_hi), mat(rows.key_lo)
+            r_used, r_lru = mat(rows.used), mat(rows.lru)
+            r_dur, r_exp = mat(rows.duration), mat(rows.expire_at)
+            tx.add((r_hi, r_lo, r_used, r_lru, r_dur, r_exp))
         now = self.now_fn()
         cold_k = self._census_thresholds[
             min(1, len(self._census_thresholds) - 1)
@@ -1511,6 +1619,11 @@ class DeviceEngine(EngineBase):
             items=len(items), waves=len(waves),
             batch_width=len(items) - len(carry),
         )
+        widths = [int(w.active.shape[0]) for w in waves]  # guberlint: allow-host-sync -- static shape metadata, no device readback
+        # Retrace attribution (runtime/telemetry.py): stamp this
+        # thread's shape signature so a compile observed during the
+        # flush names the widths that retraced, not just the program.
+        _telemetry.set_shape_hint(f"{cfg.layout}:object:{widths}")
         t_dev = time.perf_counter()
         try:
             with _telemetry.serving_scope(self.metrics), tracing.use_span_ctx(
@@ -1527,7 +1640,7 @@ class DeviceEngine(EngineBase):
             rows=wave_rows_host, events=events,
             served=len(items) - len(carry), carry_n=len(carry),
             waves=len(waves),
-            widths=[int(w.active.shape[0]) for w in waves],  # guberlint: allow-host-sync -- static shape metadata, no device readback
+            widths=widths,
             t0=t0, t_dev=t_dev, seq=seq, span=fspan,
             otel_ctx=tracing.context_of(fspan),
             trace_id=tracing.trace_id_of(fspan),
@@ -1544,6 +1657,12 @@ class DeviceEngine(EngineBase):
         host = [_materialize_out(o) for o in t.outs]
         t_sync = time.perf_counter()
         dev_s = t_sync - t.t_dev
+        # Transfer ledger: the serve-path d2h readback. Duration is the
+        # blocking sync (copy + any pending compute it waited on).
+        _transfer.record(
+            self.metrics, "d2h", "serve", _transfer.nbytes(host),
+            t_sync - t_c0,
+        )
 
         if cfg.keep_key_strings:
             self._drop_displaced_strings(t.events)
@@ -1805,6 +1924,7 @@ class DeviceEngine(EngineBase):
                 lane_reqs[w] = {
                     lane_l[j]: (j, hi_l[j], lo_l[j]) for j in by_wave[w]
                 }
+        _telemetry.set_shape_hint(f"{cfg.layout}:columnar:{W}x{B}")
         t_dev = time.perf_counter()
         with _telemetry.serving_scope(self.metrics), tracing.span(
             "engine.flush", level="DEBUG", path="columnar", items=n, waves=W,
@@ -1815,7 +1935,11 @@ class DeviceEngine(EngineBase):
                 req_resolver=resolver,
             )
 
-            status, r_limit, remaining, reset_time = _stack_wave_outputs(outs)
+            with _transfer.account(self.metrics, "d2h", "serve") as tx:
+                status, r_limit, remaining, reset_time = (
+                    _stack_wave_outputs(outs)
+                )
+                tx.add((status, r_limit, remaining, reset_time))
         dev_s = time.perf_counter() - t_dev
         flush_trace_id = tracing.trace_id_of(fspan)
 
@@ -1894,9 +2018,15 @@ class DeviceEngine(EngineBase):
                     outs.append(out)
                     if store is not None:
                         rows = self.K.gather_rows(table, out.slot)
-                        wave_rows_host.append(jax.tree.map(np.asarray, rows))
-                        ehi = np.asarray(out.evicted_hi)
-                        elo = np.asarray(out.evicted_lo)
+                        with _transfer.account(
+                            self.metrics, "d2h", "serve"
+                        ) as tx:
+                            rows_h = jax.tree.map(np.asarray, rows)
+                            tx.add(rows_h)
+                            ehi = np.asarray(out.evicted_hi)
+                            elo = np.asarray(out.evicted_lo)
+                            tx.add((ehi, elo))
+                        wave_rows_host.append(rows_h)
                         for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
                             events.append(("d", (int(ehi[j]), int(elo[j]))))
                         for lane, entry in lane_reqs[w].items():
@@ -2011,7 +2141,9 @@ class DeviceEngine(EngineBase):
             ib.invalid_at[j] = int(getattr(s, "invalid_at", 0))
             ib.burst[j] = s.burst
             ib.active[j] = True
-        table, ehi, elo = self.K.inject(table, ib, now, cfg.ways)
+        with _transfer.account(self.metrics, "h2d", "inject") as tx:
+            table, ehi, elo = self.K.inject(table, ib, now, cfg.ways)
+            tx.add(ib)
         ehi = np.asarray(ehi)
         elo = np.asarray(elo)
         for j in np.nonzero((ehi != 0) | (elo != 0))[0]:
@@ -2122,10 +2254,13 @@ class DeviceEngine(EngineBase):
         n = self.cfg.num_groups * self.cfg.ways
         if len(self._key_strings) <= max(2 * n, 4096):
             return
-        with self._lock:
+        with self._lock, _transfer.account(
+            self.metrics, "d2h", "census"
+        ) as tx:
             used = np.asarray(self.table.used)
             hi = np.asarray(self.table.key_hi)[used]
             lo = np.asarray(self.table.key_lo)[used]
+            tx.add((used, hi, lo))
         live = set(zip(hi.tolist(), lo.tolist()))
         with self._keys_lock:
             self._key_strings = {
@@ -2234,8 +2369,12 @@ class DeviceEngine(EngineBase):
 
         with self._lock:
             table = self.table
-            for ib in asm.waves:
-                table, _ehi, _elo = self.K.inject(table, ib, now, cfg.ways)
+            with _transfer.account(self.metrics, "h2d", "inject") as tx:
+                for ib in asm.waves:
+                    table, _ehi, _elo = self.K.inject(
+                        table, ib, now, cfg.ways
+                    )
+                    tx.add(ib)
             self.table = table
 
     # ---- snapshot / restore (Loader seam, task: store) ---------------------
@@ -2245,7 +2384,10 @@ class DeviceEngine(EngineBase):
         reference store.go:76-78; SURVEY.md §5 checkpoint/resume)."""
         with self._lock:
             tbl = self.K.to_wide(self.table)  # canonical wide snapshot
-            host = {f: np.asarray(getattr(tbl, f)) for f in tbl._fields}
+            with _transfer.account(self.metrics, "d2h", "snapshot") as tx:
+                host = {f: np.asarray(getattr(tbl, f)) for f in tbl._fields}
+                tx.add(host)
+            self._snapshot_staging_bytes = tx.bytes
         with self._keys_lock:
             host["key_strings"] = dict(self._key_strings)
         return host
@@ -2257,7 +2399,12 @@ class DeviceEngine(EngineBase):
         locks (the pump/executor threads read both); invalidation state
         lives in the table's own invalid_at column, which the per-wave
         read-through probe consults directly."""
-        fields = {f: jax.numpy.asarray(snap[f]) for f in SlotTable._fields}
+        with _transfer.account(self.metrics, "h2d", "snapshot") as tx:
+            fields = {
+                f: jax.numpy.asarray(snap[f]) for f in SlotTable._fields
+            }
+            tx.add(fields)
+        self._snapshot_staging_bytes = tx.bytes
         with self._lock:
             self.table = self.K.from_wide(SlotTable(**fields))
         with self._keys_lock:

@@ -64,6 +64,7 @@ from gubernator_tpu.runtime.engine import (
 from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
+from gubernator_tpu.utils import transfer as _transfer
 
 log = logging.getLogger("gubernator_tpu.ici")
 
@@ -145,7 +146,8 @@ class IciEngine(EngineBase):
 
         # Owner-sharded authoritative path
         self.table = pmesh.create_sharded_table(
-            self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
+            self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout,
+            metrics=self.metrics,
         )
         self._decide = pmesh.make_sharded_decide(
             self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
@@ -154,7 +156,8 @@ class IciEngine(EngineBase):
         # GLOBAL replica path
         self.num_rgroups = cfg.num_slots // cfg.replica_ways
         self.ici_state = ici.create_ici_state(
-            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
+            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout,
+            metrics=self.metrics,
         )
         self._replica = ici.make_replica_decide(
             self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
@@ -199,6 +202,29 @@ class IciEngine(EngineBase):
             thresholds=self._census_thresholds,
             stacked=True,
         )
+
+        # HBM attribution (utils/devicemem.py): static geometry sized
+        # once; EngineBase.device_memory() folds in allocator stats.
+        bps = BYTES_PER_SLOT[cfg.layout]
+        census_b = 8 * (
+            2 * 32
+            + (cfg.ways + 1) + (cfg.replica_ways + 1)
+            + 2 * int(cfg.census_heatmap_width)
+            + 2 * len(self._census_thresholds)
+            + 32
+        )
+        self._mem_subsystems = {
+            "slot_table": cfg.num_groups * cfg.ways * bps,
+            # Every device carries a full GLOBAL replica (table +
+            # pending deltas + tick scalar, ops/ici.py).
+            "ici_replicas": self.n_dev * cfg.num_slots * (bps + 8) + 8 * self.n_dev,
+            "census": census_b,
+            "pipeline_ring": (
+                max(int(cfg.pipeline_depth), 1)
+                * cfg.max_waves * cfg.batch_size * 8 * 8
+            ),
+        }
+        self._snapshot_staging_bytes = 0
 
         self._lock = lockorder.make_lock("ici_engine.state")
         self._home_rr = 0
@@ -247,7 +273,9 @@ class IciEngine(EngineBase):
                         self.full_ticks += 1
                         sync = self._sync_full
                 self.ici_state, diag = sync(self.ici_state, now)
-                d = np.asarray(diag)
+                with _transfer.account(self.metrics, "d2h", "census") as tx:
+                    d = np.asarray(diag)
+                    tx.add(d)
             # kept/dropped cover groups merged THIS tick; under a capped
             # backlog, retained keys in unmerged groups surface when
             # their group's turn comes. The backlog gauge (identical on
@@ -303,8 +331,10 @@ class IciEngine(EngineBase):
             asm.commit(w, slot)
         with self._lock:
             state = self.ici_state
-            for ib in asm.waves:
-                state = self._inject_replicas(state, ib, now)
+            with _transfer.account(self.metrics, "h2d", "inject") as tx:
+                for ib in asm.waves:
+                    state = self._inject_replicas(state, ib, now)
+                    tx.add(ib)
             self.ici_state = state
 
     def check_columns(
@@ -387,6 +417,9 @@ class IciEngine(EngineBase):
             homes_wb[r_ix] = homes
 
         s_outs, r_outs = [], []
+        _telemetry.set_shape_hint(
+            f"{cfg.layout}:ici-columnar:B{cfg.batch_size}"
+        )
         t_dev = time.perf_counter()
         with self._lock, _telemetry.serving_scope(self.metrics), tracing.span(
             "engine.flush", level="DEBUG", path="columnar", items=n,
@@ -429,20 +462,22 @@ class IciEngine(EngineBase):
         reset_time = np.zeros(n, np.int64)
         waves_total = 0
         tots = [0, 0, 0, 0]
-        for outs, asm, idx in (
-            (s_outs, s_asm, ng_idx), (r_outs, r_asm, g_idx),
-        ):
-            if asm is None:
-                continue
-            st, li, re, rt = _stack_wave_outputs(outs)
-            ix = asm[3]
-            status[idx] = st[ix]
-            r_limit[idx] = li[ix]
-            remaining[idx] = re[ix]
-            reset_time[idx] = rt[ix]
-            waves_total += asm[4]
-            for j, v in enumerate(_wave_totals(outs)):
-                tots[j] += v
+        with _transfer.account(self.metrics, "d2h", "serve") as tx:
+            for outs, asm, idx in (
+                (s_outs, s_asm, ng_idx), (r_outs, r_asm, g_idx),
+            ):
+                if asm is None:
+                    continue
+                st, li, re, rt = _stack_wave_outputs(outs)
+                tx.add((st, li, re, rt))
+                ix = asm[3]
+                status[idx] = st[ix]
+                r_limit[idx] = li[ix]
+                remaining[idx] = re[ix]
+                reset_time[idx] = rt[ix]
+                waves_total += asm[4]
+                for j, v in enumerate(_wave_totals(outs)):
+                    tots[j] += v
         dev_s = time.perf_counter() - t_dev
         dur = time.perf_counter() - t_start
         flush_trace_id = tracing.trace_id_of(fspan)
@@ -493,13 +528,14 @@ class IciEngine(EngineBase):
         rebuilt = False
         if consumed(self.table):
             self.table = pmesh.create_sharded_table(
-                self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
+                self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout,
+                metrics=self.metrics,
             )
             rebuilt = True
         if consumed(self.ici_state):
             self.ici_state = ici.create_ici_state(
                 self.mesh, cfg.num_slots, cfg.replica_ways,
-                layout=cfg.layout,
+                layout=cfg.layout, metrics=self.metrics,
             )
             rebuilt = True
         return rebuilt
@@ -577,22 +613,26 @@ class IciEngine(EngineBase):
     def _warmup(self) -> None:
         now = self.now_fn()
         wb = RequestBatch.zeros(self.cfg.batch_size)
-        self.table, out = self._decide(self.table, wb, now)
-        np.asarray(out.status)
-        home = np.zeros(self.cfg.batch_size, dtype=np.int64)
-        self.ici_state, out2 = self._replica(self.ici_state, wb, home, now)
-        np.asarray(out2.status)
-        self.ici_state, _diag = self._sync(self.ici_state, now)
-        if self._sync_full is not None:
-            # Warm the backstop program too — its first forced tick must
-            # not pay a cold compile on the 100ms cadence.
-            self.ici_state, _diag = self._sync_full(self.ici_state, now)
-        # Census compiles here for both tiers: the first /metrics or
-        # /debug/table scrape must dispatch warm programs, not compile.
-        cs = self._census_sharded(self.table, now)
-        cr = self._census_replica(self.ici_state.table, now)
-        np.asarray(cs.live)  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
-        np.asarray(cr.live)  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
+        with _transfer.account(self.metrics, "d2h", "warmup") as tx:
+            self.table, out = self._decide(self.table, wb, now)
+            tx.add(np.asarray(out.status))
+            home = np.zeros(self.cfg.batch_size, dtype=np.int64)
+            self.ici_state, out2 = self._replica(
+                self.ici_state, wb, home, now
+            )
+            tx.add(np.asarray(out2.status))
+            self.ici_state, _diag = self._sync(self.ici_state, now)
+            if self._sync_full is not None:
+                # Warm the backstop program too — its first forced tick
+                # must not pay a cold compile on the 100ms cadence.
+                self.ici_state, _diag = self._sync_full(self.ici_state, now)
+            # Census compiles here for both tiers: the first /metrics or
+            # /debug/table scrape must dispatch warm programs, not
+            # compile.
+            cs = self._census_sharded(self.table, now)
+            cr = self._census_replica(self.ici_state.table, now)
+            tx.add(np.asarray(cs.live))  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
+            tx.add(np.asarray(cr.live))  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
         # Final fence: __init__ returns with every program compiled and
         # the replica state resident.
         jax.block_until_ready(self.ici_state.pending)
@@ -683,6 +723,7 @@ class IciEngine(EngineBase):
             items=len(items), waves=waves_total,
             batch_width=len(items) - len(carry),
         )
+        _telemetry.set_shape_hint(f"{cfg.layout}:ici-object:B{B}")
         t_dev = time.perf_counter()
         try:
             with self._lock, _telemetry.serving_scope(
@@ -729,6 +770,11 @@ class IciEngine(EngineBase):
         }
         t_sync = time.perf_counter()
         dev_s = t_sync - t.t_dev
+        # Transfer ledger: the serve-path d2h readback (blocking sync).
+        _transfer.record(
+            self.metrics, "d2h", "serve", _transfer.nbytes(host),
+            t_sync - t_c0,
+        )
         tots = [0, 0, 0, 0]
         for path in host.values():
             for h in path:

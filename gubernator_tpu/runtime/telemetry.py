@@ -36,10 +36,44 @@ from typing import Optional
 from gubernator_tpu.utils import lockorder
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 _tls = threading.local()
 _install_lock = lockorder.make_lock("telemetry.install")
 _installed = False
+
+# Process-wide compile telemetry (docs/monitoring.md "Device
+# resources"): every backend compile is a retrace somewhere — these
+# feed the gubernator_compile_* families and the /debug/device
+# attribution table. Bounded: counters + a fixed-size recent-retrace
+# ring.
+_RETRACE_KEEP = 64
+_compile_lock = lockorder.make_lock("telemetry.compile_stats")
+_compile_counts = {"compiles": 0, "compile_seconds": 0.0, "cache_hits": 0}
+_retraces: collections.deque = collections.deque(maxlen=_RETRACE_KEEP)
+
+
+def _program_from_stack() -> str:
+    """Attribute a compile to the outermost gubernator_tpu frame on the
+    compiling thread's stack ("path:function:line" — which jitted
+    program retraced). Stack-walk attribution is jax-version-
+    independent: the duration event carries no program metadata."""
+    import traceback
+
+    for fr in traceback.extract_stack():
+        fn = fr.filename or ""
+        if "gubernator_tpu" in fn:
+            mod = fn.split("gubernator_tpu", 1)[-1].lstrip("/\\")
+            return f"{mod}:{fr.name}:{fr.lineno}"
+    return ""
+
+
+def set_shape_hint(hint: str) -> None:
+    """Stamp this thread's current dispatch shape signature (one cheap
+    attribute write per flush). A compile observed on this thread
+    attributes to the stamped signature — the "which shape retraced"
+    half of compile attribution."""
+    _tls.shape_hint = hint
 
 
 def _on_event_duration(event: str, duration: float, **kw) -> None:
@@ -50,13 +84,58 @@ def _on_event_duration(event: str, duration: float, **kw) -> None:
     owner = getattr(_tls, "owner", None)
     if owner is not None:
         owner.note_cold_compile()
+    entry = {
+        "ts": time.time(),
+        "duration_s": float(duration),
+        "program": _program_from_stack(),
+        "shape": getattr(_tls, "shape_hint", ""),
+        "thread": threading.current_thread().name,
+        "serving": owner is not None,
+    }
+    with _compile_lock:
+        _compile_counts["compiles"] += 1
+        _compile_counts["compile_seconds"] += float(duration)
+        _retraces.append(entry)
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == _CACHE_HIT_EVENT:
+        with _compile_lock:
+            _compile_counts["cache_hits"] += 1
+
+
+def compile_counters() -> dict:
+    """Process-wide compile counters: backend compiles (every one is a
+    cache miss or an uncached program), cumulative compile seconds, and
+    persistent-cache hits. Zeros until the listener installs."""
+    with _compile_lock:
+        return dict(_compile_counts)
+
+
+def compile_attribution() -> dict:
+    """Retrace attribution for /debug/device: the bounded ring of
+    recent compiles (program, shape signature, thread, serving flag)
+    plus per-program aggregates."""
+    with _compile_lock:
+        recent = list(_retraces)
+        counts = dict(_compile_counts)
+    by_program: dict = {}
+    for e in recent:
+        agg = by_program.setdefault(
+            e["program"] or "<external>",
+            {"count": 0, "total_s": 0.0, "serving": 0},
+        )
+        agg["count"] += 1
+        agg["total_s"] += e["duration_s"]
+        agg["serving"] += int(e["serving"])  # guberlint: allow-host-sync -- retrace ring entry, host-only dict
+    return {"counters": counts, "recent": recent, "by_program": by_program}
 
 
 def install_compile_listener() -> bool:
-    """Idempotently register the process-global jax.monitoring listener.
-    Returns False when jax (or its monitoring API) is unavailable —
-    cold-compile detection then degrades to a permanent 0, never an
-    import error."""
+    """Idempotently register the process-global jax.monitoring
+    listeners (compile durations + cache-hit events). Returns False
+    when jax (or its monitoring API) is unavailable — compile telemetry
+    then degrades to permanent zeros, never an import error."""
     global _installed
     with _install_lock:
         if _installed:
@@ -69,6 +148,10 @@ def install_compile_listener() -> bool:
             )
         except Exception:
             return False
+        try:
+            jax.monitoring.register_event_listener(_on_event)
+        except Exception:
+            pass  # older jax: plain-event API absent — hits stay 0
         _installed = True
         return True
 

@@ -290,6 +290,16 @@ class DaemonConfig:
     census_thresholds: tuple = (1, 4, 16)
     census_heatmap_width: int = 64
 
+    # Continuous profiling (docs/monitoring.md "Device resources"):
+    # GUBER_PROFILE_INTERVAL > 0 starts a background sampler that takes
+    # a GUBER_PROFILE_SECONDS-long jax.profiler capture each interval,
+    # keeping the newest GUBER_PROFILE_KEEP trace dirs on disk
+    # (service/profiler.py). Default off — captures cost real device
+    # time and trace bytes; an explicit operator opt-in.
+    profile_interval_s: float = 0.0
+    profile_seconds: float = 0.5
+    profile_keep: int = 8
+
     def engine_config(self) -> EngineConfig:
         if self.engine is not None:
             return self.engine

@@ -246,6 +246,22 @@ class Daemon:
             self.svc.auditor = self._auditor
             self._auditor.start()
 
+        # Continuous profiler (docs/monitoring.md "Device resources"):
+        # off unless GUBER_PROFILE_INTERVAL > 0. Shares the one-capture-
+        # at-a-time guard with /debug/profile; trace dirs rotate, so an
+        # unattended soak holds profile_keep traces, not thousands.
+        self._profiler = None
+        if float(getattr(conf, "profile_interval_s", 0.0)) > 0:
+            from gubernator_tpu.service.profiler import ContinuousProfiler
+
+            self._profiler = ContinuousProfiler(
+                conf.profile_interval_s,
+                seconds=conf.profile_seconds,
+                keep=conf.profile_keep,
+            )
+            self.svc.profiler = self._profiler
+            self._profiler.start()
+
         # Discovery pool pushes membership through set_peers
         # (reference daemon.go:208-243). Unknown/unavailable backends fail
         # fast rather than silently serving as a cluster of one.
@@ -366,6 +382,8 @@ class Daemon:
         # that are mid-handover and report phantom divergence.
         if getattr(self, "_auditor", None) is not None:
             await self._auditor.close()
+        if getattr(self, "_profiler", None) is not None:
+            self._profiler.stop()
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
         # preStop settle (the k8s preStop-sleep analog): calls already on

@@ -192,7 +192,20 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env("GUBER_TABLE_CENSUS_THRESHOLDS")
         ),
         census_heatmap_width=_env_int("GUBER_TABLE_CENSUS_HEATMAP", 64),
+        # Continuous profiling (docs/monitoring.md "Device resources"):
+        # sampler cadence (0 = off), per-capture trace length, and how
+        # many trace dirs the rotation keeps.
+        profile_interval_s=parse_duration_s(
+            _env("GUBER_PROFILE_INTERVAL"), 0.0
+        ),
+        profile_seconds=parse_duration_s(_env("GUBER_PROFILE_SECONDS"), 0.5),
+        profile_keep=_env_int("GUBER_PROFILE_KEEP", 8),
     )
+    if conf.profile_keep < 1:
+        raise ValueError(
+            f"'GUBER_PROFILE_KEEP={conf.profile_keep}' is invalid; the "
+            "rotation must keep at least 1 trace"
+        )
     if conf.census_heatmap_width < 1:
         raise ValueError(
             f"'GUBER_TABLE_CENSUS_HEATMAP={conf.census_heatmap_width}' is "

@@ -25,39 +25,20 @@ from __future__ import annotations
 
 import asyncio
 import json
-import os
-import tempfile
-import threading
-import time
 
 from aiohttp import web
 
-from gubernator_tpu.utils import lockorder
 from gubernator_tpu.service import pb
+from gubernator_tpu.service import profiler as _profiler
 from gubernator_tpu.service.server import ApiError, V1Service
 
 # jax.profiler state is process-global: exactly one capture at a time,
-# regardless of how many daemons/listeners share the process.
-_PROFILE_GUARD = lockorder.make_lock("gateway.profile_guard")
-_PROFILE_MAX_SECONDS = 30.0
-
-
-def _capture_profile(seconds: float) -> dict:
-    """Blocking profiler capture (runs in an executor thread)."""
-    import jax
-
-    trace_dir = tempfile.mkdtemp(prefix="gubernator_profile_")
-    jax.profiler.start_trace(trace_dir)
-    try:
-        time.sleep(seconds)
-    finally:
-        jax.profiler.stop_trace()
-    files = [
-        os.path.join(r, f)
-        for r, _, fs in os.walk(trace_dir)
-        for f in fs
-    ]
-    return {"trace_dir": trace_dir, "seconds": seconds, "files": len(files)}
+# regardless of how many daemons/listeners share the process. The guard
+# and the bounded/rotating capture itself live in service/profiler.py
+# (shared with the continuous sampler); these aliases keep the
+# historical gateway names importable.
+_PROFILE_GUARD = _profiler.PROFILE_GUARD
+_PROFILE_MAX_SECONDS = _profiler.PROFILE_MAX_SECONDS
 
 
 def add_debug_routes(app: web.Application, svc: V1Service) -> None:
@@ -78,13 +59,16 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
             )
         seconds = min(max(seconds, 0.05), _PROFILE_MAX_SECONDS)
         if not _PROFILE_GUARD.acquire(blocking=False):
+            # Captures are short and serialized; tell pollers when to
+            # come back instead of having them hammer the 503.
             return web.json_response(
                 {"error": "a profile capture is already running"},
                 status=503,
+                headers={"Retry-After": str(int(seconds) or 1)},
             )
         try:
             out = await asyncio.get_running_loop().run_in_executor(
-                None, _capture_profile, seconds
+                None, _profiler.capture, seconds
             )
         except Exception as e:
             return web.json_response(
@@ -93,6 +77,19 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         finally:
             _PROFILE_GUARD.release()
         return web.json_response(out)
+
+    async def debug_device(request: web.Request) -> web.Response:
+        """Device-resource observatory (docs/monitoring.md "Device
+        resources"): per-subsystem HBM attribution + headroom, the
+        host<->device transfer ledger, and compile telemetry with
+        retrace attribution. Pure host-side reads (one allocator stats
+        query, histogram summaries, bounded ring copies) — no device
+        program runs (GL009); executor only for the engine attribute
+        reads."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.device_debug_info
+        )
+        return web.json_response(snap)
 
     async def debug_hotkeys(request: web.Request) -> web.Response:
         # Sketch snapshot + census residency join: the join gathers the
@@ -155,6 +152,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/engine", debug_engine)
     app.router.add_get("/debug/hotkeys", debug_hotkeys)
     app.router.add_get("/debug/table", debug_table)
+    app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/cluster", debug_cluster)
 

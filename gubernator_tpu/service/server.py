@@ -78,6 +78,7 @@ class V1Service:
         # and the background divergence auditor, wired by the daemon.
         self._global_last_update: "OrderedDict[str, int]" = OrderedDict()
         self.auditor = None  # ConsistencyAuditor; None when not wired
+        self.profiler = None  # ContinuousProfiler; None when not wired
         # pre-resolved metric children (labels() lookups are hot-loop cost)
         m = self.metrics
         self._m_local = m.getratelimit_counter.labels("local")
@@ -467,6 +468,11 @@ class V1Service:
             info["table_census"] = self.engine.table_census()
         if hasattr(self.engine, "hotkeys_snapshot"):
             info["hotkeys"] = self.engine.hotkeys_snapshot()
+        # Device-resource blob rides the free-form DebugInfo dict too,
+        # so /debug/cluster shows fleet-wide HBM headroom and transfer
+        # bandwidth with no wire-format bump (docs/monitoring.md
+        # "Device resources").
+        info["device"] = self.device_debug_info()
         consistency: dict = {
             "propagation_lag": m.global_propagation_lag.summary(),
             "staleness_keys_tracked": len(self._global_last_update),
@@ -492,6 +498,29 @@ class V1Service:
                 for k in keys
                 if k in self._global_last_update
             }
+        return info
+
+    def device_debug_info(self) -> dict:
+        """/debug/device payload (docs/monitoring.md "Device
+        resources"): per-subsystem HBM attribution + headroom, the
+        host<->device transfer ledger, compile telemetry with retrace
+        attribution, and profiler capture stats. Host-side reads only —
+        allocator stats, histogram summaries, bounded ring copies — so
+        scraping it never dispatches device work (GL009)."""
+        from gubernator_tpu.runtime import telemetry as _rt
+        from gubernator_tpu.utils import compilecache
+
+        info: dict = {"v": 1}
+        if hasattr(self.engine, "device_memory"):
+            info["memory"] = self.engine.device_memory()
+        em = getattr(self.engine, "metrics", None)
+        if em is not None and hasattr(em, "transfer_snapshot"):
+            info["transfers"] = em.transfer_snapshot()
+        info["compile"] = compilecache.cache_stats()
+        info["retraces"] = _rt.compile_attribution()
+        prof = getattr(self, "profiler", None)
+        if prof is not None and hasattr(prof, "stats"):
+            info["profiler"] = prof.stats()
         return info
 
     # ---- peer membership (reference gubernator.go:616-711) -----------------

@@ -25,6 +25,7 @@ import os
 log = logging.getLogger("gubernator.compilecache")
 
 _enabled = False
+_path: str | None = None
 
 DEFAULT_DIR = "/tmp/guber_jax_cache"
 
@@ -33,12 +34,12 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at `path` (default
     $GUBER_COMPILE_CACHE or /tmp/guber_jax_cache). Idempotent; returns
     the cache dir, or None when disabled via GUBER_COMPILE_CACHE=off."""
-    global _enabled
+    global _enabled, _path
     path = path or os.environ.get("GUBER_COMPILE_CACHE") or DEFAULT_DIR
     if path.lower() in ("off", "none", "0", ""):
         return None
     if _enabled:
-        return path
+        return _path
     import jax
 
     # CPU-backed processes skip the cache by default: XLA:CPU AOT reload
@@ -75,4 +76,36 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         except Exception:  # older jax: option absent — defaults are fine
             pass
     _enabled = True
+    _path = path
     return path
+
+
+def cache_stats() -> dict:
+    """Compile-cache observability for /debug/device: whether the
+    persistent cache is live, its on-disk footprint (entry count +
+    bytes), and the process-wide compile counters (hits/compiles/
+    seconds) from the runtime telemetry listener. Disk census is a
+    single scandir — cheap enough for a debug route, not run per
+    scrape."""
+    entries = 0
+    disk_bytes = 0
+    if _enabled and _path:
+        try:
+            with os.scandir(_path) as it:
+                for e in it:
+                    if e.is_file(follow_symlinks=False):
+                        entries += 1
+                        disk_bytes += e.stat(follow_symlinks=False).st_size
+        except OSError:
+            pass
+    # Lazy: runtime package pulls jax; this module must import without.
+    from gubernator_tpu.runtime import telemetry
+
+    out = {
+        "enabled": _enabled,
+        "path": _path,
+        "entries": entries,
+        "disk_bytes": disk_bytes,
+    }
+    out.update(telemetry.compile_counters())
+    return out

@@ -1,0 +1,103 @@
+"""HBM accounting: per-subsystem device-memory attribution + headroom.
+
+The paged slot table (ROADMAP item 1) cannot be built or tuned blind:
+its two governing numbers are "how much HBM does each resident
+structure cost" and "how much headroom is left before the next
+allocation OOMs". This module answers the first from engine geometry
+(each engine names its resident subsystems — slot table, ICI replica
+tier, census buffers, pipeline in-flight ring, snapshot staging — and
+sizes them from bytes_per_slot x capacity) and the second from the
+backend's real per-device allocator stats when they exist.
+
+Two sources, ONE schema (tests/test_device_observatory.py pins parity):
+
+- "device": jax `device.memory_stats()` — real allocator numbers
+  (TPU/GPU backends). bytes_in_use/bytes_limit come from the device;
+  the subsystem map stays the geometry-derived attribution, and the
+  gap is reported as unattributed_bytes.
+- "estimated": the CPU-safe fallback (CPU backends return no memory
+  stats; jax may be absent entirely). bytes_in_use is the sum of the
+  subsystem estimates and the capacity is ESTIMATED_CAPACITY_BYTES —
+  a documented single-chip assumption, not a measurement — so tier-1
+  CPU runs exercise every consumer of the snapshot shape.
+
+Deliberately jax-free at import: jax loads lazily inside
+device_stats(), and a CPU-pinned process never touches it beyond one
+failed stats probe.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+log = logging.getLogger("gubernator_tpu.devicemem")
+
+SCHEMA_VERSION = 1
+
+# Capacity assumption for the estimated fallback, used ONLY when the
+# backend exposes no allocator stats: one v5e core's 16 GiB HBM. The
+# snapshot labels itself source="estimated" so dashboards can tell a
+# real headroom number from this assumption.
+ESTIMATED_CAPACITY_BYTES = 16 << 30
+
+
+def device_stats(device=None) -> Optional[dict]:
+    """Raw allocator stats for `device` (default: the first jax device),
+    or None when unavailable — jax absent, no devices, or a backend
+    (CPU) whose devices expose no memory_stats. Never raises."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    return dict(stats)
+
+
+def snapshot(
+    subsystems: Optional[dict] = None,
+    device=None,
+    capacity_bytes: Optional[int] = None,
+) -> dict:
+    """One device-memory accounting snapshot.
+
+    `subsystems` maps subsystem name -> estimated resident bytes (static
+    geometry, computed once by the engine at init). The returned dict
+    has the SAME keys whether backed by real device stats or the
+    estimated fallback; only `source` distinguishes them."""
+    subs = {k: int(v) for k, v in (subsystems or {}).items()}
+    accounted = sum(subs.values())
+    stats = device_stats(device)
+    if stats is not None:
+        source = "device"
+        in_use = int(stats.get("bytes_in_use", 0))
+        limit = int(
+            stats.get("bytes_limit", 0)
+            or stats.get("bytes_reservable_limit", 0)
+            or 0
+        )
+        peak = int(stats.get("peak_bytes_in_use", in_use))
+    else:
+        source = "estimated"
+        in_use = accounted
+        limit = 0
+        peak = in_use
+    if limit <= 0:
+        limit = int(capacity_bytes or ESTIMATED_CAPACITY_BYTES)
+    headroom = max(limit - in_use, 0)
+    return {
+        "v": SCHEMA_VERSION,
+        "source": source,
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "bytes_limit": limit,
+        "headroom_bytes": headroom,
+        "headroom_frac": headroom / limit if limit else 0.0,
+        "subsystems": subs,
+        "accounted_bytes": accounted,
+        "unattributed_bytes": max(in_use - accounted, 0),
+    }

@@ -127,6 +127,128 @@ def latest(
     return best
 
 
+def _telemetry_p99(rec: dict[str, Any]) -> float | None:
+    """Flush-latency p99 (µs) from a record's telemetry blob, if any.
+    Matches the blob bench.py's _engine_telemetry writes: telemetry.
+    flush_us.p99."""
+    tel = rec.get("telemetry")
+    if not isinstance(tel, dict):
+        return None
+    fu = tel.get("flush_us")
+    if isinstance(fu, dict):
+        v = fu.get("p99")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def gate(
+    *,
+    job: str = "",
+    mode: str = "",
+    layout: str = "",
+    platform: str = "",
+    threshold: float | None = None,
+) -> dict[str, Any]:
+    """Perf regression gate (ROADMAP item 5): compare the FRESHEST ledger
+    row against the BEST prior row for the same (job, mode, layout,
+    platform) tuple. Returns a verdict dict:
+
+      {ok, reason, current, best, threshold, throughput_ratio, p99_ratio}
+
+    Fails (ok=False) when the fresh row's value drops more than
+    `threshold` below the best prior value, or when its telemetry flush
+    p99 inflates more than `threshold` above the best prior row's p99.
+    A ledger with fewer than two matching rows passes vacuously — the
+    gate protects against regressions, it doesn't block first runs.
+
+    `threshold` resolution: explicit arg, else GUBER_GATE_THRESHOLD
+    (read at call time, not import — GL004), else 0.15.
+    """
+    if threshold is None:
+        env = os.environ.get("GUBER_GATE_THRESHOLD")
+        threshold = float(env) if env else 0.15
+    rows = [
+        r
+        for r in load()
+        if r.get("value")
+        and (not job or r.get("job") == job)
+        and (not mode or r.get("mode") == mode)
+        and (not layout or not r.get("layout") or r.get("layout") == layout)
+        and (not platform or r.get("platform") == platform)
+    ]
+    verdict: dict[str, Any] = {
+        "ok": True,
+        "reason": "",
+        "threshold": threshold,
+        "current": None,
+        "best": None,
+        "throughput_ratio": None,
+        "p99_ratio": None,
+    }
+    if not rows:
+        verdict["reason"] = "no matching rows; gate passes vacuously"
+        return verdict
+    current = rows[-1]  # load() is oldest-first
+    # Priors must be comparable to the fresh row: same platform always
+    # (a CPU smoke must never gate against a TPU headline), and same
+    # layout when the caller didn't already pin one.
+    cur_plat = current.get("platform")
+    cur_layout = current.get("layout")
+    prior = [
+        r
+        for r in rows[:-1]
+        if (not cur_plat or r.get("platform") == cur_plat)
+        and (
+            layout
+            or not cur_layout
+            or not r.get("layout")
+            or r.get("layout") == cur_layout
+        )
+    ]
+    if not prior:
+        verdict["reason"] = "no comparable prior rows; gate passes vacuously"
+        verdict["current"] = current
+        return verdict
+    best = max(prior, key=lambda r: float(r.get("value") or 0))
+    verdict["current"] = current
+    verdict["best"] = best
+    cur_v = float(current.get("value") or 0)
+    best_v = float(best.get("value") or 0)
+    if best_v > 0:
+        ratio = cur_v / best_v
+        verdict["throughput_ratio"] = round(ratio, 4)
+        if ratio < 1.0 - threshold:
+            verdict["ok"] = False
+            verdict["reason"] = (
+                f"throughput regression: {cur_v:.6g} is "
+                f"{(1.0 - ratio) * 100:.1f}% below best prior {best_v:.6g} "
+                f"(threshold {threshold * 100:.0f}%)"
+            )
+            return verdict
+    cur_p99 = _telemetry_p99(current)
+    # p99 baseline: the best prior row's p99 when it has one, else the
+    # smallest prior p99 — a row without telemetry shouldn't exempt the
+    # fresh run from the latency gate.
+    best_p99 = _telemetry_p99(best)
+    if best_p99 is None:
+        p99s = [p for p in (_telemetry_p99(r) for r in prior) if p]
+        best_p99 = min(p99s) if p99s else None
+    if cur_p99 is not None and best_p99 is not None:
+        ratio = cur_p99 / best_p99
+        verdict["p99_ratio"] = round(ratio, 4)
+        if ratio > 1.0 + threshold:
+            verdict["ok"] = False
+            verdict["reason"] = (
+                f"p99 inflation: {cur_p99:.6g}s is "
+                f"{(ratio - 1.0) * 100:.1f}% above best prior {best_p99:.6g}s "
+                f"(threshold {threshold * 100:.0f}%)"
+            )
+            return verdict
+    verdict["reason"] = "within threshold"
+    return verdict
+
+
 _MODE_FROM_JOB = re.compile(
     r"(kernel10m|kernel|engine_ab|engine|server|global|latency|edge|ici)"
 )

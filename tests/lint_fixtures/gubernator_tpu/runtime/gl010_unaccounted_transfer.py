@@ -1,0 +1,34 @@
+"""GL010 violation fixture: raw device_put calls that bypass the
+host<->device transfer ledger (utils/transfer)."""
+
+import jax
+from jax import device_put
+
+from gubernator_tpu.utils import transfer as _transfer
+
+
+def raw_attr_call(x, sharding):
+    return jax.device_put(x, sharding)  # fires: raw jax.device_put
+
+
+def raw_bare_call(x):
+    return device_put(x)  # fires: bare `from jax import device_put`
+
+
+def raw_in_loop(tables, sharding):
+    out = []
+    for t in tables:
+        out.append(jax.device_put(t, sharding))  # fires
+    return out
+
+
+def accounted_ok(x, sharding, metrics):
+    return _transfer.device_put(x, sharding, metrics=metrics)
+
+
+def accounted_tree_ok(tree, sharding, metrics):
+    return _transfer.put_tree(tree, sharding, metrics=metrics)
+
+
+def pragma_ok(x):
+    return jax.device_put(x)  # guberlint: allow-unaccounted-transfer -- fixture witness

@@ -204,6 +204,26 @@ def test_prewarm_and_ici_batch_env(monkeypatch):
     assert conf.ici.batch_limit == 250
 
 
+def test_profile_knobs_env(monkeypatch):
+    """GUBER_PROFILE_* must reach DaemonConfig (continuous profiling,
+    docs/monitoring.md "Continuous profiling"); defaults keep the
+    sampler off."""
+    conf = setup_daemon_config()
+    assert conf.profile_interval_s == 0.0  # off by default
+    assert conf.profile_seconds == 0.5
+    assert conf.profile_keep == 8
+    monkeypatch.setenv("GUBER_PROFILE_INTERVAL", "60s")
+    monkeypatch.setenv("GUBER_PROFILE_SECONDS", "250ms")
+    monkeypatch.setenv("GUBER_PROFILE_KEEP", "3")
+    conf = setup_daemon_config()
+    assert conf.profile_interval_s == 60.0
+    assert conf.profile_seconds == 0.25
+    assert conf.profile_keep == 3
+    monkeypatch.setenv("GUBER_PROFILE_KEEP", "0")
+    with pytest.raises(ValueError, match="GUBER_PROFILE_KEEP"):
+        setup_daemon_config()
+
+
 def test_env_validation_errors(monkeypatch):
     import pytest as _pytest
 

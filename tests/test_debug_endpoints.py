@@ -146,8 +146,40 @@ def test_debug_profile_rejects_concurrent_capture(daemon):
         )
         assert r.status_code == 503
         assert "already running" in r.json()["error"]
+        # busy is transient: tell pollers when to retry
+        assert int(r.headers["Retry-After"]) >= 1
     finally:
         gateway._PROFILE_GUARD.release()
+
+
+def test_debug_device_served_on_both_listeners(daemon):
+    for addr in (daemon.http_address, daemon.status_address):
+        r = requests.get(f"http://{addr}/debug/device", timeout=10)
+        assert r.status_code == 200
+        out = r.json()
+        mem = out["memory"]
+        assert mem["source"] in ("device", "estimated")
+        assert mem["bytes_in_use"] > 0 and mem["headroom_bytes"] > 0
+        subs = mem["subsystems"]
+        assert subs["slot_table"] > 0 and "ici_replicas" in subs
+        # the fixture's 20-request batch fed the transfer ledger
+        serve = out["transfers"]["d2h/serve"]
+        assert serve["count"] >= 1 and serve["bytes"] > 0
+        assert "d2h/warmup" in out["transfers"]
+        comp = out["compile"]
+        assert comp["compiles"] >= 0 and "enabled" in comp
+        assert "recent" in out["retraces"]
+        assert "by_program" in out["retraces"]
+
+
+def test_debug_cluster_carries_device_blob(daemon):
+    r = requests.get(
+        f"http://{daemon.http_address}/debug/cluster", timeout=10
+    )
+    assert r.status_code == 200
+    local = r.json()["local"]
+    assert local["device"]["memory"]["bytes_in_use"] > 0
+    assert "transfers" in local["device"]
 
 
 def test_debug_profile_rejects_junk_seconds(daemon):

@@ -1,0 +1,289 @@
+"""Device-resource observatory (docs/monitoring.md "Device resources"):
+HBM accounting schema parity across the real-stats and estimated
+sources, the host<->device transfer ledger on every serving path plus
+snapshot/inject, and the bounded/rotating profiler (on-demand capture
+dirs + the continuous sampler)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.types import RateLimitReq
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.service import profiler
+from gubernator_tpu.store.store import ItemSnapshot
+from gubernator_tpu.utils import devicemem, transfer
+
+NOW = 1_753_700_000_000
+
+
+@pytest.fixture
+def engine():
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=256, ways=8, batch_size=64,
+                     batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    eng._clock = clock
+    yield eng
+    eng.close()
+
+
+def mk(key, **kw):
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 100)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(name="dev", unique_key=key, **kw)
+
+
+# ---------------------------------------------------------------------------
+# devicemem: one schema, two sources
+
+
+def test_snapshot_estimated_vs_device_schema_parity(monkeypatch):
+    subs = {"slot_table": 1000, "census": 24}
+    monkeypatch.setattr(devicemem, "device_stats", lambda device=None: None)
+    est = devicemem.snapshot(subs)
+    monkeypatch.setattr(
+        devicemem,
+        "device_stats",
+        lambda device=None: {
+            "bytes_in_use": 5000,
+            "bytes_limit": 10_000,
+            "peak_bytes_in_use": 6000,
+        },
+    )
+    real = devicemem.snapshot(subs)
+    # parity: identical keys, only `source` tells them apart
+    assert set(est) == set(real)
+    assert est["source"] == "estimated" and real["source"] == "device"
+    # estimated: in_use is the attribution sum, nothing unattributed
+    assert est["bytes_in_use"] == 1024 and est["accounted_bytes"] == 1024
+    assert est["unattributed_bytes"] == 0
+    assert est["bytes_limit"] == devicemem.ESTIMATED_CAPACITY_BYTES
+    # device: allocator numbers win; the gap is unattributed
+    assert real["bytes_in_use"] == 5000
+    assert real["peak_bytes_in_use"] == 6000
+    assert real["unattributed_bytes"] == 5000 - 1024
+    assert real["headroom_bytes"] == 5000
+    assert real["headroom_frac"] == pytest.approx(0.5)
+
+
+def test_snapshot_estimated_capacity_override(monkeypatch):
+    monkeypatch.setattr(devicemem, "device_stats", lambda device=None: None)
+    snap = devicemem.snapshot({"a": 1 << 20}, capacity_bytes=1 << 22)
+    assert snap["bytes_limit"] == 1 << 22
+    assert snap["headroom_bytes"] == (1 << 22) - (1 << 20)
+
+
+def test_device_stats_never_raises_without_stats():
+    # whatever the backend (CPU tier-1: memory_stats absent/None), the
+    # probe returns a dict with bytes_in_use or None — never raises
+    stats = devicemem.device_stats()
+    assert stats is None or "bytes_in_use" in stats
+
+
+def test_engine_device_memory_attribution(engine):
+    mem = engine.device_memory()
+    assert mem["v"] == devicemem.SCHEMA_VERSION
+    subs = mem["subsystems"]
+    cfg = engine.cfg
+    assert subs["slot_table"] == (
+        cfg.num_groups * cfg.ways * engine.K.bytes_per_slot
+    )
+    assert subs["ici_replicas"] == 0  # single-device engine: key present
+    assert subs["census"] > 0 and subs["pipeline_ring"] > 0
+    assert mem["bytes_limit"] > 0
+    assert mem["headroom_bytes"] <= mem["bytes_limit"]
+
+
+# ---------------------------------------------------------------------------
+# transfer: primitives
+
+
+def test_nbytes_recursive():
+    a = np.zeros(10, np.int64)
+    assert transfer.nbytes(a) == 80
+    assert transfer.nbytes({"x": a, "y": [a, (a,)]}) == 240
+    assert transfer.nbytes(None) == 0
+    assert transfer.nbytes("strings do not count") == 0
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.events = []
+
+    def observe_transfer(self, direction, purpose, n_bytes, seconds):
+        self.events.append((direction, purpose, n_bytes, seconds))
+
+
+def test_account_records_on_clean_exit_only():
+    m = _FakeMetrics()
+    with transfer.account(m, "d2h", "serve") as tx:
+        tx.add(np.zeros(8, np.int64))
+        tx.add(64)  # raw byte count
+    assert len(m.events) == 1
+    d, p, nb, secs = m.events[0]
+    assert (d, p, nb) == ("d2h", "serve", 128) and secs >= 0
+    # exceptional exit records nothing
+    with pytest.raises(RuntimeError):
+        with transfer.account(m, "h2d", "inject") as tx:
+            tx.add(64)
+            raise RuntimeError("boom")
+    assert len(m.events) == 1
+    # no-op safety: None metrics and metrics without the hook
+    transfer.record(None, "d2h", "serve", 1, 0.1)
+    transfer.record(object(), "d2h", "serve", 1, 0.1)
+
+
+def test_accounted_device_put_and_put_tree():
+    m = _FakeMetrics()
+    a = np.arange(16, dtype=np.int64)
+    out = transfer.device_put(a, metrics=m, purpose="warmup")
+    assert np.asarray(out).tolist() == a.tolist()
+    tree = {"x": a, "y": a}
+    transfer.put_tree(tree, metrics=m, purpose="inject")
+    assert [(d, p, nb) for d, p, nb, _ in m.events] == [
+        ("h2d", "warmup", 128),
+        ("h2d", "inject", 256),  # one observation for the whole tree
+    ]
+
+
+# ---------------------------------------------------------------------------
+# transfer: the engine's serving paths feed the ledger
+
+
+def test_warmup_and_object_path_feed_ledger(engine):
+    snap = engine.metrics.transfer_snapshot()
+    # _warmup's readbacks were accounted at init
+    assert snap["d2h/warmup"]["count"] >= 1
+    base_serve = snap.get("d2h/serve", {}).get("count", 0)
+    out = engine.check_batch([mk(f"k{i}") for i in range(50)])
+    assert len(out) == 50
+    snap = engine.metrics.transfer_snapshot()
+    serve = snap["d2h/serve"]
+    assert serve["count"] > base_serve
+    assert serve["bytes"] > 0 and serve["bytes_per_s"] > 0
+    assert serve["p99_s"] >= serve["p50_s"] >= 0
+
+
+def test_columnar_path_feeds_ledger(engine):
+    wire = pytest.importorskip("gubernator_tpu.wire")
+    if not wire.available():
+        pytest.skip("native wirepath unavailable")
+    from gubernator_tpu.service import pb
+
+    msg = pb.pb.GetRateLimitsReq()
+    for i in range(20):
+        msg.requests.append(pb.req_to_pb(mk(f"col{i}")))
+    cols = wire.parse_requests(msg.SerializeToString())
+    assert cols is not None
+    base = engine.metrics.transfer_snapshot().get("d2h/serve", {})
+    got = engine.check_columns(cols, now=NOW)
+    assert got is not None
+    serve = engine.metrics.transfer_snapshot()["d2h/serve"]
+    assert serve["count"] > base.get("count", 0)
+    assert serve["bytes"] > base.get("bytes", 0)
+
+
+def test_snapshot_restore_inject_feed_ledger(engine):
+    engine.check_batch([mk(f"s{i}") for i in range(10)])
+    snap = engine.snapshot()
+    engine.restore(snap)
+    engine.inject_snapshots(
+        [
+            ItemSnapshot(key=f"inj{i}", limit=10, duration=60_000,
+                         remaining=5, stamp=NOW, expire_at=NOW + 60_000)
+            for i in range(8)
+        ]
+    )
+    ts = engine.metrics.transfer_snapshot()
+    for key in ("d2h/snapshot", "h2d/snapshot", "h2d/inject"):
+        assert ts[key]["count"] >= 1 and ts[key]["bytes"] > 0, key
+    # the table moved both ways: snapshot staging is a real high-water
+    mem = engine.device_memory()
+    assert mem["subsystems"]["snapshot_staging"] > 0
+    assert mem["subsystems"]["snapshot_staging"] == ts["h2d/snapshot"]["bytes"]
+
+
+def test_store_readthrough_feeds_inject_ledger():
+    from gubernator_tpu.store import MemoryStore, attach_store
+
+    store = MemoryStore()
+    store.data["dev_rt"] = ItemSnapshot(
+        key="dev_rt", limit=10, duration=60_000, remaining=2,
+        stamp=NOW - 1000, expire_at=NOW + 59_000,
+    )
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=256, ways=8, batch_size=64,
+                     batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    attach_store(eng, store)
+    try:
+        eng.check_batch([mk("rt")])
+        ts = eng.metrics.transfer_snapshot()
+        assert ts["h2d/inject"]["count"] >= 1  # read-through probe fed it
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# profiler: rotation bound + continuous sampler
+
+
+def test_rotate_bounds_capture_dirs(tmp_path):
+    for i in range(12):
+        os.makedirs(tmp_path / f"capture-{i:020d}")
+    removed = profiler.rotate(keep=5, root=str(tmp_path))
+    assert removed == 7
+    left = sorted(os.listdir(tmp_path))
+    assert left == [f"capture-{i:020d}" for i in range(7, 12)]
+    # missing root is a no-op, never an error
+    assert profiler.rotate(keep=1, root=str(tmp_path / "nope")) == 0
+
+
+def test_capture_reports_and_rotates(tmp_path):
+    root = str(tmp_path)
+    outs = [profiler.capture(0.05, keep=2, root=root) for _ in range(3)]
+    for out in outs:
+        assert out["seconds"] == 0.05 and out["keep"] == 2
+    last = outs[-1]
+    assert os.path.isdir(last["trace_dir"])
+    assert last["files"] >= 1 and last["bytes"] > 0
+    dirs = [d for d in os.listdir(root) if d.startswith("capture-")]
+    assert len(dirs) == 2  # rotation bound held across captures
+    assert outs[-1]["rotated_out"] == 1
+
+
+def test_continuous_profiler_off_and_guard_sharing(tmp_path):
+    # interval 0 = off: start refuses, nothing runs
+    off = profiler.ContinuousProfiler(0.0, root=str(tmp_path))
+    assert off.start() is False
+    p = profiler.ContinuousProfiler(
+        0.05, seconds=0.05, keep=2, root=str(tmp_path)
+    )
+    # a held guard (an operator's /debug/profile) makes cycles skip
+    assert profiler.PROFILE_GUARD.acquire(blocking=False)
+    try:
+        assert p.start() is True
+        deadline = time.monotonic() + 20
+        while p.skipped < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p.skipped >= 1 and p.captures == 0
+    finally:
+        profiler.PROFILE_GUARD.release()
+    # guard released: the sampler captures, bounded by keep
+    deadline = time.monotonic() + 30
+    while p.captures < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    p.stop()
+    stats = p.stats()
+    assert stats["captures"] >= 1
+    assert stats["last"] and os.path.isdir(stats["last"]["trace_dir"])
+    dirs = [d for d in os.listdir(tmp_path) if d.startswith("capture-")]
+    assert 1 <= len(dirs) <= 2

@@ -109,6 +109,98 @@ def test_infer_platform(led):
     assert led.infer_platform("nothing here") == "unknown"
 
 
+def _row(value, p99=None):
+    r = {"metric": "x (tpu, fused layout)", "value": value,
+         "unit": "decisions/s", "vs_baseline": 1.0}
+    if p99 is not None:
+        r["telemetry"] = {"flush_us": {"p50": 10.0, "p99": p99, "count": 8}}
+    return r
+
+
+def test_gate_flags_throughput_regression(led):
+    led.append(_row(100.0), job="bench_child", mode="kernel",
+               layout="fused", ts=1000.0)
+    led.append(_row(79.0), job="bench_child", mode="kernel",
+               layout="fused", ts=2000.0)  # 21% below best prior
+    v = led.gate(mode="kernel", layout="fused")
+    assert v["ok"] is False
+    assert "throughput regression" in v["reason"]
+    assert v["throughput_ratio"] == pytest.approx(0.79)
+    assert v["current"]["value"] == 79.0 and v["best"]["value"] == 100.0
+    # a looser explicit threshold passes the same ledger
+    assert led.gate(mode="kernel", layout="fused", threshold=0.25)["ok"]
+
+
+def test_gate_passes_within_threshold_env_override(led, monkeypatch):
+    led.append(_row(100.0), job="bench_child", mode="kernel",
+               layout="fused", ts=1000.0)
+    led.append(_row(95.0), job="bench_child", mode="kernel",
+               layout="fused", ts=2000.0)
+    v = led.gate(mode="kernel", layout="fused")
+    assert v["ok"] is True and v["reason"] == "within threshold"
+    # GUBER_GATE_THRESHOLD is read at call time (GL004), not import
+    monkeypatch.setenv("GUBER_GATE_THRESHOLD", "0.01")
+    v = led.gate(mode="kernel", layout="fused")
+    assert v["ok"] is False and v["threshold"] == 0.01
+
+
+def test_gate_flags_p99_inflation(led):
+    led.append(_row(100.0, p99=100.0), job="bench_child", mode="kernel",
+               layout="fused", ts=1000.0)
+    # throughput even improved — the latency gate still fires
+    led.append(_row(101.0, p99=130.0), job="bench_child", mode="kernel",
+               layout="fused", ts=2000.0)
+    v = led.gate(mode="kernel", layout="fused")
+    assert v["ok"] is False
+    assert "p99 inflation" in v["reason"]
+    assert v["p99_ratio"] == pytest.approx(1.3)
+
+
+def test_gate_vacuous_and_platform_isolation(led):
+    # empty ledger and single-row ledger both pass vacuously
+    assert led.gate(mode="kernel")["ok"] is True
+    led.append(_row(100.0), job="bench_child", mode="kernel",
+               layout="fused", ts=1000.0)
+    assert "vacuously" in led.gate(mode="kernel")["reason"]
+    # a CPU smoke row must never gate against the TPU headline
+    led.append(
+        {"metric": "x (cpu, fused layout)", "value": 5.0,
+         "unit": "decisions/s", "vs_baseline": 1.0},
+        job="bench_child", mode="kernel", layout="fused", ts=2000.0,
+    )
+    v = led.gate(mode="kernel", layout="fused")
+    assert v["ok"] is True and "vacuously" in v["reason"]
+
+
+def test_bench_run_gate_prints_verdict(led, capsys):
+    """bench.py --gate plumbing: _run_gate prints one GATE json line and
+    returns the verdict bool the caller turns into the exit code."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench
+
+    led.append(_row(100.0), job="bench_child", mode="kernel",
+               layout="fused", ts=1000.0)
+    led.append(_row(79.0), job="bench_child", mode="kernel",
+               layout="fused", ts=2000.0)
+
+    class Args:
+        mode = "kernel"
+        layout = "fused"
+        layout_explicit = True
+        gate_threshold = None
+
+    assert bench._run_gate(Args) is False
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("GATE "))
+    verdict = json.loads(line[len("GATE "):])
+    assert verdict["ok"] is False
+    assert "throughput regression" in verdict["reason"]
+    # a generous threshold flips it
+    Args.gate_threshold = 0.5
+    assert bench._run_gate(Args) is True
+
+
 def test_runner_watchdog_abandons_hung_job(tmp_path):
     """A job that never returns must not freeze the queue: the watchdog
     writes a timeout marker and the next job still runs (round-3 failure

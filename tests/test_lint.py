@@ -65,7 +65,7 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-        "GL007", "GL008", "GL009",
+        "GL007", "GL008", "GL009", "GL010",
     }
 
 
@@ -136,6 +136,12 @@ _CASES = [
         {"'live_count'", "'occupancy_stats'", "'debug_snapshot'",
          "jax.numpy.sum", "'add_debug_routes'", "'engine_sync'"},
         6,  # table_census internals, pragma'd gather, helper don't fire
+    ),
+    (
+        "GL010",
+        fixture("runtime", "gl010_unaccounted_transfer.py"),
+        {"'raw_attr_call'", "'raw_bare_call'", "'raw_in_loop'"},
+        3,  # accounted wrapper calls + pragma'd site don't fire
     ),
 ]
 

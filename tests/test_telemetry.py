@@ -139,6 +139,39 @@ def test_deliberate_cold_dispatch_is_detected(engine):
     assert engine.metrics.cold_compiles == before
 
 
+def test_completion_thread_compile_is_counted(monkeypatch):
+    """The pipelined engine materializes outputs on the completion
+    thread, outside the pump's dispatch-site serving scope — a compile
+    fired there must still be attributed to the engine (the
+    _complete_ticket serving_scope regression pin)."""
+    from gubernator_tpu.runtime import engine as engine_mod
+
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 8, ways=4, batch_size=32,
+                     batch_wait_s=0.001, pipeline_depth=2),
+        now_fn=lambda: NOW,
+    )
+    try:
+        assert eng.metrics.cold_compiles == 0
+        real = engine_mod._materialize_out
+        fired = {"n": 0}
+        # geometry this process never compiled (48 groups, width 12)
+        scratch = eng.K.create(48, 4)
+
+        def cold_then_real(o):
+            if fired["n"] == 0:
+                fired["n"] = 1
+                eng.K.decide(scratch, RequestBatch.zeros(12), NOW, 4, False)
+            return real(o)
+
+        monkeypatch.setattr(engine_mod, "_materialize_out", cold_then_real)
+        eng.check_batch([mk(f"c{i}") for i in range(10)])
+        assert fired["n"] == 1
+        assert eng.metrics.cold_compiles > 0
+    finally:
+        eng.close()
+
+
 # ---- ICI tier ---------------------------------------------------------------
 
 

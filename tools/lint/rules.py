@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL009.
+"""guberlint rule set GL000-GL010.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -823,6 +823,58 @@ class GL009ScrapeDeviceWork(Rule):
                     f"'{fn}' runs device work per exposition — read the "
                     f"TTL-cached table_census() instead",
                     f"scrape-device:{node.attr}:{fn}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL010 — host->device uploads in runtime//parallel/ must be accounted.
+
+_TRANSFER_SCOPES = ("gubernator_tpu/runtime/", "gubernator_tpu/parallel/")
+
+
+class GL010UnaccountedTransfer(Rule):
+    code = "GL010"
+    name = "unaccounted-transfer"
+    description = (
+        "raw jax.device_put in runtime//parallel/ bypasses the "
+        "host<->device transfer ledger (gubernator_transfer_* families, "
+        "docs/monitoring.md \"Device resources\") — route uploads "
+        "through utils/transfer.device_put/put_tree or wrap the site in "
+        "transfer.account(), or carry an allow-unaccounted-transfer "
+        "pragma with a reason"
+    )
+    requires_reason = True
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if not scan_path(mod.relpath).startswith(_TRANSFER_SCOPES):
+            return []
+        out = []
+        for node, stack in walk_scoped(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # jax.device_put(...) or a bare device_put(...) pulled in via
+            # `from jax import device_put`. The accounted wrapper is
+            # always called through its module (transfer.device_put /
+            # _transfer.device_put), so attribute calls on other bases
+            # pass.
+            if not (
+                _is_name_attr(f, "jax", "device_put")
+                or (isinstance(f, ast.Name) and f.id == "device_put")
+            ):
+                continue
+            fn = func_name(stack)
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"raw device_put in '{fn}' bypasses the transfer "
+                    f"ledger ({unparse(node)[:60]}) — use "
+                    f"utils/transfer.device_put/put_tree so the upload "
+                    f"lands in gubernator_transfer_*",
+                    f"device_put:{fn}",
                 )
             )
         return out
