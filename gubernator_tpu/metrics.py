@@ -1010,6 +1010,31 @@ class Metrics:
             "(inserts that reclaimed an expired/freed resident slot).",
             registry=r,
         )
+        # Paged-table residency (docs/architecture.md "Paged table"):
+        # fed from the census snapshot's "pages" section, present only
+        # when GUBER_TABLE_PAGE_GROUPS enables paging.
+        self.table_page_count = Gauge(
+            "gubernator_table_page_count",
+            "Paged-table pages by state: resident (bound to a physical "
+            "HBM frame), demoted (in the host-DRAM cold tier), free "
+            "(unbound physical frames).",
+            ["state"],
+            registry=r,
+        )
+        self.table_page_moves = Gauge(
+            "gubernator_table_page_moves",
+            "Cumulative page residency transitions: demote (d2h "
+            "evacuation to the host tier), promote (h2d refill from the "
+            "host tier), bind (fresh zeroed frame for a never-resident "
+            "page).",
+            ["kind"],
+            registry=r,
+        )
+        self.table_page_host_bytes = Gauge(
+            "gubernator_table_page_host_bytes",
+            "Host-DRAM bytes held by demoted pages (wide slot rows).",
+            registry=r,
+        )
         self.table_slot_age_seconds = CensusSnapshotHistogram(
             "gubernator_table_slot_age_seconds",
             "Census snapshot: resident slots by age (now - stamp; time "
@@ -1241,6 +1266,15 @@ def engine_sync(engine):
             m.table_slot_idle_seconds.update(
                 c["idle_ms_hist"], c["idle_ms_sum"]
             )
+            pages = c.get("pages")
+            if pages:
+                m.table_page_count.labels("resident").set(pages["resident"])
+                m.table_page_count.labels("demoted").set(pages["host"])
+                m.table_page_count.labels("free").set(pages["free"])
+                m.table_page_moves.labels("demote").set(pages["demotes"])
+                m.table_page_moves.labels("promote").set(pages["promotes"])
+                m.table_page_moves.labels("bind").set(pages["binds"])
+                m.table_page_host_bytes.set(pages["host_bytes"])
         elif hasattr(engine, "occupancy_stats"):
             stats = engine.occupancy_stats()
             m.cache_size.set(stats["live"])

@@ -200,6 +200,28 @@ def get_census(layout: str, ways: int, **kwargs):
     return make_census(layout, ways, **kwargs)
 
 
+def get_paged_kernels(
+    layout: str,
+    num_groups: int,
+    ways: int,
+    groups_per_page: int,
+    num_phys_pages: int,
+):
+    """Paged addressing layer over `layout` (ops/paged.py): the physical
+    table shrinks to a resident-page budget and every kernel consults a
+    device page map (one extra gather) to translate logical groups.
+    Registered here so layout selection and paging compose at the same
+    seam the engine already resolves kernels from. Lazy import: flat
+    tables never pay for the paged module."""
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown table layout: {layout!r}")
+    from gubernator_tpu.ops.paged import make_paged_kernels
+
+    return make_paged_kernels(
+        layout, num_groups, ways, groups_per_page, num_phys_pages
+    )
+
+
 def get_raw_kernels(layout: str) -> RawKernels:
     if layout == "wide":
         from gubernator_tpu.ops.decide import _decide_impl

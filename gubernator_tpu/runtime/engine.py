@@ -43,7 +43,11 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
-from gubernator_tpu.ops.kernels import get_census, get_kernels
+from gubernator_tpu.ops.kernels import (
+    get_census,
+    get_kernels,
+    get_paged_kernels,
+)
 from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
@@ -141,6 +145,26 @@ class EngineConfig:
     # axis aggregates into this many contiguous regions — the future
     # paged-table "page" axis (ROADMAP item 1).
     census_heatmap_width: int = 64
+    # ---- paged table (GUBER_TABLE_PAGE_*, docs/architecture.md
+    # "Paged table") ----
+    # Groups per page (GUBER_TABLE_PAGE_GROUPS): 0 keeps the classic
+    # flat table; > 0 carves the table into fixed-size pages behind a
+    # device-resident indirection map (ops/paged.py) with a host-DRAM
+    # cold tier for demoted pages (runtime/pager.py). The keyspace
+    # (num_groups) stays logical; HBM holds only page_budget pages.
+    page_groups: int = 0
+    # Resident-page budget (GUBER_TABLE_PAGE_BUDGET): physical page
+    # frames in HBM. Required > 0 when page_groups > 0. HBM table bytes
+    # = page_budget x page_groups x ways x bytes_per_slot.
+    page_budget: int = 0
+    # Background demoter cadence (GUBER_TABLE_PAGE_DEMOTE_INTERVAL):
+    # seconds between demoter passes; 0 disables the thread (pages then
+    # demote only on free-frame pressure in the serving path).
+    page_demote_interval_s: float = 2.0
+    # Free-frame target (GUBER_TABLE_PAGE_FREE_TARGET): the demoter
+    # keeps at least this many frames free so promotions on the serving
+    # path rarely pay a demand demote (a device sync under the lock).
+    page_free_target: int = 1
 
 
 class EngineMetrics:
@@ -1173,9 +1197,41 @@ class DeviceEngine(EngineBase):
             raise ValueError("max_waves must be >= 1")
         dev = config.device
 
-        self.K = get_kernels(config.layout)
-        with jax.default_device(dev) if dev is not None else _nullcontext():
-            self.table = self.K.create(config.num_groups, config.ways)
+        # Paged table (docs/architecture.md "Paged table"): the kernel
+        # facade swaps to the paged addressing layer and the PHYSICAL
+        # table shrinks to the resident-page budget; the Pager tracks
+        # residency and owns the host-DRAM cold tier.
+        self._pager = None
+        pg = int(getattr(config, "page_groups", 0) or 0)
+        if pg > 0:
+            budget = int(getattr(config, "page_budget", 0) or 0)
+            if budget <= 0:
+                raise ValueError(
+                    "page_budget must be > 0 when page_groups > 0"
+                )
+            if pg > config.num_groups:
+                raise ValueError(
+                    f"page_groups ({pg}) exceeds num_groups "
+                    f"({config.num_groups})"
+                )
+            from gubernator_tpu.runtime.pager import Pager
+
+            self.K = get_paged_kernels(
+                config.layout, config.num_groups, config.ways, pg, budget
+            )
+            with (
+                jax.default_device(dev) if dev is not None
+                else _nullcontext()
+            ):
+                self.table = self.K.create()
+            self._pager = Pager(self.K, metrics=self.metrics)
+        else:
+            self.K = get_kernels(config.layout)
+            with (
+                jax.default_device(dev) if dev is not None
+                else _nullcontext()
+            ):
+                self.table = self.K.create(config.num_groups, config.ways)
 
         # Table-observatory program (ops/census.py): one jitted,
         # non-donating scan per (layout, geometry, knobs); warmed in
@@ -1212,6 +1268,20 @@ class DeviceEngine(EngineBase):
                 daemon=True,
             )
             self._warm_thread.start()
+        # Background demoter (paged mode): keeps free-frame headroom by
+        # evacuating census-cold pages to the host tier, so serving-path
+        # promotions rarely pay a demand demote under the lock.
+        self._demote_stop = threading.Event()
+        self._demote_thread = None
+        if (
+            self._pager is not None
+            and float(getattr(config, "page_demote_interval_s", 0) or 0) > 0
+        ):
+            self._demote_thread = threading.Thread(
+                target=self._demote_loop, name="gubernator-page-demoter",
+                daemon=True,
+            )
+            self._demote_thread.start()
 
     def wait_warm(self, timeout_s: float = 600.0) -> bool:
         """Block until the bucket ladder has finished warming (VERDICT r3
@@ -1230,6 +1300,50 @@ class DeviceEngine(EngineBase):
             return True
         warm.join(timeout=timeout_s)
         return not warm.is_alive()
+
+    def close(self) -> None:
+        """Stop the page demoter before the base drain: the demoter
+        takes the engine lock and dispatches device work, and the base
+        close tears the pump down around that same lock."""
+        self._demote_stop.set()
+        dem = self._demote_thread
+        if dem is not None and dem.is_alive():
+            dem.join(timeout=30)
+        super().close()
+
+    def _demote_loop(self) -> None:
+        """Background demoter (paged mode). Each cycle: read the
+        TTL-cached census, and when the resident tier shows cold slots
+        (or holds no live rows at all) AND the free-frame list is below
+        page_free_target, evacuate LRU pages under the engine lock
+        until the headroom target is met. The census gate keeps a fully
+        hot working set resident instead of thrashing it through the
+        host tier; min_idle_ticks=1 additionally spares pages touched
+        by the most recent wave round."""
+        interval = max(float(self.cfg.page_demote_interval_s), 0.05)
+        while not self._demote_stop.wait(interval):
+            try:
+                pager = self._pager
+                want = int(getattr(self.cfg, "page_free_target", 1) or 0)
+                if want <= 0 or len(pager.free) >= want:
+                    continue
+                census = self.table_census()
+                dev = census.get("tiers", {}).get("device", census)
+                cold = dev.get("cold") or []
+                cold_slots = int(cold[0]["slots"]) if cold else 0  # guberlint: allow-host-sync -- census dict is host data (TTL-cached scrape)
+                if int(dev.get("live", 0)) > 0 and cold_slots == 0:
+                    continue  # resident set is fully hot: don't thrash
+                with self._lock:
+                    self.table = pager.demote_victims(
+                        self.table, want_free=want, min_idle_ticks=1
+                    )
+            except Exception:  # pragma: no cover - defensive
+                # The demoter is an optimization: serving-path demand
+                # demotes cover for it, so a transient failure (device
+                # teardown races at close) must not kill the thread.
+                if self._demote_stop.is_set():
+                    return
+                continue
 
     # Scratch-table budget for the bucket-warm ladder: beyond this the
     # throwaway compile copy is skipped and only batch_size stays warm —
@@ -1250,7 +1364,16 @@ class DeviceEngine(EngineBase):
         # always-warm batch_size shape still serves the fast path. Sized
         # by the LAYOUT's resident bytes/slot (a narrow table crosses
         # the threshold later than a wide one).
-        approx_bytes = cfg.num_groups * cfg.ways * self.K.bytes_per_slot
+        # Paged mode subsumes the old whole-table gate: the RESIDENT
+        # footprint (physical frames, not the logical keyspace) is what
+        # a scratch copy costs, and paging keeps it bounded regardless
+        # of num_groups — the budget skip only fires when the resident
+        # budget itself is huge.
+        if self._pager is not None:
+            resident_slots = self.K.num_phys_pages * self.K.page_slots
+            approx_bytes = resident_slots * self.K.bytes_per_slot
+        else:
+            approx_bytes = cfg.num_groups * cfg.ways * self.K.bytes_per_slot
         if approx_bytes > self._WARM_TABLE_BUDGET:
             return
         shapes = []
@@ -1290,8 +1413,16 @@ class DeviceEngine(EngineBase):
         the device). Estimates, not allocator truth: the gap shows up
         as unattributed_bytes in the snapshot."""
         cfg = self.cfg
-        slots = cfg.num_groups * cfg.ways
-        table_b = slots * self.K.bytes_per_slot
+        if self._pager is not None:
+            # Paged table: HBM holds only the physical frames plus the
+            # int32 indirection map; demoted pages live in host DRAM
+            # (reported via the census "pages" section, not here —
+            # this map attributes DEVICE memory).
+            slots = self.K.num_phys_pages * self.K.page_slots
+            table_b = slots * self.K.bytes_per_slot
+        else:
+            slots = cfg.num_groups * cfg.ways
+            table_b = slots * self.K.bytes_per_slot
         # Census output: two fixed-width histograms (age/idle), the
         # fill histogram, the heatmap regions, one bucket per coldness
         # threshold, and a handful of scalars — all int64.
@@ -1311,11 +1442,14 @@ class DeviceEngine(EngineBase):
             * 8
             * 8
         )
-        return {
+        subs = {
             "slot_table": table_b,
             "census": census_b,
             "pipeline_ring": ring_b,
         }
+        if self._pager is not None:
+            subs["page_map"] = 4 * self.K.num_logical_pages
+        return subs
 
     def _warmup(self) -> None:
         """Compile the decide AND inject kernels before serving: first XLA
@@ -1335,12 +1469,35 @@ class DeviceEngine(EngineBase):
                 table, InjectBatch.zeros(self.cfg.batch_size), now,
                 self.cfg.ways,
             )
-            tx.add(np.asarray(table.used[:1]))
+            tx.add(np.asarray(table.used[:1]))  # guberlint: allow-raw-table-index -- warmup sync probe: any one physical row works, logical identity irrelevant
             # Census compiles here too: the first /metrics or /debug/table
             # scrape must dispatch a warm program, not pay a compile.
-            c = self._census(table, now)
+            c = self._census(self._census_view(table), now)
             tx.add(np.asarray(c.live))  # guberlint: allow-host-sync -- warmup: compile the census program before serving
+        if self._pager is not None:
+            # Compile the page-migration programs (bind/extract/write/
+            # unbind) on a throwaway cycle over frame 0: the first
+            # demand promote/demote must not pay a compile under the
+            # serving lock. Leaves the table empty and the map unbound.
+            PK = self.K
+            z = np.int32(0)
+            table = PK.bind_page(table, z, z)
+            rows = PK.extract_page(table, z)
+            with _transfer.account(self.metrics, "d2h", "warmup") as tx:
+                host = {
+                    f: np.asarray(getattr(rows, f))  # guberlint: allow-host-sync -- warmup: compile the demote extract path before serving
+                    for f in SlotTable._fields
+                }
+                tx.add(host)
+            table = PK.write_page(table, z, z, SlotTable(**host))
+            table = PK.unbind_page(table, z, z)
         self.table = table
+
+    def _census_view(self, table):
+        """The tensor the census program scans: the PHYSICAL table in
+        paged mode (the host tier is censused separately with the numpy
+        oracle in _census_scan), the table itself otherwise."""
+        return table.data if self._pager is not None else table
 
     def warm_store_path(self) -> None:
         """Compile the store-path kernels (the with_store decide variant,
@@ -1404,21 +1561,87 @@ class DeviceEngine(EngineBase):
         reference under the engine lock, materialize after release."""
         cfg = self.cfg
         now = self.now_fn()
+        host_pages = None
+        pages_snap = None
         with self._lock:
-            out = self._census(self.table, now)
+            out = self._census(self._census_view(self.table), now)
+            if self._pager is not None:
+                # Reference copies under the lock; the numpy census walk
+                # happens after release (rows blocks are replace-only).
+                host_pages = self._pager.host_tier_copy()
+                pages_snap = self._pager.pages_snapshot()
+        dev_groups = (
+            self.K.num_phys_pages * self.K.groups_per_page
+            if self._pager is not None
+            else cfg.num_groups
+        )
         with _transfer.account(self.metrics, "d2h", "census") as tx:
             tier = _census_tier_snapshot(
                 out,
                 now=now,
                 layout=cfg.layout,
-                groups=cfg.num_groups,
+                groups=dev_groups,
                 ways=cfg.ways,
                 bytes_per_slot=self.K.bytes_per_slot,
                 thresholds=self._census_thresholds,
                 heatmap_width=int(cfg.census_heatmap_width),
             )
             tx.add(out)
-        return _census_combine({"device": tier}, primary="device")
+        tiers = {"device": tier}
+        if self._pager is not None:
+            # Host-DRAM tier census (satellite: per-tier counts — the
+            # census must not under-report live keys once demotion is
+            # on). Pure numpy over the demoted pages' wide rows
+            # (ops/census.py census_oracle), no device work.
+            tiers["host"] = self._census_host_tier(host_pages, now)
+        snap = _census_combine(tiers, primary="device")
+        if pages_snap is not None:
+            snap["pages"] = pages_snap
+        return snap
+
+    def _census_host_tier(self, host_pages: dict, now: int) -> dict:
+        """Census the demoted pages with the numpy oracle; returns the
+        same tier dict shape as the device tier so _census_combine sums
+        them. Empty host tier -> an all-zero tier (stable schema)."""
+        import types
+
+        from gubernator_tpu.ops.census import census_oracle
+        from gubernator_tpu.runtime.pager import wide_zeros
+
+        cfg = self.cfg
+        ps = self.K.page_slots
+        if host_pages:
+            lps = sorted(host_pages)
+            fields = {
+                f: np.concatenate([host_pages[lp][f] for lp in lps])
+                for f in SlotTable._fields
+            }
+        else:
+            fields = wide_zeros(ps)  # one empty page: zero counts
+        wide = SlotTable(**fields)
+        d = census_oracle(
+            wide,
+            now,
+            ways=cfg.ways,
+            heatmap_width=int(cfg.census_heatmap_width),
+            thresholds=self._census_thresholds,
+        )
+        groups = (len(host_pages) if host_pages else 0) * (
+            ps // cfg.ways
+        )
+        # groups=0 when the host tier is empty: the zero-page
+        # placeholder censused above contributes zero counts and the
+        # tier reports 0 slots (fracs guard on slots == 0).
+        return _census_tier_snapshot(
+            types.SimpleNamespace(_fields=tuple(d.keys()), **d),
+            now=now,
+            layout=cfg.layout,
+            groups=groups,
+            ways=cfg.ways,
+            bytes_per_slot=self.K.bytes_per_slot,
+            thresholds=self._census_thresholds,
+            heatmap_width=int(cfg.census_heatmap_width),
+        )
 
     def hotkeys_snapshot(self) -> dict:
         """/debug/hotkeys payload with the census join: each sketch row
@@ -1438,11 +1661,22 @@ class DeviceEngine(EngineBase):
         grp = np.array(
             [group_of(int(l), cfg.num_groups) for l in lo], dtype=np.int64
         )
-        slots = (
-            grp[:, None] * np.int64(W)
-            + np.arange(W, dtype=np.int64)[None, :]
-        ).reshape(-1)
+        demoted = np.zeros(len(grp), dtype=bool)
         with self._lock:
+            if self._pager is not None:
+                # Logical -> physical translation through the pager's
+                # host mirror; keys on demoted pages gather the
+                # out-of-range sentinel (zero rows) and are labeled
+                # below instead of probed.
+                pgrp = self._pager.phys_groups(grp)
+                demoted = pgrp < 0
+                grp_dev = np.where(demoted, self.table.num_slots // W, pgrp)
+            else:
+                grp_dev = grp
+            slots = (
+                grp_dev[:, None] * np.int64(W)
+                + np.arange(W, dtype=np.int64)[None, :]
+            ).reshape(-1)
             rows = self.K.gather_rows(self.table, slots)
         # Bounded O(K x ways) readback at debug-poll cadence; the
         # census bucket thresholds mirror table_census semantics.
@@ -1461,6 +1695,9 @@ class DeviceEngine(EngineBase):
             min(1, len(self._census_thresholds) - 1)
         ]
         for i, e in enumerate(entries):
+            if demoted[i]:
+                e["census"] = "demoted"  # its page is in the host tier
+                continue
             match = r_used[i] & (r_hi[i] == hi[i]) & (r_lo[i] == lo[i])
             if not match.any():
                 e["census"] = "evicted"
@@ -2006,6 +2243,16 @@ class DeviceEngine(EngineBase):
             table = self.table
             try:
                 for w, wb in enumerate(waves):
+                    if self._pager is not None:
+                        # Promote every page this wave touches BEFORE
+                        # its probe/decide (a probe-miss against a
+                        # demoted page must resolve against promoted
+                        # state, not the sentinel). Same lock as the
+                        # decide: a promotion can never race a flush.
+                        table = self._pager.ensure_resident(
+                            table,
+                            self._pager.touched_pages(wb.group, wb.active),
+                        )
                     if store is not None:
                         table = self._wave_readthrough(
                             table, wb, lane_reqs[w], now,
@@ -2257,11 +2504,17 @@ class DeviceEngine(EngineBase):
         with self._lock, _transfer.account(
             self.metrics, "d2h", "census"
         ) as tx:
-            used = np.asarray(self.table.used)
-            hi = np.asarray(self.table.key_hi)[used]
-            lo = np.asarray(self.table.key_lo)[used]
+            used = np.asarray(self.table.used)  # guberlint: allow-raw-table-index -- prune wants the PHYSICAL resident set; demoted keys join via host_live_keys below
+            hi = np.asarray(self.table.key_hi)[used]  # guberlint: allow-raw-table-index -- same physical scan as line above
+            lo = np.asarray(self.table.key_lo)[used]  # guberlint: allow-raw-table-index -- same physical scan as line above
             tx.add((used, hi, lo))
         live = set(zip(hi.tolist(), lo.tolist()))
+        if self._pager is not None:
+            # Demoted keys are still live — their pages promote back
+            # verbatim and Loader snapshots must stay routable — so the
+            # host tier's keys survive the prune too.
+            with self._lock:
+                live |= self._pager.host_live_keys()
         with self._keys_lock:
             self._key_strings = {
                 k: v for k, v in self._key_strings.items() if k in live
@@ -2287,6 +2540,13 @@ class DeviceEngine(EngineBase):
             deleted = True
         if deleted:
             self.table = self.K.create(self.cfg.num_groups, self.cfg.ways)
+            if self._pager is not None:
+                # The rebuilt paged table is empty with an unbound map;
+                # the pager's mirror, frames, and host tier must match
+                # (counter loss on failure covers the cold tier too —
+                # stale host pages promoted into a fresh table would
+                # resurrect pre-failure state for SOME keys only).
+                self._pager.reset()
             with self._keys_lock:
                 self._key_strings.clear()
         return deleted
@@ -2371,6 +2631,11 @@ class DeviceEngine(EngineBase):
             table = self.table
             with _transfer.account(self.metrics, "h2d", "inject") as tx:
                 for ib in asm.waves:
+                    if self._pager is not None:
+                        table = self._pager.ensure_resident(
+                            table,
+                            self._pager.touched_pages(ib.group, ib.active),
+                        )
                     table, _ehi, _elo = self.K.inject(
                         table, ib, now, cfg.ways
                     )
@@ -2381,7 +2646,15 @@ class DeviceEngine(EngineBase):
 
     def snapshot(self) -> dict:
         """Device -> host snapshot of the table (the Loader.Save analog,
-        reference store.go:76-78; SURVEY.md §5 checkpoint/resume)."""
+        reference store.go:76-78; SURVEY.md §5 checkpoint/resume).
+
+        Paged mode: the snapshot is the LOGICAL wide image — resident
+        pages are extracted positionally into their logical offsets and
+        host-tier pages are copied in place — so Loader files are
+        identical to (and interchangeable with) an all-resident or flat
+        table's snapshot of the same keys."""
+        if self._pager is not None:
+            return self._snapshot_paged()
         with self._lock:
             tbl = self.K.to_wide(self.table)  # canonical wide snapshot
             with _transfer.account(self.metrics, "d2h", "snapshot") as tx:
@@ -2392,13 +2665,54 @@ class DeviceEngine(EngineBase):
             host["key_strings"] = dict(self._key_strings)
         return host
 
+    def _snapshot_paged(self) -> dict:
+        from gubernator_tpu.runtime.pager import wide_zeros
+
+        cfg = self.cfg
+        PK = self.K
+        ps = PK.page_slots
+        n_logical = cfg.num_groups * cfg.ways
+        host = wide_zeros(PK.num_logical_pages * ps)
+        with self._lock:
+            pager = self._pager
+            with _transfer.account(self.metrics, "d2h", "snapshot") as tx:
+                for lp in np.nonzero(pager.page_map >= 0)[0].tolist():
+                    rows = PK.extract_page(
+                        self.table, np.int32(int(pager.page_map[lp]))  # guberlint: allow-host-sync -- page_map is the pager's host numpy mirror
+                    )
+                    for f in SlotTable._fields:
+                        # guberlint: allow-host-sync -- snapshot assembly: accounted page-at-a-time d2h
+                        host[f][lp * ps:(lp + 1) * ps] = np.asarray(
+                            getattr(rows, f)
+                        )
+                    tx.add(ps * PK.bytes_per_slot)
+            for lp, rows in pager.host_tier.items():
+                for f in SlotTable._fields:
+                    host[f][lp * ps:(lp + 1) * ps] = rows[f]
+            self._snapshot_staging_bytes = sum(
+                a.nbytes for a in host.values()
+            )
+        # Trim the tail-page padding back to the logical slot count.
+        host = {f: a[:n_logical] for f, a in host.items()}
+        with self._keys_lock:
+            host["key_strings"] = dict(self._key_strings)
+        return host
+
     def restore(self, snap: dict) -> None:
         """Host -> device restore (the Loader.Load analog).
 
         Replaces the table AND the host key-string dictionary under their
         locks (the pump/executor threads read both); invalidation state
         lives in the table's own invalid_at column, which the per-wave
-        read-through probe consults directly."""
+        read-through probe consults directly.
+
+        Paged mode: pages with live rows fill the resident frames first
+        (in logical order); the overflow restores into the host tier —
+        no data is dropped even when the image holds more live pages
+        than the resident budget."""
+        if self._pager is not None:
+            self._restore_paged(snap)
+            return
         with _transfer.account(self.metrics, "h2d", "snapshot") as tx:
             fields = {
                 f: jax.numpy.asarray(snap[f]) for f in SlotTable._fields
@@ -2407,6 +2721,39 @@ class DeviceEngine(EngineBase):
         self._snapshot_staging_bytes = tx.bytes
         with self._lock:
             self.table = self.K.from_wide(SlotTable(**fields))
+        with self._keys_lock:
+            self._key_strings = dict(snap.get("key_strings", {}))
+
+    def _restore_paged(self, snap: dict) -> None:
+        from gubernator_tpu.runtime.pager import wide_zeros
+
+        PK = self.K
+        ps = PK.page_slots
+        fields = {f: np.asarray(snap[f]) for f in SlotTable._fields}  # guberlint: allow-host-sync -- snap is the Loader's host-side image, not device data
+        n = fields["used"].shape[0]
+        with self._lock:
+            self.table = PK.create()
+            self._pager.reset()
+            pager = self._pager
+            with _transfer.account(self.metrics, "h2d", "snapshot") as tx:
+                for lp in range(PK.num_logical_pages):
+                    lo, hi = lp * ps, min((lp + 1) * ps, n)
+                    if lo >= n or not fields["used"][lo:hi].any():
+                        continue
+                    page = wide_zeros(ps)
+                    for f in SlotTable._fields:
+                        page[f][: hi - lo] = fields[f][lo:hi]
+                    if pager.free:
+                        pp = pager.free.pop()
+                        self.table = PK.write_page(
+                            self.table, np.int32(lp), np.int32(pp),
+                            SlotTable(**page),
+                        )
+                        pager.page_map[lp] = pp
+                        tx.add(page)
+                    else:
+                        pager.host_tier[lp] = page
+            self._snapshot_staging_bytes = tx.bytes
         with self._keys_lock:
             self._key_strings = dict(snap.get("key_strings", {}))
 

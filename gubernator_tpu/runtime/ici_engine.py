@@ -119,6 +119,16 @@ class IciEngineConfig:
     # (sharded + replica) waves launch in the dispatch stage and sync
     # in the completion stage.
     pipeline_depth: int = 2
+    # Paged-table knobs (GUBER_TABLE_PAGE_*): accepted for config
+    # parity with EngineConfig, but NOT YET IMPLEMENTED for the
+    # shard_map'd ici tiers — the indirection map would have to be
+    # replicated and page moves collective. Setting page_groups > 0
+    # logs a warning and serves flat (docs/architecture.md "Paged
+    # table", staged work).
+    page_groups: int = 0
+    page_budget: int = 0
+    page_demote_interval_s: float = 2.0
+    page_free_target: int = 1
 
 
 class IciEngine(EngineBase):
@@ -138,6 +148,13 @@ class IciEngine(EngineBase):
             )
         if cfg.max_waves < 1:
             raise ValueError("max_waves must be >= 1")
+        if int(getattr(cfg, "page_groups", 0) or 0) > 0:
+            log.warning(
+                "table paging (page_groups=%d) is not yet implemented "
+                "for the ici engine's sharded tiers; serving flat — "
+                "the HBM budget is num_groups * ways per device",
+                cfg.page_groups,
+            )
         self.cfg = cfg
         self.now_fn = now_fn
         self.n_dev = len(devices)

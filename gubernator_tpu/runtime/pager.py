@@ -1,0 +1,250 @@
+"""Host-side page-residency manager for the paged slot table.
+
+The device half of paging lives in ops/paged.py (indirection map +
+positional page moves); this module owns the HOST half: which logical
+page sits in which physical frame, the free-frame list, per-page touch
+recency, and the host-DRAM cold tier (demoted pages as wide numpy row
+blocks). The engine consults it at one choke point — `ensure_resident`
+inside `_execute_waves`' per-wave loop, under the engine lock — so a
+probe against a demoted page promotes it back BEFORE the wave's decide
+runs, and the flush resolves against resident state.
+
+Locking: the Pager has no lock of its own. Every mutating method is
+called with the owning engine's table lock held (the serving pump, the
+background demoter, inject/restore paths all already serialize on it);
+read-only snapshot helpers copy references under that same lock.
+
+Transfer accounting: demote = d2h `purpose="demote"`, promote = h2d
+`purpose="promote"` (utils/transfer.py, GL010). A demote's np.asarray
+materialization synchronizes pending async flushes — acceptable at
+demote cadence (background thread / free-list pressure), never per
+request.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from gubernator_tpu.ops.layout import SlotTable
+from gubernator_tpu.utils import transfer as _transfer
+
+# Wide-row dtypes for assembling logical snapshot images (layout.py).
+_WIDE_DTYPES = {
+    "used": np.bool_,
+    "algo": np.int8,
+    "status": np.int8,
+}
+
+
+def wide_zeros(n: int) -> Dict[str, np.ndarray]:
+    """One n-row block of empty wide (SlotTable-shaped) host rows."""
+    return {
+        f: np.zeros(n, dtype=_WIDE_DTYPES.get(f, np.int64))
+        for f in SlotTable._fields
+    }
+
+
+class PageBudgetError(RuntimeError):
+    """One wave touches more distinct pages than there are physical
+    frames — the resident-page budget cannot hold a single wave's
+    working set. Raise loudly: silently dropping lanes would serve
+    wrong decisions."""
+
+
+class Pager:
+    """Tracks residency for a PagedKernels-backed table.
+
+    State (all engine-lock guarded):
+      page_map:  host mirror of the device map (lp -> pp, -1 demoted)
+      free:      physical frames not bound to any logical page
+      touch:     per-logical-page monotonic touch tick (LRU victims)
+      host_tier: lp -> {field: np.ndarray(page_slots,)} wide rows
+    """
+
+    def __init__(self, kernels, metrics=None):
+        self.PK = kernels
+        self.metrics = metrics
+        self.page_map = np.full(
+            kernels.num_logical_pages, -1, dtype=np.int32
+        )
+        self.free: List[int] = list(range(kernels.num_phys_pages))
+        self.touch = np.zeros(kernels.num_logical_pages, dtype=np.int64)
+        self._tick = 0
+        self.host_tier: Dict[int, Dict[str, np.ndarray]] = {}
+        self.demotes = 0
+        self.promotes = 0
+        self.binds = 0
+
+    # ---- residency queries -------------------------------------------------
+
+    def resident_count(self) -> int:
+        return self.PK.num_phys_pages - len(self.free)
+
+    def host_count(self) -> int:
+        return len(self.host_tier)
+
+    def host_bytes(self) -> int:
+        return sum(
+            sum(a.nbytes for a in rows.values())
+            for rows in self.host_tier.values()
+        )
+
+    def touched_pages(self, groups, active=None) -> np.ndarray:
+        """Distinct logical pages hit by a batch's group column."""
+        g = np.asarray(groups)  # guberlint: allow-host-sync -- wave batches carry host-built group columns, never device tensors
+        if active is not None:
+            g = g[np.asarray(active)]  # guberlint: allow-host-sync -- host-built active mask, same as the group column
+        if g.size == 0:
+            return g.astype(np.int64)
+        return np.unique(g.astype(np.int64) // self.PK.groups_per_page)
+
+    def phys_groups(self, groups: np.ndarray) -> np.ndarray:
+        """Host-side logical->physical group translation (hotkeys /
+        debug joins). Non-resident groups map to -1."""
+        g = np.asarray(groups, dtype=np.int64)  # guberlint: allow-host-sync -- host-built group column (hotkeys/debug joins)
+        gpp = self.PK.groups_per_page
+        pp = self.page_map[g // gpp].astype(np.int64)
+        return np.where(pp >= 0, pp * gpp + g % gpp, np.int64(-1))
+
+    def host_live_keys(self) -> Set[Tuple[int, int]]:
+        """(key_hi, key_lo) of every used slot in the host tier — key
+        pruning must keep strings for demoted keys (they are still
+        live; a promote brings them back verbatim)."""
+        out: Set[Tuple[int, int]] = set()
+        for rows in self.host_tier.values():
+            used = rows["used"]
+            for hi, lo in zip(
+                rows["key_hi"][used].tolist(), rows["key_lo"][used].tolist()
+            ):
+                out.add((hi, lo))
+        return out
+
+    def host_tier_copy(self) -> Dict[int, Dict[str, np.ndarray]]:
+        """Shallow copy for off-lock readers (census, snapshot). Stored
+        row blocks are never mutated in place — demote replaces the dict
+        entry — so the copied references are stable."""
+        return dict(self.host_tier)
+
+    # ---- residency transitions (engine lock held) --------------------------
+
+    def ensure_resident(self, table, pages) -> object:
+        """Promote every page in `pages` (logical page indices), demoting
+        LRU victims if no frame is free. Returns the updated table."""
+        pages = [int(p) for p in np.atleast_1d(pages)]
+        self._tick += 1
+        for lp in pages:
+            self.touch[lp] = self._tick
+        protect = set(pages)
+        for lp in pages:
+            if self.page_map[lp] < 0:
+                table = self._promote_one(table, lp, protect)
+        return table
+
+    def _promote_one(self, table, lp: int, protect: Set[int]):
+        if self.free:
+            pp = self.free.pop()
+        else:
+            victim = self._coldest_resident(protect)
+            if victim is None:
+                raise PageBudgetError(
+                    f"page budget {self.PK.num_phys_pages} cannot hold "
+                    f"{len(protect)} distinct pages touched by one wave; "
+                    "raise GUBER_TABLE_PAGE_BUDGET"
+                )
+            table = self.demote(table, victim)
+            pp = self.free.pop()
+        rows = self.host_tier.pop(lp, None)
+        if rows is None:
+            table = self.PK.bind_page(table, np.int32(lp), np.int32(pp))
+            self.binds += 1
+        else:
+            with _transfer.account(self.metrics, "h2d", "promote") as tx:
+                table = self.PK.write_page(
+                    table, np.int32(lp), np.int32(pp), SlotTable(**rows)
+                )
+                tx.add(rows)
+            self.promotes += 1
+        self.page_map[lp] = pp
+        return table
+
+    def demote(self, table, lp: int):
+        """Evacuate one resident page to the host tier (positional wide
+        rows) and unbind its frame. All-empty pages are dropped, not
+        stored — a later touch rebinds a zeroed frame."""
+        pp = int(self.page_map[lp])  # guberlint: allow-host-sync -- page_map is a host numpy mirror, not device data
+        if pp < 0:
+            return table
+        with _transfer.account(self.metrics, "d2h", "demote") as tx:
+            rows = self.PK.extract_page(table, np.int32(pp))
+            host = {
+                f: np.asarray(getattr(rows, f))  # guberlint: allow-host-sync -- page evacuation: demote-cadence d2h, never per request
+                for f in SlotTable._fields
+            }
+            tx.add(host)
+        if host["used"].any():
+            self.host_tier[lp] = host
+        table = self.PK.unbind_page(table, np.int32(lp), np.int32(pp))
+        self.page_map[lp] = -1
+        self.free.append(pp)
+        self.demotes += 1
+        return table
+
+    def _coldest_resident(self, protect: Set[int]) -> Optional[int]:
+        resident = np.nonzero(self.page_map >= 0)[0]
+        candidates = [lp for lp in resident.tolist() if lp not in protect]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda lp: int(self.touch[lp]))  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
+
+    def demote_victims(
+        self, table, want_free: int, min_idle_ticks: int = 0
+    ):
+        """Background-demoter entry: demote LRU resident pages until
+        `want_free` frames are free. With min_idle_ticks > 0, only pages
+        untouched for at least that many ensure_resident rounds qualify
+        (the census cold gate decides whether the demoter calls this at
+        all). Returns the updated table."""
+        while len(self.free) < want_free:
+            victim = self._coldest_resident(set())
+            if victim is None:
+                break
+            if (
+                min_idle_ticks > 0
+                and self._tick - int(self.touch[victim]) < min_idle_ticks  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
+            ):
+                break  # everything left is too recently touched
+            table = self.demote(table, victim)
+        return table
+
+    def reset(self) -> None:
+        """Post-recovery zeroing: the engine rebuilt an empty paged
+        table, so every mirror entry, frame, and host page is gone."""
+        self.page_map.fill(-1)
+        self.free = list(range(self.PK.num_phys_pages))
+        self.touch.fill(0)
+        self.host_tier.clear()
+
+    # ---- observability -----------------------------------------------------
+
+    def pages_snapshot(self) -> dict:
+        """/debug/table "pages" section + metrics-bridge source."""
+        nlp = self.PK.num_logical_pages
+        snap = {
+            "enabled": True,
+            "groups_per_page": self.PK.groups_per_page,
+            "page_slots": self.PK.page_slots,
+            "logical_pages": nlp,
+            "budget": self.PK.num_phys_pages,
+            "resident": self.resident_count(),
+            "free": len(self.free),
+            "host": len(self.host_tier),
+            "host_bytes": self.host_bytes(),
+            "demotes": self.demotes,
+            "promotes": self.promotes,
+            "binds": self.binds,
+        }
+        if nlp <= 4096:  # bounded debug payload
+            snap["page_map"] = self.page_map.tolist()
+        return snap

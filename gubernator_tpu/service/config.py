@@ -290,6 +290,20 @@ class DaemonConfig:
     census_thresholds: tuple = (1, 4, 16)
     census_heatmap_width: int = 64
 
+    # Paged slot table (docs/architecture.md "Paged table"):
+    # GUBER_TABLE_PAGE_GROUPS > 0 carves the table into pages of that
+    # many contiguous groups behind a device-resident indirection map,
+    # keeping only GUBER_TABLE_PAGE_BUDGET pages in HBM (cold pages
+    # demote to a host-DRAM tier). GUBER_TABLE_PAGE_DEMOTE_INTERVAL
+    # paces the background demoter (0 = demand demotes only);
+    # GUBER_TABLE_PAGE_FREE_TARGET is the free-frame headroom it keeps.
+    # Default off: the flat table is bit-exact and has zero translation
+    # overhead when the keyspace fits HBM.
+    page_groups: int = 0
+    page_budget: int = 0
+    page_demote_interval_s: float = 2.0
+    page_free_target: int = 1
+
     # Continuous profiling (docs/monitoring.md "Device resources"):
     # GUBER_PROFILE_INTERVAL > 0 starts a background sampler that takes
     # a GUBER_PROFILE_SECONDS-long jax.profiler capture each interval,
@@ -331,6 +345,10 @@ class DaemonConfig:
             census_ttl_s=self.census_ttl_s,
             census_thresholds=self.census_thresholds,
             census_heatmap_width=self.census_heatmap_width,
+            page_groups=self.page_groups,
+            page_budget=self.page_budget,
+            page_demote_interval_s=self.page_demote_interval_s,
+            page_free_target=self.page_free_target,
             # Handover needs routable (string-keyed) snapshots even on
             # the store-less columnar edge; with it off, skip the decode.
             record_columnar_keys=self.behaviors.handover,

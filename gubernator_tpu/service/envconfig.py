@@ -192,6 +192,15 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env("GUBER_TABLE_CENSUS_THRESHOLDS")
         ),
         census_heatmap_width=_env_int("GUBER_TABLE_CENSUS_HEATMAP", 64),
+        # Paged slot table (docs/architecture.md "Paged table"): page
+        # granularity in groups (0 = flat table), resident-page budget,
+        # background-demoter cadence, and free-frame headroom target.
+        page_groups=_env_int("GUBER_TABLE_PAGE_GROUPS", 0),
+        page_budget=_env_int("GUBER_TABLE_PAGE_BUDGET", 0),
+        page_demote_interval_s=parse_duration_s(
+            _env("GUBER_TABLE_PAGE_DEMOTE_INTERVAL"), 2.0
+        ),
+        page_free_target=_env_int("GUBER_TABLE_PAGE_FREE_TARGET", 1),
         # Continuous profiling (docs/monitoring.md "Device resources"):
         # sampler cadence (0 = off), per-capture trace length, and how
         # many trace dirs the rotation keeps.
@@ -215,6 +224,17 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_PIPELINE_DEPTH={conf.pipeline_depth}' is invalid; "
             "must be >= 1 (1 = serial dispatch)"
+        )
+    if conf.page_groups < 0:
+        raise ValueError(
+            f"'GUBER_TABLE_PAGE_GROUPS={conf.page_groups}' is invalid; "
+            "must be >= 0 (0 disables table paging)"
+        )
+    if conf.page_groups > 0 and conf.page_budget < 1:
+        raise ValueError(
+            f"'GUBER_TABLE_PAGE_BUDGET={conf.page_budget}' is invalid; "
+            "must be >= 1 resident page when GUBER_TABLE_PAGE_GROUPS "
+            "enables paging"
         )
 
     # Table layouts validate EARLY against the one registry

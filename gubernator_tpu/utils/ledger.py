@@ -250,7 +250,8 @@ def gate(
 
 
 _MODE_FROM_JOB = re.compile(
-    r"(kernel10m|kernel|engine_ab|engine|server|global|latency|edge|ici)"
+    r"(kernel10m|kernel|engine_ab|engine|server|global|latency|edge|ici"
+    r"|paged_table)"
 )
 _LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide|narrow)")
 

@@ -6,9 +6,10 @@ to this module).
 Each accounted transfer records (bytes, wall seconds) into the owning
 engine's `gubernator_transfer_*` Log2Histograms, labeled by direction
 ("h2d" | "d2h") and purpose ("serve" | "snapshot" | "inject" |
-"warmup" | "census") — the exact instrumentation the paged table's
-promote/demote path will ride (ROADMAP item 1): demote = d2h at
-snapshot cadence, promote = h2d on probe miss.
+"warmup" | "census" | "demote" | "promote") — demote/promote are the
+paged table's page-migration moves (runtime/pager.py): demote = d2h
+page evacuation to the host-DRAM tier, promote = h2d page fill on a
+probe against a demoted page.
 
 Honesty note on timing: d2h materializations (np.asarray of device
 arrays) block until the copy lands, so their latency is the real
@@ -25,7 +26,9 @@ from __future__ import annotations
 import time
 
 DIRECTIONS = ("h2d", "d2h")
-PURPOSES = ("serve", "snapshot", "inject", "warmup", "census")
+PURPOSES = (
+    "serve", "snapshot", "inject", "warmup", "census", "demote", "promote",
+)
 
 
 def nbytes(obj) -> int:

@@ -41,6 +41,7 @@ from gubernator_tpu.utils.compilecache import enable_compile_cache  # noqa: E402
 enable_compile_cache()
 
 import asyncio  # noqa: E402
+import signal  # noqa: E402
 import threading  # noqa: E402
 
 import pytest  # noqa: E402
@@ -55,6 +56,51 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
     )
+    config.addinivalue_line(
+        "markers",
+        "flaky: quarantined known-flaky test (also marked slow so "
+        "tier-1 never pays for a hang; run explicitly with -m flaky)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "deadline(seconds): hard per-test SIGALRM watchdog covering "
+        "setup+call+teardown — a hang fails with TimeoutError instead "
+        "of eating the suite budget (no pytest-timeout in this env)",
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    """Hand-rolled per-test watchdog for @pytest.mark.deadline(s).
+
+    Wraps the whole protocol (fixture setup, call, teardown) because
+    the known hangs live in module-scoped cluster fixtures, not the
+    test body. SIGALRM only delivers to the main thread — exactly
+    where pytest runs tests — and interrupts the blocking
+    Future.result()/Condition.wait() calls the in-process cluster
+    plumbing parks on."""
+    m = item.get_closest_marker("deadline")
+    if (
+        m is None
+        or not hasattr(signal, "SIGALRM")
+        or threading.current_thread() is not threading.main_thread()
+    ):
+        yield
+        return
+    seconds = int(m.args[0]) if m.args else 120
+
+    def _abort(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded its {seconds}s deadline marker"
+        )
+
+    old = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,32 @@
+"""GL011 fixture: raw slot-table tensor access in runtime/ code.
+
+Never imported — parsed by guberlint only (tests/test_lint.py). Paths
+mirror the package so the runtime/ scope predicate fires.
+"""
+
+import numpy as np  # noqa
+
+
+class _Eng:
+    def subscript_attr_chain(self):
+        # self.table.<field>[...] — physical-row indexing, flagged
+        return self.table.used[:16]
+
+    def subscript_bare_name(self, table):
+        # table.<field>[...] on the bare name, flagged
+        return table.remaining[0]
+
+    def asarray_pull(self):
+        # np.asarray(self.table.<field>) — whole-tensor host pull, flagged
+        return np.asarray(self.table.key_hi)
+
+    def pragma_with_reason(self):
+        return self.table.lru[:1]  # guberlint: allow-raw-table-index -- fixture: witnessed-intentional physical read
+
+    def batch_struct_not_table(self, ib, wb, cols):
+        # same field names off batch structs — NOT a table base, clean
+        return ib.key_hi[0] + wb.used[1] + cols.remaining[2]
+
+    def paged_route(self, PK, table, slots):
+        # the sanctioned route: paged gather translates logical->physical
+        return PK.gather_rows(table, slots)

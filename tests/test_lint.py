@@ -65,8 +65,20 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-        "GL007", "GL008", "GL009", "GL010",
+        "GL007", "GL008", "GL009", "GL010", "GL011",
     }
+
+
+def test_gl011_field_list_matches_slot_table():
+    # GL011 hardcodes the slot-table field names so the linter stays
+    # jax-free; this is the lockstep pin (import deferred to keep THIS
+    # module's import graph jax-free too — conftest already loaded jax
+    # for the suite, but the linter itself must not need it).
+    from gubernator_tpu.ops.layout import SlotTable
+
+    from tools.lint.rules import _SLOT_FIELDS
+
+    assert _SLOT_FIELDS == SlotTable._fields
 
 
 # ---------------------------------------------------------------------------
@@ -142,6 +154,13 @@ _CASES = [
         fixture("runtime", "gl010_unaccounted_transfer.py"),
         {"'raw_attr_call'", "'raw_bare_call'", "'raw_in_loop'"},
         3,  # accounted wrapper calls + pragma'd site don't fire
+    ),
+    (
+        "GL011",
+        fixture("runtime", "gl011_raw_table_index.py"),
+        {"'subscript_attr_chain'", "'subscript_bare_name'",
+         "'asarray_pull'"},
+        3,  # pragma'd + batch-struct (ib./wb./cols.) sites don't fire
     ),
 ]
 

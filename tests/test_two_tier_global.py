@@ -16,6 +16,17 @@ from gubernator_tpu.service import pb
 from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
 from gubernator_tpu.service.daemon import Daemon
 
+# Quarantined: the two-pod ici cluster intermittently hangs at spawn
+# (collective init under 8 virtual devices), which used to eat the
+# whole tier-1 870s budget. slow keeps it out of tier-1, flaky lets CI
+# run the quarantine lane explicitly (-m flaky), and the deadline
+# watchdog turns any residual hang into a bounded failure.
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.flaky,
+    pytest.mark.deadline(300),
+]
+
 LIMIT = 1000
 
 

@@ -881,6 +881,91 @@ class GL010UnaccountedTransfer(Rule):
 
 
 # ---------------------------------------------------------------------------
+# GL011 — raw slot-table tensor indexing in runtime/ bypasses paging.
+
+_PAGED_SCOPES = ("gubernator_tpu/runtime/",)
+
+# ops/layout.py SlotTable._fields, hardcoded so the linter stays
+# jax-free (importing ops.layout pulls in jax.numpy). A registry test
+# in tests/test_lint.py asserts this tuple equals SlotTable._fields.
+_SLOT_FIELDS = (
+    "key_hi", "key_lo", "used", "algo", "status", "limit", "duration",
+    "remaining", "stamp", "expire_at", "invalid_at", "burst", "lru",
+)
+
+
+class GL011RawTableIndex(Rule):
+    code = "GL011"
+    name = "raw-table-index"
+    description = (
+        "direct indexing / host materialization of a raw slot-table "
+        "field tensor in runtime/ reads PHYSICAL rows — under paging "
+        "(GUBER_TABLE_PAGE_GROUPS) physical position is a page frame, "
+        "not a logical group, and host-demoted rows are invisible. "
+        "Route reads through the paged addressing layer "
+        "(PagedKernels.gather_rows/extract_page, ops/paged.py) or the "
+        "census view, or carry an allow-raw-table-index pragma with a "
+        "reason"
+    )
+    requires_reason = True
+
+    def _table_field(self, node: ast.AST) -> Optional[str]:
+        """Return the field name if node is `<table>.<slot-field>`.
+
+        A table base is the bare name `table`/`tbl` or any attribute
+        chain ending in `.table` (self.table, eng.table, …). Batch
+        structs (ib.*, wb.*, cols.*) reuse some field names but never
+        hang off a `table` base, which is what keeps this precise.
+        """
+        if not isinstance(node, ast.Attribute) or node.attr not in _SLOT_FIELDS:
+            return None
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in ("table", "tbl"):
+            return node.attr
+        if isinstance(base, ast.Attribute) and base.attr == "table":
+            return node.attr
+        return None
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if not scan_path(mod.relpath).startswith(_PAGED_SCOPES):
+            return []
+        if scan_path(mod.relpath).endswith("runtime/pager.py"):
+            # the residency manager IS the paging layer's host half
+            return []
+        out = []
+        for node, stack in walk_scoped(mod.tree):
+            field = None
+            how = None
+            if isinstance(node, ast.Subscript):
+                # table.used[idx] — physical-row indexing
+                field = self._table_field(node.value)
+                how = "indexes"
+            elif isinstance(node, ast.Call) and _is_name_attr(
+                node.func, "np", "asarray"
+            ):
+                # np.asarray(table.used) — whole-tensor host pull of
+                # physical rows (usually followed by fancy indexing)
+                for arg in node.args[:1]:
+                    field = self._table_field(arg)
+                how = "materializes"
+            if field is None:
+                continue
+            fn = func_name(stack)
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"'{fn}' {how} raw table field '{field}' — physical "
+                    f"rows are page frames under paging; go through the "
+                    f"paged addressing layer (ops/paged.py) or the "
+                    f"census view",
+                    f"raw-table:{field}:{fn}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # --fix-docs support (GL003 auto-stub).
 
 

@@ -73,11 +73,17 @@ class _Demux(io.TextIOBase):
         (buf or self.real).flush()
 
 
-def _archive_results(name: str, text: str) -> None:
+def _archive_results(name: str, text: str) -> str:
+    """Archive a job's RESULT lines, then auto-gate (tools/jobs/README.md
+    contract): every job that lands a ledger row gets the same
+    regression verdict `bench.py --gate` computes, as a `GATE {json}`
+    line. Returns the gate line(s) so the caller can append them to the
+    job's .out — a soak's artifact carries its own verdict."""
     try:
         from gubernator_tpu.utils import ledger
 
         n = 0
+        mode = layout = ""
         for line in text.splitlines():
             if line.startswith("RESULT "):
                 try:
@@ -89,10 +95,23 @@ def _archive_results(name: str, text: str) -> None:
                 )
                 ledger.append(result, job=name, mode=mode, layout=layout)
                 n += 1
-        if n:
-            print(f"  archived {n} RESULT line(s) from {name}", flush=True)
+        if not n:
+            return ""
+        print(f"  archived {n} RESULT line(s) from {name}", flush=True)
+        try:
+            verdict = ledger.gate(job=name, mode=mode, layout=layout)
+            print(
+                f"  gate[{name}]: {'ok' if verdict['ok'] else 'FAIL'} — "
+                f"{verdict['reason']}",
+                flush=True,
+            )
+            return "GATE " + json.dumps(verdict) + "\n"
+        except Exception as e:  # the measurement stays valid without it
+            print(f"  gate failed for {name}: {e!r}", flush=True)
+            return f"GATE ERROR {e!r}\n"
     except Exception as e:  # ledger failure must not kill the runner
         print(f"  ledger archive failed for {name}: {e!r}", flush=True)
+        return ""
 
 
 def _job_timeout(py_path: str) -> float:
@@ -249,17 +268,20 @@ def main() -> int:
         payload = buf.getvalue()
         if claim_finalize(done + ".claim"):
             # Archive + expose .out first, .done last: a poller that sees
-            # .done must find the result already durable.
-            _archive_results(name, payload)
-            write_atomic(out, payload)
+            # .done must find the result already durable. The GATE line
+            # (auto-gate after every ledger write) rides in .out too.
+            gate_txt = _archive_results(name, payload)
+            write_atomic(out, payload + gate_txt)
             put_done(done, "ok" if ok else "error")
             verdict = "ok" if ok else "ERROR"
         else:
             # Watchdog abandoned us first; the TIMEOUT record in .out
             # stays authoritative — late completion lands in .out.late,
             # and only the tail the watchdog never saw is archived.
-            write_atomic(out + ".late", payload)
-            _archive_results(name, payload[abandoned_len.pop(name, 0):])
+            gate_txt = _archive_results(
+                name, payload[abandoned_len.pop(name, 0):]
+            )
+            write_atomic(out + ".late", payload + gate_txt)
             verdict = f"LATE {'ok' if ok else 'ERROR'}"
         demux.real.write(f"job {name}: {verdict}\n")
         demux.real.flush()
@@ -302,15 +324,16 @@ def main() -> int:
                 abandoned_len[name] = len(partial)
                 if claim_finalize(done + ".claim"):
                     abandoned += 1
+                    gate_txt = _archive_results(name, partial)
                     if not os.path.exists(out):
                         write_atomic(
                             out,
                             partial
                             + f"\nTIMEOUT after {timeout_s:.0f}s — job "
                             f"abandoned by watchdog (thread left running; "
-                            f"late output, if any, lands in {name}.out.late)\n",
+                            f"late output, if any, lands in {name}.out.late)\n"
+                            + gate_txt,
                         )
-                    _archive_results(name, partial)
                     put_done(done, "timeout")
                     demux.real.write(
                         f"job {name}: TIMEOUT after {timeout_s:.0f}s "
