@@ -58,13 +58,23 @@ def from_timestamp_ms(ms: int):
 
 
 class GubernatorClient:
-    """Async gRPC client (reference DialV1Server, client.go:44-65)."""
+    """Async gRPC client (reference DialV1Server, client.go:44-65).
+
+    With `leases=True` the client holds cooperative token leases
+    (parallel/leases.py): checks against a leased key are answered from
+    a local slice with zero RPCs, and the cache reconciles with the
+    server at renew cadence through the V1/Lease RPC. The server must
+    run with GUBER_LEASES=true — against an older or lease-less server
+    every check simply falls through to the normal RPC path."""
 
     def __init__(
         self,
         address: str,
         tls=None,  # optional service.tls.TlsConfig
         default_timeout: float = 10.0,
+        leases: bool = False,
+        lease_low_water: float = 0.25,
+        lease_max_keys: int = 1024,
     ):
         self.address = address
         self.default_timeout = default_timeout
@@ -82,6 +92,14 @@ class GubernatorClient:
         else:
             self.channel = grpc.aio.insecure_channel(address)
         self.stub = V1Stub(self.channel)
+        self.lease_cache = None
+        self._lease_tasks: set = set()
+        if leases:
+            from gubernator_tpu.parallel.leases import LeaseCache
+
+            self.lease_cache = LeaseCache(
+                low_water=lease_low_water, max_keys=lease_max_keys
+            )
 
     async def __aenter__(self) -> "GubernatorClient":
         return self
@@ -90,19 +108,82 @@ class GubernatorClient:
         await self.close()
 
     async def close(self) -> None:
+        if self.lease_cache is not None:
+            # An in-flight renewal re-installs an entry on apply(); let
+            # maintenance land first or its grant would dodge the final
+            # return below and sit on the owner's ledger until expiry.
+            for t in list(self._lease_tasks):
+                try:
+                    await asyncio.wait_for(t, timeout=2.0)
+                except (asyncio.TimeoutError, grpc.RpcError):
+                    pass
+            if self.lease_cache._entries:
+                # Best-effort final return so the server reclaims our
+                # slices as `returned` instead of waiting for expiry.
+                self.lease_cache.drain_for_close()
+                try:
+                    await asyncio.wait_for(
+                        self._lease_maintain(), timeout=2.0
+                    )
+                except (asyncio.TimeoutError, grpc.RpcError):
+                    pass
         await self.channel.close()
 
     async def get_rate_limits(
         self, reqs: Sequence[RateLimitReq], timeout: Optional[float] = None
     ) -> List[RateLimitResp]:
+        local = {}
+        if self.lease_cache is not None:
+            for i, r in enumerate(reqs):
+                resp = self.lease_cache.try_serve(r)
+                if resp is not None:
+                    local[i] = resp
+            if self.lease_cache.due():
+                t = asyncio.ensure_future(self._lease_maintain())
+                self._lease_tasks.add(t)
+                t.add_done_callback(self._lease_tasks.discard)
+            if len(local) == len(reqs):
+                return [local[i] for i in range(len(reqs))]
         msg = pb.pb.GetRateLimitsReq()
-        for r in reqs:
+        fwd_idx = []
+        for i, r in enumerate(reqs):
+            if i in local:
+                continue
             tracing.propagate_inject(r.metadata)
             msg.requests.append(pb.req_to_pb(r))
+            fwd_idx.append(i)
         resp = await self.stub.get_rate_limits(
             msg, timeout=timeout or self.default_timeout
         )
-        return [pb.resp_from_pb(r) for r in resp.responses]
+        out: List[Optional[RateLimitResp]] = [
+            local.get(i) for i in range(len(reqs))
+        ]
+        for i, m in zip(fwd_idx, resp.responses):
+            out[i] = pb.resp_from_pb(m)
+        return [
+            r if r is not None else RateLimitResp(error="missing response")
+            for r in out
+        ]
+
+    async def _lease_maintain(self) -> None:
+        """One Lease RPC: returns + renews + new grants (collect/apply
+        contract in parallel/leases.py LeaseCache)."""
+        grants, returns = self.lease_cache.collect()
+        if not grants and not returns:
+            self.lease_cache.inflight = False
+            return
+        try:
+            raw = await self.stub.lease(
+                pb.lease_req_to_bytes(grants, returns, holder="client"),
+                timeout=self.default_timeout,
+            )
+            g_res, _r_res, _md = pb.lease_resp_from_bytes(raw)
+        except (grpc.RpcError, ValueError, TypeError):
+            # Advisory: failed renews re-send next round; the server
+            # sweep reclaims anything we never manage to return.
+            self.lease_cache.abort()
+            return
+        self.lease_cache.apply(grants, g_res)
 
     async def health_check(self, timeout: Optional[float] = None) -> HealthCheckResp:
         h = await self.stub.health_check(
@@ -115,20 +196,28 @@ class SyncGubernatorClient:
     """Blocking facade over GubernatorClient (runs its own event loop
     thread), for scripts and non-async applications."""
 
-    def __init__(self, address: str, tls=None, default_timeout: float = 10.0):
+    def __init__(
+        self,
+        address: str,
+        tls=None,
+        default_timeout: float = 10.0,
+        leases: bool = False,
+    ):
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         self._client: GubernatorClient = self._call(
-            self._make(address, tls, default_timeout)
+            self._make(address, tls, default_timeout, leases)
         )
 
     def _run(self) -> None:
         asyncio.set_event_loop(self._loop)
         self._loop.run_forever()
 
-    async def _make(self, address, tls, timeout) -> GubernatorClient:
-        return GubernatorClient(address, tls=tls, default_timeout=timeout)
+    async def _make(self, address, tls, timeout, leases) -> GubernatorClient:
+        return GubernatorClient(
+            address, tls=tls, default_timeout=timeout, leases=leases
+        )
 
     def _call(self, coro, timeout: float = 30.0):
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)

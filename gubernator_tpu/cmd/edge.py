@@ -12,6 +12,12 @@ Env:
     GUBER_EDGE_UPSTREAM       device daemon's GUBER_EDGE_LISTEN_ADDRESS
                               (unix:///path or host:port; required)
     GUBER_EDGE_CONNECTIONS    upstream connections (default 2)
+    GUBER_LEASES              serve leased keys locally (zero upstream
+                              frames on the hot path); the daemon must
+                              also run with GUBER_LEASES=true
+    GUBER_LEASE_LOW_WATER     renew when a slice falls below this
+                              fraction (default 0.25)
+    GUBER_LEASE_MAX_KEYS      max cached lease entries (default 4096)
     GUBER_LOG_LEVEL
 """
 
@@ -67,6 +73,7 @@ def main() -> None:
         from gubernator_tpu.metrics import Metrics
         from gubernator_tpu.service.edge import (
             EdgeClient,
+            EdgeLeases,
             EdgeV1Servicer,
             build_edge_app,
             edge_v1_handler,
@@ -84,9 +91,32 @@ def main() -> None:
             ),
             timeout_counter=metrics.edge_call_timeouts,
         )
+        leases = None
+        # knob: GUBER_LEASES (same switch as the daemon's — an edge only
+        # holds leases when the upstream daemon grants them)
+        if os.environ.get("GUBER_LEASES", "").strip().lower() in (
+            "1", "true", "yes", "on",
+        ):
+            from gubernator_tpu.parallel.leases import LeaseCache
+
+            leases = EdgeLeases(
+                client,
+                LeaseCache(
+                    # knob: GUBER_LEASE_LOW_WATER
+                    low_water=float(
+                        os.environ.get("GUBER_LEASE_LOW_WATER", "") or 0.25
+                    ),
+                    # knob: GUBER_LEASE_MAX_KEYS
+                    max_keys=int(
+                        os.environ.get("GUBER_LEASE_MAX_KEYS", "") or 4096
+                    ),
+                ),
+                holder=f"edge:{listen}",
+                local_counter=metrics.lease_local_answers,
+            )
         server = grpc.aio.server()
         server.add_generic_rpc_handlers(
-            (edge_v1_handler(EdgeV1Servicer(client)),)
+            (edge_v1_handler(EdgeV1Servicer(client, leases=leases)),)
         )
         port = server.add_insecure_port(listen)
         await server.start()
@@ -94,7 +124,9 @@ def main() -> None:
         if http_listen:
             from aiohttp import web
 
-            http_runner = web.AppRunner(build_edge_app(client, metrics=metrics))
+            http_runner = web.AppRunner(
+                build_edge_app(client, metrics=metrics, leases=leases)
+            )
             await http_runner.setup()
             site = web.TCPSite(http_runner, hhost, hport)
             await site.start()
@@ -114,6 +146,8 @@ def main() -> None:
         await stop.wait()
         logging.info("edge shutting down")
         await server.stop(grace=0.5)
+        if leases is not None:
+            await leases.close()  # return held slices before the pipe dies
         if http_runner is not None:
             await http_runner.cleanup()
         await client.close()

@@ -1119,6 +1119,45 @@ class Metrics:
             "backstop, every GUBER_ICI_FULL_TICK_EVERY capped ticks).",
         )
 
+        # Cooperative token leases (docs/monitoring.md "Leases";
+        # GUBER_LEASES — all zero when leases are off).
+        self.lease_grants = counter(
+            "gubernator_lease_grants",
+            "Lease grant decisions by result: granted, rejected "
+            "(ineligible / over limit / table full), revoked (key is "
+            "under an active revocation window).",
+            ["result"],
+        )
+        self.lease_hits = counter(
+            "gubernator_lease_hits",
+            "Lease ledger flows in hit units: granted (carved from the "
+            "slot), returned (slice came back — renew or final), "
+            "credited (unused tokens restored to the slot), expired "
+            "(reclaimed by the sweep or a revocation; unused tokens are "
+            "forfeit). Conservation: granted - returned - expired == "
+            "outstanding.",
+            ["kind"],
+        )
+        self.lease_outstanding_hits = Gauge(
+            "gubernator_lease_outstanding_hits",
+            "Hits currently out on lease (granted - returned - expired) "
+            "— the fleet-wide over-admission bound during a partition; "
+            "its return to 0 after heal is the lease reconvergence "
+            "signal (auditor lease pass).",
+            registry=r,
+        )
+        self.lease_revocations = counter(
+            "gubernator_lease_revocations",
+            "Lease revocations broadcast by this owner (an over-limit "
+            "re-read found outstanding slices on the key).",
+        )
+        self.lease_local_answers = counter(
+            "gubernator_lease_local_answers",
+            "Checks answered entirely from a local lease slice (zero "
+            "RPCs) by a holder-side cache colocated with this registry "
+            "(edge tier).",
+        )
+
         self._syncs = []
 
     # -- registration --------------------------------------------------------

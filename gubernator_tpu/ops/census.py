@@ -77,6 +77,12 @@ class CensusOutput(NamedTuple):
     fill_hist: jnp.ndarray  # (ways+1,) int64 groups by used-way count
     max_full_run: jnp.ndarray  # () int64 longest run of full groups
     cold: jnp.ndarray  # (len(thresholds),) int64 used & idle > k*duration
+    # Per-region count of cold slots (idle > thresholds[0] x duration),
+    # same region axis/padding as `heatmap` — the demotion policy's
+    # victim signal (runtime/pager.py demote_victims): a region full of
+    # USED slots may still be all-cold, and the pager's LRU touch ticks
+    # cannot see that (one probe re-warms a whole page).
+    cold_heatmap: jnp.ndarray  # (heatmap_width,) int64 cold slots per region
 
 
 def _log2_bins(values: jnp.ndarray, used: jnp.ndarray, n_buckets: int):
@@ -142,6 +148,17 @@ def _census_wide(
         ]
     )
 
+    cold0 = used & (idle_c > jnp.int64(thresholds[0]) * wide.duration)
+    g_cold = jnp.sum(cold0.reshape(groups, ways), axis=1, dtype=I64)
+    cold_padded = (
+        jnp.zeros((heatmap_width * per_region,), dtype=I64)
+        .at[:groups]
+        .set(g_cold)
+    )
+    cold_heatmap = jnp.sum(
+        cold_padded.reshape(heatmap_width, per_region), axis=1, dtype=I64
+    )
+
     return CensusOutput(
         live=live,
         full_groups=full_groups,
@@ -154,6 +171,7 @@ def _census_wide(
         fill_hist=fill_hist,
         max_full_run=max_full_run,
         cold=cold,
+        cold_heatmap=cold_heatmap,
     )
 
 
@@ -251,6 +269,12 @@ def census_oracle(
         dtype=np.int64,
     )
 
+    cold0 = used & (idle_c > np.int64(thresholds[0]) * duration)
+    g_cold = cold0.reshape(groups, ways).sum(axis=1).astype(np.int64)
+    cold_padded = np.zeros(heatmap_width * per_region, dtype=np.int64)
+    cold_padded[:groups] = g_cold
+    cold_heatmap = cold_padded.reshape(heatmap_width, per_region).sum(axis=1)
+
     return {
         "live": int(used.sum()),
         "full_groups": int(full.sum()),
@@ -265,4 +289,5 @@ def census_oracle(
         ).astype(np.int64),
         "max_full_run": max_full_run,
         "cold": cold,
+        "cold_heatmap": cold_heatmap.astype(np.int64),
     }

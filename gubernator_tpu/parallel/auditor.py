@@ -67,6 +67,7 @@ class ConsistencyAuditor:
         self._rotate = 0
         self._last_max_ms = 0
         self._counts: Dict[str, int] = {"lag": 0, "lost": 0, "conflict": 0}
+        self._lease_last: Dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -156,7 +157,39 @@ class ConsistencyAuditor:
         # Re-set every pass: falls back toward 0 after reconvergence.
         m.consistency_max_staleness.set(max_ms)
         self._last_max_ms = max_ms
+        self._audit_leases()
         return self.summary()
+
+    def _audit_leases(self) -> None:
+        """Lease honesty pass (parallel/leases.py conservation model):
+        re-derive Σ outstanding slice hits from the live records — that
+        sum IS the worst-case over-admission bound during a partition —
+        re-set the gauge from it (so it falls back to 0 after holders
+        return/expire post-heal, same falls-toward-zero contract as the
+        staleness gauge), and cross-check it against the ledger identity
+        granted − returned − expired. A mismatch means lease bookkeeping
+        leaked and the advertised bound is a lie — counted as divergence
+        kind="lease"."""
+        lm = getattr(self.svc, "lease_mgr", None)
+        if lm is None:
+            return
+        m = self.svc.metrics
+        ledger = lm.outstanding_hits()
+        records = sum(lm.outstanding_by_key().values())
+        if ledger != records:
+            m.consistency_divergence.labels("lease").inc()
+            self._counts["lease"] = self._counts.get("lease", 0) + 1
+            log.warning(
+                "lease conservation violated: ledger outstanding %d != "
+                "record sum %d", ledger, records,
+            )
+        m.lease_outstanding_hits.set(records)
+        self._lease_last = {
+            "outstanding_hits": records,
+            "ledger_outstanding_hits": ledger,
+            "over_admission_bound_hits": records,
+            "leases": len(lm._leases),
+        }
 
     async def _owner_snapshots(self, keys) -> Dict[str, object]:
         from gubernator_tpu.store.store import snapshots_from_engine
@@ -196,9 +229,12 @@ class ConsistencyAuditor:
 
     def summary(self) -> dict:
         """Last-pass state for local_debug_info / /debug/cluster."""
-        return {
+        out = {
             "max_staleness_ms": self._last_max_ms,
             "divergence": dict(self._counts),
             "audit_passes": self._pass_n,
             "audit_interval_s": self.interval_s,
         }
+        if self._lease_last:
+            out["leases"] = dict(self._lease_last)
+        return out

@@ -35,9 +35,11 @@ log = logging.getLogger("gubernator_tpu.global")
 from gubernator_tpu.api.types import (
     Behavior,
     RateLimitReq,
+    Status,
     UpdatePeerGlobal,
     has_behavior,
 )
+from gubernator_tpu.parallel.leases import LEASE_REVOKE_MD_KEY
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
@@ -408,7 +410,22 @@ class GlobalManager:
             ]
             statuses = await asyncio.gather(*futs)
             globals_ = []
+            lease_mgr = getattr(self.svc, "lease_mgr", None)
             for (key, upd), status in zip(updates.items(), statuses):
+                if (
+                    lease_mgr is not None
+                    and status.status == Status.OVER_LIMIT
+                    and lease_mgr.has_leases(key)
+                ):
+                    # Revocation rides the broadcast leg: the key went
+                    # over limit with slices outstanding, so the owner
+                    # drops them (stopping renewals) and tells every
+                    # replica to refuse grants until the window resets.
+                    lease_mgr.revoke(key, status.reset_time)
+                if lease_mgr is not None and key in lease_mgr._revoked:
+                    md = dict(status.metadata or {})
+                    md[LEASE_REVOKE_MD_KEY] = str(lease_mgr._revoked[key])
+                    status = dataclasses.replace(status, metadata=md)
                 origin = upd.metadata.get(ORIGIN_MD_KEY)
                 if origin is not None:
                     # The origin rides to every replica on the status

@@ -1,0 +1,793 @@
+"""Cooperative token leases: answer most checks with zero RPCs.
+
+A lease carves a bounded slice of a key's remaining budget out of the
+owner's device table and hands it to a holder (an edge tier or a client
+SDK). The holder then answers checks for that key entirely locally —
+decrement a local counter — and reconciles with the owner only at renew
+cadence. The common-case check costs zero RPCs; the owner's slot stays
+the single source of truth because the slice is *pre-consumed* at grant
+time (the carve rides the normal engine check path, so replicas learn
+about it through the existing GLOBAL hit-queue / broadcast legs).
+
+Honesty model (docs/architecture.md "Cooperative leases"):
+
+  conservation   granted − returned − expired == outstanding, in hit
+                 units, per manager. Handover transfers count as
+                 returned at the sender and granted at the receiver, so
+                 fleet-wide sums still conserve.
+  over-admission during a partition is bounded by Σ outstanding slice
+                 hits: the tokens were already consumed from the slot,
+                 so the worst case is every holder spending its full
+                 slice while unreachable — never more.
+  staleness      lease answers carry `lease_staleness_ms` (age of the
+                 grant), the same shape as `global_staleness_ms`.
+  clock skew     owners advertise a relative ttl clamped by the worst
+                 per-peer clock-skew estimate (metrics.peer_clock_skew),
+                 and enforce expiry on their own clock with a grace.
+
+Grant protocol (probe-then-carve): the owner first reads the bucket
+with a hits=0 probe, then carves min(want, remaining). Carving more
+than `remaining` would flip the stored status to OVER_LIMIT (the sticky
+over-limit quirk, models/oracle.py) and poison non-leased traffic in
+the same window — the probe keeps grants side-effect free on rejection.
+Returns credit the *unused* part of the slice back with a negative-hits
+check, but only when the bucket is still in the same window (probe
+reset_time matches the grant's) and clamped so remaining never exceeds
+limit. Expired leases credit nothing — conservative: unused tokens in
+an expired slice are lost to the window, never over-admitted.
+
+Everything here is event-loop state (like V1Service._global_last_update);
+the engine round trips go through check_bulk futures. The manager
+serializes probe→apply sections with an asyncio lock so two concurrent
+returns cannot both observe the same headroom and over-credit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Status,
+)
+
+log = logging.getLogger("gubernator.leases")
+
+# Metadata keys (wire-visible, documented in docs/architecture.md).
+LEASE_STALENESS_MD_KEY = "lease_staleness_ms"
+LEASE_REVOKE_MD_KEY = "lease_revoked_until_ms"
+RETRY_AFTER_MD_KEY = "retry_after_ms"
+
+# Behaviors a lease can never cover: RESET_REMAINING mutates the bucket
+# out-of-band of hit accounting, and Gregorian windows reset on calendar
+# boundaries the holder cannot compute from (reset_time alone is not
+# enough once DST/odd-month lengths enter).
+_INELIGIBLE = int(Behavior.RESET_REMAINING) | int(Behavior.DURATION_IS_GREGORIAN)
+
+# Expiry enforcement grace on the owner clock: holders run on their own
+# clocks bounded by the advertised ttl; the sweep waits this much past
+# the owner-side expiry stamp before reclaiming, so a slightly slow
+# holder's final return still finds its record.
+_SWEEP_GRACE_MS = 250
+
+
+def _hash_key(name: str, unique_key: str) -> str:
+    return name + "_" + unique_key
+
+
+@dataclass
+class LeaseRecord:
+    """Owner-side record of one outstanding slice."""
+
+    lease_id: str
+    key: str  # hash key (name + "_" + unique_key)
+    slice_hits: int
+    expiry_ms: int  # owner-clock absolute expiry
+    reset_time: int  # bucket window end at grant — the credit guard
+    limit: int
+    duration: int
+    behavior: int
+    stamp: int  # grant wall ms; LWW discriminator on handover merge
+    holder: str = ""
+
+    def to_wire(self) -> list:
+        return [
+            self.lease_id, self.key, self.slice_hits, self.expiry_ms,
+            self.reset_time, self.limit, self.duration, self.behavior,
+            self.stamp, self.holder,
+        ]
+
+    @classmethod
+    def from_wire(cls, row: Sequence) -> "LeaseRecord":
+        return cls(
+            lease_id=str(row[0]), key=str(row[1]), slice_hits=int(row[2]),
+            expiry_ms=int(row[3]), reset_time=int(row[4]), limit=int(row[5]),
+            duration=int(row[6]), behavior=int(row[7]), stamp=int(row[8]),
+            holder=str(row[9]) if len(row) > 9 else "",
+        )
+
+
+class LeaseManager:
+    """Owner-side lease authority for the keys this daemon owns.
+
+    Wired onto V1Service as `svc.lease_mgr` when GUBER_LEASES is on;
+    None (the default) keeps every code path bit-exact with today.
+    """
+
+    def __init__(
+        self,
+        svc,
+        ttl_s: float = 2.0,
+        fraction: float = 0.1,
+        max_leases: int = 4096,
+        sweep_interval_s: float = 1.0,
+        now_fn=None,
+    ):
+        self.svc = svc
+        self.ttl_ms = max(1, int(ttl_s * 1000))
+        self.fraction = min(1.0, max(0.0, fraction))
+        self.max_leases = max_leases
+        self.sweep_interval_s = sweep_interval_s
+        self.now_fn = now_fn or svc.now_fn
+        self._leases: Dict[str, LeaseRecord] = {}  # by lease_id
+        self._by_key: Dict[str, Set[str]] = {}
+        # key -> owner-clock ms until which new grants are refused
+        # (set by revoke; replicas keep their own copy via broadcast md).
+        self._revoked: Dict[str, int] = {}
+        self._seq = 0
+        self._apply_lock = asyncio.Lock()
+        self._task: Optional[asyncio.Task] = None
+        # Conservation ledger, in hit units. outstanding_hits() is
+        # derived, never stored — the property IS the bookkeeping test.
+        self.granted_hits = 0
+        self.returned_hits = 0
+        self.expired_hits = 0
+        self.credited_hits = 0  # info: actual credits applied
+        self.revocations = 0
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # guberlint: allow-swallow -- shutdown path; sweep errors were already logged per-pass
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sweep_interval_s)
+            try:
+                self.sweep()
+            except Exception:
+                log.exception("lease sweep failed")
+
+    # ---- derived state -----------------------------------------------------
+
+    def outstanding_hits(self) -> int:
+        return self.granted_hits - self.returned_hits - self.expired_hits
+
+    def outstanding_by_key(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for rec in self._leases.values():
+            out[rec.key] = out.get(rec.key, 0) + rec.slice_hits
+        return out
+
+    def has_leases(self, key: str) -> bool:
+        return bool(self._by_key.get(key))
+
+    def summary(self) -> dict:
+        """Debug blob for /debug/leases and the auditor's lease pass."""
+        by_key = self.outstanding_by_key()
+        top = sorted(by_key.items(), key=lambda kv: -kv[1])[:16]
+        return {
+            "leases": len(self._leases),
+            "keys": len(by_key),
+            "granted_hits": self.granted_hits,
+            "returned_hits": self.returned_hits,
+            "expired_hits": self.expired_hits,
+            "credited_hits": self.credited_hits,
+            "outstanding_hits": self.outstanding_hits(),
+            "revocations": self.revocations,
+            "revoked_keys": len(self._revoked),
+            "top_outstanding": [[k, v] for k, v in top],
+        }
+
+    # ---- clock-skew clamp --------------------------------------------------
+
+    def _skew_margin_ms(self) -> int:
+        """Worst observed |peer clock skew|, capped at half the ttl —
+        the grant's advertised relative ttl shrinks by this much so a
+        fast-clocked holder still stops serving before the owner-side
+        expiry sweep reclaims the slice."""
+        m = getattr(self.svc, "metrics", None)
+        gauge = getattr(m, "peer_clock_skew", None)
+        worst = 0.0
+        if gauge is not None:
+            try:
+                for fam in gauge.collect():
+                    for s in fam.samples:
+                        worst = max(worst, abs(float(s.value)))
+            except Exception:  # guberlint: allow-swallow -- prometheus client API drift degrades to margin 0 (the pre-skew behavior), nothing to count
+                worst = 0.0
+        return int(min(worst, self.ttl_ms / 2))
+
+    # ---- grant / return ----------------------------------------------------
+
+    def _eligible(self, g: dict) -> Optional[str]:
+        if int(g.get("algorithm", 0)) != int(Algorithm.TOKEN_BUCKET):
+            return "leases cover TOKEN_BUCKET only"
+        if int(g.get("behavior", 0)) & _INELIGIBLE:
+            return "behavior not leaseable"
+        if int(g.get("limit", 0)) <= 0 or int(g.get("duration", 0)) <= 0:
+            return "limit and duration must be positive"
+        return None
+
+    def _max_slice(self, limit: int) -> int:
+        return max(1, int(limit * self.fraction))
+
+    def _new_id(self) -> str:
+        self._seq += 1
+        addr = getattr(self.svc.local_info, "grpc_address", "") or "local"
+        return f"{addr}/{self._seq}"
+
+    def _probe_req(self, t: dict, now: int) -> RateLimitReq:
+        return RateLimitReq(
+            name=str(t["name"]), unique_key=str(t["unique_key"]),
+            hits=0, limit=int(t["limit"]), duration=int(t["duration"]),
+            algorithm=int(t.get("algorithm", 0)),
+            behavior=int(t.get("behavior", 0)) & ~int(Behavior.DRAIN_OVER_LIMIT),
+            burst=int(t.get("burst", 0)), created_at=now,
+        )
+
+    async def _bulk(self, reqs: List[RateLimitReq]):
+        fut = self.svc.engine.check_bulk(reqs)
+        return await asyncio.wrap_future(fut)
+
+    async def handle(
+        self, grants: List[dict], returns: List[dict], holder: str = ""
+    ) -> Tuple[List[dict], List[dict]]:
+        """One lease RPC: process returns then grants (a renew is a
+        return + grant in the same call, and crediting first maximizes
+        the headroom the new slice can carve from)."""
+        async with self._apply_lock:
+            ret_results = await self._handle_returns(returns)
+            grant_results = await self._handle_grants(grants, holder)
+        return grant_results, ret_results
+
+    async def _handle_returns(self, returns: List[dict]) -> List[dict]:
+        m = self.svc.metrics
+        results: List[dict] = [
+            {"lease_id": str(r.get("lease_id", "")), "status": "unknown"}
+            for r in returns
+        ]
+        live: List[Tuple[int, dict, LeaseRecord]] = []
+        for i, r in enumerate(returns):
+            rec = self._leases.get(str(r.get("lease_id", "")))
+            if rec is None:
+                # Expired, revoked, or re-homed past us: the holder just
+                # drops its copy; the tokens were reclaimed (or never
+                # ours to reclaim) already.
+                continue
+            live.append((i, r, rec))
+        if not live:
+            return results
+        now = self.now_fn()
+        probes = await self._bulk([self._probe_req(r, now) for _, r, _ in live])
+        credit_reqs: List[RateLimitReq] = []
+        credit_amounts: List[int] = []
+        headroom: Dict[str, int] = {}
+        for (i, r, rec), probe in zip(live, probes):
+            self._drop_record(rec)
+            used = max(0, min(int(r.get("used", 0)), rec.slice_hits))
+            unused = rec.slice_hits - used
+            self.returned_hits += rec.slice_hits
+            m.lease_hits.labels("returned").inc(rec.slice_hits)
+            results[i]["status"] = "ok"
+            if probe.error or unused <= 0:
+                continue
+            if probe.reset_time != rec.reset_time:
+                # The window rolled since the grant: the refill already
+                # restored these tokens, crediting again would mint new
+                # ones. Stale return, nothing to credit.
+                results[i]["status"] = "stale"
+                continue
+            room = headroom.setdefault(
+                rec.key, max(0, rec.limit - probe.remaining)
+            )
+            credit = min(unused, room)
+            if credit <= 0:
+                continue
+            headroom[rec.key] = room - credit
+            req = self._probe_req(r, now)
+            req.hits = -credit
+            credit_reqs.append(req)
+            credit_amounts.append(credit)
+        if credit_reqs:
+            applied = await self._bulk(credit_reqs)
+            for req, credit, resp in zip(credit_reqs, credit_amounts, applied):
+                if resp.error:
+                    continue
+                self.credited_hits += credit
+                m.lease_hits.labels("credited").inc(credit)
+                self._queue_global(req)
+        return results
+
+    async def _handle_grants(
+        self, grants: List[dict], holder: str
+    ) -> List[dict]:
+        m = self.svc.metrics
+        now = self.now_fn()
+        results: List[dict] = []
+        todo: List[Tuple[int, dict]] = []
+        for g in grants:
+            res = {
+                "ok": 0, "lease_id": "", "slice": 0, "ttl_ms": 0,
+                "expiry_ms": 0, "limit": int(g.get("limit", 0)),
+                "remaining": 0, "reset_time": 0, "retry_after_ms": 0,
+                "error": "",
+            }
+            err = self._eligible(g)
+            key = _hash_key(str(g.get("name", "")), str(g.get("unique_key", "")))
+            until = self._revoked.get(key, 0)
+            if err is None and until > now:
+                err = "revoked"
+                res["retry_after_ms"] = until - now
+                m.lease_grants.labels("revoked").inc()
+            elif err is None and len(self._leases) >= self.max_leases:
+                err = "lease table full"
+            if err is not None:
+                res["error"] = err
+                if res["retry_after_ms"] == 0:
+                    m.lease_grants.labels("rejected").inc()
+                results.append(res)
+                continue
+            results.append(res)
+            todo.append((len(results) - 1, g))
+        if not todo:
+            return results
+        probes = await self._bulk([self._probe_req(g, now) for _, g in todo])
+        carve_reqs: List[RateLimitReq] = []
+        carve_src: List[Tuple[int, dict, int, int]] = []  # (ri, g, want, reset)
+        # Track headroom per key inside this batch so two grants for the
+        # same key cannot both carve the same remaining tokens.
+        seen_rem: Dict[str, int] = {}
+        for (ri, g), probe in zip(todo, probes):
+            res = results[ri]
+            if probe.error:
+                res["error"] = probe.error
+                m.lease_grants.labels("rejected").inc()
+                continue
+            key = _hash_key(str(g["name"]), str(g["unique_key"]))
+            rem = seen_rem.get(key, probe.remaining)
+            res["remaining"] = rem
+            res["reset_time"] = probe.reset_time
+            cap = self._max_slice(int(g["limit"]))
+            want = int(g.get("want", 0)) or cap
+            want = max(1, min(want, cap, rem))
+            if rem <= 0 or probe.status == Status.OVER_LIMIT:
+                res["error"] = "over limit"
+                res["retry_after_ms"] = max(0, probe.reset_time - now)
+                m.lease_grants.labels("rejected").inc()
+                continue
+            seen_rem[key] = rem - want
+            req = self._probe_req(g, now)
+            req.hits = want
+            carve_reqs.append(req)
+            carve_src.append((ri, g, want, probe.reset_time))
+        if not carve_reqs:
+            return results
+        carved = await self._bulk(carve_reqs)
+        margin = self._skew_margin_ms()
+        for (ri, g, want, reset), resp in zip(carve_src, carved):
+            res = results[ri]
+            if resp.error:
+                res["error"] = resp.error
+                m.lease_grants.labels("rejected").inc()
+                continue
+            if resp.status != Status.UNDER_LIMIT:
+                # Lost the race to concurrent traffic between probe and
+                # carve; OVER_LIMIT carves consume nothing, so rejecting
+                # here is clean.
+                res["error"] = "over limit"
+                res["retry_after_ms"] = max(0, resp.reset_time - now)
+                m.lease_grants.labels("rejected").inc()
+                continue
+            key = _hash_key(str(g["name"]), str(g["unique_key"]))
+            expiry = min(now + self.ttl_ms, resp.reset_time)
+            rec = LeaseRecord(
+                lease_id=self._new_id(), key=key, slice_hits=want,
+                expiry_ms=expiry, reset_time=reset, limit=int(g["limit"]),
+                duration=int(g["duration"]), behavior=int(g.get("behavior", 0)),
+                stamp=now, holder=holder,
+            )
+            self._install(rec)
+            self.granted_hits += want
+            m.lease_hits.labels("granted").inc(want)
+            m.lease_grants.labels("granted").inc()
+            carve_req = self._probe_req(g, now)
+            carve_req.hits = want
+            self._queue_global(carve_req)
+            res.update(
+                ok=1, lease_id=rec.lease_id, slice=want,
+                ttl_ms=max(1, expiry - now - margin), expiry_ms=expiry,
+                remaining=resp.remaining, reset_time=resp.reset_time,
+            )
+        return results
+
+    def _queue_global(self, req: RateLimitReq) -> None:
+        """Carves and credits on GLOBAL keys ride the existing
+        hit-queue/broadcast reconciliation so replicas converge on the
+        post-lease remaining."""
+        gm = getattr(self.svc, "global_mgr", None)
+        if gm is not None and req.behavior & int(Behavior.GLOBAL):
+            gm.queue_update(req)
+
+    def _install(self, rec: LeaseRecord) -> None:
+        self._leases[rec.lease_id] = rec
+        self._by_key.setdefault(rec.key, set()).add(rec.lease_id)
+
+    def _drop_record(self, rec: LeaseRecord) -> None:
+        self._leases.pop(rec.lease_id, None)
+        ids = self._by_key.get(rec.key)
+        if ids is not None:
+            ids.discard(rec.lease_id)
+            if not ids:
+                self._by_key.pop(rec.key, None)
+
+    # ---- expiry / revocation ----------------------------------------------
+
+    def sweep(self) -> int:
+        """Reclaim expired leases (owner clock + grace). Credits
+        nothing: expiry ≤ reset_time by construction, and losing unused
+        tokens under-admits — the conservative side of the bound."""
+        now = self.now_fn()
+        m = self.svc.metrics
+        expired = [
+            rec for rec in self._leases.values()
+            if now >= rec.expiry_ms + _SWEEP_GRACE_MS
+        ]
+        for rec in expired:
+            self._drop_record(rec)
+            self.expired_hits += rec.slice_hits
+            m.lease_hits.labels("expired").inc(rec.slice_hits)
+        for key, until in list(self._revoked.items()):
+            if now >= until:
+                self._revoked.pop(key, None)
+        m.lease_outstanding_hits.set(self.outstanding_hits())
+        return len(expired)
+
+    def revoke(self, key: str, until_ms: int) -> int:
+        """Drop every lease on `key` and refuse new grants until
+        `until_ms` (normally the bucket's reset_time). Rides the GLOBAL
+        broadcast legs: the caller attaches LEASE_REVOKE_MD_KEY to the
+        broadcast status so replicas refuse grants too."""
+        ids = list(self._by_key.get(key, ()))
+        for lid in ids:
+            rec = self._leases.get(lid)
+            if rec is None:
+                continue
+            self._drop_record(rec)
+            # Forced expiry: the slice is no longer outstanding; its
+            # unspent tokens stay consumed (the key is over limit — that
+            # is exactly when minting tokens back would be wrong).
+            self.expired_hits += rec.slice_hits
+            self.svc.metrics.lease_hits.labels("expired").inc(rec.slice_hits)
+        if ids:
+            self.revocations += 1
+            self.svc.metrics.lease_revocations.inc()
+        self._revoked[key] = max(self._revoked.get(key, 0), until_ms)
+        return len(ids)
+
+    # ---- handover ----------------------------------------------------------
+
+    def export_for(self, route) -> Dict[object, List[list]]:
+        """Pop lease records for keys re-homing to other peers (handover
+        sender half). `route(key)` returns the destination peer or None
+        (same contract as PeerMesh ring-change routing). Popped records
+        count as returned here and granted at the adopter, keeping each
+        manager's conservation exact while fleet sums conserve."""
+        out: Dict[object, List[list]] = {}
+        m = self.svc.metrics
+        for rec in list(self._leases.values()):
+            dest = route(rec.key)
+            if dest is None:
+                continue
+            self._drop_record(rec)
+            self.returned_hits += rec.slice_hits
+            m.lease_hits.labels("returned").inc(rec.slice_hits)
+            out.setdefault(dest, []).append(rec.to_wire())
+        return out
+
+    def adopt(self, rows: Sequence[Sequence]) -> Tuple[int, int]:
+        """Handover receiver half: install transferred lease records,
+        last-writer-wins on stamp per lease id (same discipline as
+        merge_snapshots_lww). Returns (accepted, stale)."""
+        accepted = stale = 0
+        m = self.svc.metrics
+        for row in rows:
+            try:
+                rec = LeaseRecord.from_wire(row)
+            except (IndexError, ValueError, TypeError):
+                stale += 1
+                continue
+            have = self._leases.get(rec.lease_id)
+            if have is not None and have.stamp >= rec.stamp:
+                stale += 1
+                continue
+            if have is None:
+                self.granted_hits += rec.slice_hits
+                m.lease_hits.labels("granted").inc(rec.slice_hits)
+            self._install(rec)
+            accepted += 1
+        return accepted, stale
+
+
+# ---------------------------------------------------------------------------
+# Holder side: the local slice cache shared by the edge tier and the
+# client SDK. Transport-agnostic — the owner drives renewal by calling
+# collect()/apply() around whatever Lease RPC it speaks.
+
+
+@dataclass
+class _CacheEntry:
+    lease_id: str
+    template: dict  # grant-request template (name, unique_key, limit, ...)
+    slice_hits: int
+    local_remaining: int
+    used: int  # hits served against this lease so far
+    remaining_at_grant: int  # owner-reported remaining AFTER the carve
+    limit: int
+    reset_time: int
+    expiry_local_ms: int
+    granted_ms: int
+    renewing: bool = False
+    renew_used_snapshot: int = 0
+
+
+def lease_template(req: RateLimitReq) -> dict:
+    return {
+        "name": req.name, "unique_key": req.unique_key,
+        "limit": req.limit, "duration": req.duration,
+        "algorithm": int(req.algorithm), "behavior": int(req.behavior),
+        "burst": req.burst, "want": 0,
+    }
+
+
+class LeaseCache:
+    """Holder-side slice cache. try_serve() is the zero-RPC hot path;
+    collect()/apply() run at renew cadence around a Lease RPC."""
+
+    def __init__(
+        self,
+        low_water: float = 0.25,
+        max_keys: int = 1024,
+        now_fn=None,
+    ):
+        from gubernator_tpu.utils import clock as _clock
+
+        self.low_water = min(1.0, max(0.0, low_water))
+        self.max_keys = max_keys
+        self.now_fn = now_fn or _clock.now_ms
+        self._entries: Dict[str, _CacheEntry] = {}
+        # Keys we saw miss and want a lease for: key -> template.
+        self._wanted: Dict[str, dict] = {}
+        # Dead leases awaiting their final return: wire return dicts.
+        self._pending_returns: List[dict] = []
+        # Negative cache: key -> local ms until which grants are futile.
+        self._denied: Dict[str, int] = {}
+        self.inflight = False
+        self.stats = {
+            "local_answers": 0, "misses": 0, "grants": 0,
+            "rejects": 0, "renews": 0, "expiries": 0,
+        }
+
+    # ---- hot path ----------------------------------------------------------
+
+    def _leasable(self, req: RateLimitReq) -> bool:
+        return (
+            int(req.algorithm) == int(Algorithm.TOKEN_BUCKET)
+            and not (int(req.behavior) & _INELIGIBLE)
+            and req.limit > 0
+            and req.duration > 0
+            and req.hits >= 0
+        )
+
+    def try_serve(self, req: RateLimitReq) -> Optional["RateLimitResp"]:
+        """Answer locally from the leased slice, or return None (caller
+        falls through to the RPC path). A miss on a leasable key marks
+        it wanted so the next maintenance RPC grabs a lease."""
+        from gubernator_tpu.api.types import RateLimitResp
+
+        if not self._leasable(req):
+            return None
+        key = req.hash_key()
+        now = self.now_fn()
+        e = self._entries.get(key)
+        if e is not None and now >= e.expiry_local_ms:
+            self._retire(key, e)
+            e = None
+        if e is None:
+            self.stats["misses"] += 1
+            if (
+                self._denied.get(key, 0) <= now
+                and len(self._entries) < self.max_keys
+            ):
+                self._wanted.setdefault(key, lease_template(req))
+            return None
+        if req.hits > e.local_remaining:
+            # Slice exhausted (or request bigger than the slice): the
+            # authoritative answer — OVER_LIMIT with retry_after, or a
+            # fresh carve — must come from the owner.
+            self.stats["misses"] += 1
+            self._wanted.setdefault(key, dict(e.template))
+            return None
+        e.local_remaining -= req.hits
+        e.used += req.hits
+        self.stats["local_answers"] += 1
+        return RateLimitResp(
+            status=Status.UNDER_LIMIT,
+            limit=e.limit,
+            remaining=max(0, e.remaining_at_grant - e.used),
+            reset_time=e.reset_time,
+            metadata={
+                LEASE_STALENESS_MD_KEY: str(max(0, now - e.granted_ms))
+            },
+        )
+
+    def _retire(self, key: str, e: _CacheEntry) -> None:
+        self._entries.pop(key, None)
+        self.stats["expiries"] += 1
+        ret = dict(e.template)
+        ret.pop("want", None)
+        ret["lease_id"] = e.lease_id
+        ret["used"] = e.used
+        self._pending_returns.append(ret)
+
+    def drop(self, key: str) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            ret = dict(e.template)
+            ret.pop("want", None)
+            ret["lease_id"] = e.lease_id
+            ret["used"] = e.used
+            self._pending_returns.append(ret)
+
+    def drain_for_close(self) -> None:
+        """Shutdown prep: retire every entry into pending returns and
+        forget wanted/denied state, so the holder's final maintenance
+        RPC only returns slices — it must never request a fresh grant
+        the holder won't live to use."""
+        for key in list(self._entries):
+            self.drop(key)
+        self._wanted.clear()
+        self._denied.clear()
+
+    # ---- maintenance (renew cadence) --------------------------------------
+
+    def due(self) -> bool:
+        """True when a maintenance RPC would do useful work."""
+        if self.inflight:
+            return False
+        if self._wanted or self._pending_returns:
+            return True
+        now = self.now_fn()
+        for e in self._entries.values():
+            if e.renewing:
+                continue
+            if now >= e.expiry_local_ms:
+                return True
+            if e.local_remaining <= e.slice_hits * self.low_water:
+                return True
+        return False
+
+    def collect(self) -> Tuple[List[dict], List[dict]]:
+        """Build (grants, returns) for one Lease RPC and mark the cache
+        in-flight. A renew is the old lease's return (used so far) plus
+        a fresh grant; the entry keeps serving its residual slice while
+        the RPC flies — apply() self-charges any flight-time hits
+        against the new slice so nothing is admitted twice."""
+        now = self.now_fn()
+        grants: List[dict] = []
+        returns: List[dict] = list(self._pending_returns)
+        self._pending_returns = []
+        for key, e in list(self._entries.items()):
+            if now >= e.expiry_local_ms:
+                self._retire(key, e)
+                ret = self._pending_returns.pop()
+                returns.append(ret)
+                self._wanted.setdefault(key, dict(e.template))
+                continue
+            if e.renewing or e.local_remaining > e.slice_hits * self.low_water:
+                continue
+            e.renewing = True
+            e.renew_used_snapshot = e.used
+            ret = dict(e.template)
+            ret.pop("want", None)
+            ret["lease_id"] = e.lease_id
+            ret["used"] = e.used
+            returns.append(ret)
+            grants.append(dict(e.template))
+            self.stats["renews"] += 1
+        for key, t in self._wanted.items():
+            if key not in self._entries or not any(
+                g["name"] == t["name"] and g["unique_key"] == t["unique_key"]
+                for g in grants
+            ):
+                grants.append(dict(t))
+        self._wanted = {}
+        self.inflight = bool(grants or returns)
+        return grants, returns
+
+    def apply(self, grants_sent: List[dict], grant_results: List[dict]) -> None:
+        """Install grant results from a Lease RPC (positional with the
+        grants collect() returned)."""
+        now = self.now_fn()
+        self.inflight = False
+        for g, res in zip(grants_sent, grant_results):
+            key = _hash_key(str(g["name"]), str(g["unique_key"]))
+            old = self._entries.get(key)
+            flight_extra = 0
+            if old is not None and old.renewing:
+                flight_extra = max(0, old.used - old.renew_used_snapshot)
+            if not res.get("ok"):
+                self.stats["rejects"] += 1
+                self._entries.pop(key, None)
+                ra = int(res.get("retry_after_ms", 0) or 0)
+                if ra > 0:
+                    self._denied[key] = now + ra
+                continue
+            slice_hits = int(res["slice"])
+            if (
+                old is not None
+                and not old.renewing
+                and str(old.lease_id) != str(res["lease_id"])
+            ):
+                # A fresh grant displaced a live slice we never returned
+                # (exhausted-slice top-up raced a grant): owe the old
+                # lease back next round, or its hits sit on the owner's
+                # ledger as outstanding until expiry forfeits them.
+                self.drop(key)
+            self._entries[key] = _CacheEntry(
+                lease_id=str(res["lease_id"]),
+                template=dict(g),
+                slice_hits=slice_hits,
+                local_remaining=max(0, slice_hits - flight_extra),
+                used=flight_extra,
+                remaining_at_grant=int(res.get("remaining", 0)),
+                limit=int(res.get("limit", g.get("limit", 0))),
+                reset_time=int(res.get("reset_time", 0)),
+                expiry_local_ms=now + int(res.get("ttl_ms", 1)),
+                granted_ms=now,
+            )
+            self.stats["grants"] += 1
+
+    def abort(self) -> None:
+        """The Lease RPC failed in transit: clear in-flight state. Renew
+        returns that never landed stay owed (re-sent next round)."""
+        self.inflight = False
+        for e in self._entries.values():
+            e.renewing = False
+
+    def outstanding_hits(self) -> int:
+        return sum(e.local_remaining for e in self._entries.values())
+
+    def summary(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "wanted": len(self._wanted),
+            "pending_returns": len(self._pending_returns),
+            "outstanding_local_hits": self.outstanding_hits(),
+            **self.stats,
+        }

@@ -254,6 +254,33 @@ class Peer:
         )
         return pb.transfer_resp_from_bytes(raw)
 
+    async def lease(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> bytes:
+        """Forward one Lease RPC (pb.lease_req_to_bytes payload) to this
+        peer — the daemon-to-owner leg of a holder's grant/renew/return.
+        Breaker- and fault-wrapped like every transport leg; runs at
+        renew cadence, never per check."""
+        try:
+            if faults.active():
+                await faults.inject(
+                    self.info.grpc_address, faults.OP_PEER_LEASE
+                )
+            out = await self._rpc_lease(payload, timeout)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return out
+
+    async def _rpc_lease(
+        self, payload: bytes, timeout: Optional[float]
+    ) -> bytes:
+        stub = self._ensure_stub()
+        return await stub.lease(
+            payload, timeout=timeout or self.behaviors.global_timeout_s
+        )
+
     async def debug_info(
         self, keys: Optional[Sequence[str]] = None,
         timeout: Optional[float] = None,
@@ -630,6 +657,22 @@ class PeerMesh:
             )
         if not by_peer:
             return
+        # Outstanding lease records ride the first chunk to each new
+        # owner (pb.snapshots_to_bytes `leases=`), so holders keep
+        # serving through the handover without re-granting. Records are
+        # popped here (sender counts them returned, adopter re-grants) —
+        # a failed ship loses only the record, never counter state, and
+        # the holder's next renew re-grants from the new owner.
+        lease_rows: Dict[str, list] = {}
+        lm = getattr(self.svc, "lease_mgr", None)
+        if lm is not None:
+            def _lease_route(key: str):
+                peer = route(key)
+                if peer is None or peer.info.grpc_address not in by_peer:
+                    return None
+                return peer.info.grpc_address
+
+            lease_rows = lm.export_for(_lease_route)
         budget_s = float(getattr(self.behaviors, "forward_deadline_s", 2.0))
 
         async def ship(peer: Peer, items) -> int:
@@ -657,7 +700,8 @@ class PeerMesh:
                 try:
                     await peer.transfer_snapshots(
                         pb.snapshots_to_bytes(
-                            part, metadata=tracing.propagate_inject({})
+                            part, metadata=tracing.propagate_inject({}),
+                            leases=lease_rows.get(addr) if i == 0 else None,
                         ),
                         timeout=remaining,
                     )

@@ -1094,6 +1094,7 @@ def _census_tier_snapshot(
         "idle_ms_hist": a["idle_hist"],
         "idle_ms_sum": a["idle_sum"],
         "heatmap": a["heatmap"],
+        "cold_heatmap": a["cold_heatmap"],
         "heatmap_groups_per_region": -(-groups // heatmap_width),
         "fill_hist": a["fill_hist"],
         "max_full_run": a["max_full_run"],
@@ -1160,6 +1161,7 @@ def _census_combine(tiers: Dict[str, dict], primary: str) -> dict:
         "idle_ms_hist": vsum("idle_ms_hist"),
         "idle_ms_sum": sum(t["idle_ms_sum"] for t in tiers.values()),
         "heatmap": p["heatmap"],
+        "cold_heatmap": p["cold_heatmap"],
         "heatmap_groups_per_region": p["heatmap_groups_per_region"],
         "fill_hist": p["fill_hist"],
         "max_full_run": p["max_full_run"],
@@ -1333,9 +1335,21 @@ class DeviceEngine(EngineBase):
                 cold_slots = int(cold[0]["slots"]) if cold else 0  # guberlint: allow-host-sync -- census dict is host data (TTL-cached scrape)
                 if int(dev.get("live", 0)) > 0 and cold_slots == 0:
                     continue  # resident set is fully hot: don't thrash
+                # Victim policy: fold the census per-region cold-slot
+                # heatmap into per-page coldness so the demoter evicts
+                # pages whose SLOTS are idle, not merely pages with the
+                # oldest touch tick (a single probe re-warms a page's
+                # tick; the census still sees its other slots as cold).
+                coldness = None
+                ch = dev.get("cold_heatmap")
+                if ch:
+                    coldness = pager.coldness_from_heatmap(
+                        ch, int(dev.get("heatmap_groups_per_region", 1))
+                    )
                 with self._lock:
                     self.table = pager.demote_victims(
-                        self.table, want_free=want, min_idle_ticks=1
+                        self.table, want_free=want, min_idle_ticks=1,
+                        coldness=coldness,
                     )
             except Exception:  # pragma: no cover - defensive
                 # The demoter is an optimization: serving-path demand

@@ -137,6 +137,13 @@ class IciEngine(EngineBase):
     # submission in server._get_rate_limits)
     routes_global_internally = True
 
+    # Serve-flat fallback warn-once latch: a daemon restart loop (or a
+    # test suite constructing many engines) must not spam the same
+    # capability warning per construction — once per process is the
+    # operator signal; per-engine visibility lives in /debug/engine and
+    # the census "pages" section instead.
+    _paging_warned = False
+
     def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):
         cfg = config
         devices = cfg.devices or jax.devices()
@@ -148,7 +155,9 @@ class IciEngine(EngineBase):
             )
         if cfg.max_waves < 1:
             raise ValueError("max_waves must be >= 1")
-        if int(getattr(cfg, "page_groups", 0) or 0) > 0:
+        self._paging_requested = int(getattr(cfg, "page_groups", 0) or 0) > 0
+        if self._paging_requested and not IciEngine._paging_warned:
+            IciEngine._paging_warned = True
             log.warning(
                 "table paging (page_groups=%d) is not yet implemented "
                 "for the ici engine's sharded tiers; serving flat — "
@@ -618,7 +627,19 @@ class IciEngine(EngineBase):
                 heatmap_width=int(cfg.census_heatmap_width),
             ),
         }
-        return _census_combine(tiers, primary="sharded")
+        snap = _census_combine(tiers, primary="sharded")
+        if self._paging_requested:
+            # Same section the paged DeviceEngine fills from its Pager:
+            # an operator who set GUBER_TABLE_PAGE_* sees WHY there is
+            # no resident/host breakdown instead of a silent absence.
+            snap["pages"] = {"enabled": False, "paging": "unsupported (flat)"}
+        return snap
+
+    def debug_snapshot(self) -> dict:
+        snap = super().debug_snapshot()
+        if self._paging_requested:
+            snap["paging"] = "unsupported (flat)"
+        return snap
 
     def close(self) -> None:
         self._stop_sync.set()

@@ -198,20 +198,66 @@ class Pager:
             return None
         return min(candidates, key=lambda lp: int(self.touch[lp]))  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
 
+    def coldness_from_heatmap(
+        self, cold_heatmap, groups_per_region: int
+    ) -> Dict[int, float]:
+        """Fold the census per-region cold-slot heatmap (physical-group
+        axis — the census scans the resident table) into a per-LOGICAL-
+        page coldness score: each resident page sums the cold counts of
+        the regions its frame's group span overlaps, weighted by overlap
+        fraction. O(resident x regions-per-page), demote cadence only."""
+        hm = np.asarray(cold_heatmap, dtype=np.float64)  # guberlint: allow-host-sync -- census heatmap fold runs at demote cadence, never per request
+        per = max(1, int(groups_per_region))
+        gpp = self.PK.groups_per_page
+        out: Dict[int, float] = {}
+        for lp in np.nonzero(self.page_map >= 0)[0].tolist():
+            pp = int(self.page_map[lp])  # guberlint: allow-host-sync -- page_map is a host numpy mirror, not device data
+            g0, g1 = pp * gpp, (pp + 1) * gpp
+            total = 0.0
+            for r in range(g0 // per, min((g1 - 1) // per, len(hm) - 1) + 1):
+                overlap = min(g1, (r + 1) * per) - max(g0, r * per)
+                if overlap > 0:
+                    total += float(hm[r]) * (overlap / float(per))  # guberlint: allow-host-sync -- census heatmap fold runs at demote cadence, never per request
+            out[lp] = total
+        return out
+
+    def _pick_victim(
+        self, coldness: Optional[Dict[int, float]]
+    ) -> Optional[int]:
+        """Demoter victim: census-coldest resident page first, LRU touch
+        tick as the tiebreak (and the whole ordering when no census
+        coldness is available). The census sees what touch ticks cannot:
+        a single probe re-warms a page's tick while the census still
+        counts every other slot on it as idle — such a hot-touched but
+        census-cold page should go before a genuinely busy one."""
+        resident = np.nonzero(self.page_map >= 0)[0].tolist()
+        if not resident:
+            return None
+        cold = coldness or {}
+        return min(
+            resident,
+            key=lambda lp: (-cold.get(lp, 0.0), int(self.touch[lp])),  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
+        )
+
     def demote_victims(
-        self, table, want_free: int, min_idle_ticks: int = 0
+        self, table, want_free: int, min_idle_ticks: int = 0, coldness=None
     ):
-        """Background-demoter entry: demote LRU resident pages until
-        `want_free` frames are free. With min_idle_ticks > 0, only pages
-        untouched for at least that many ensure_resident rounds qualify
-        (the census cold gate decides whether the demoter calls this at
-        all). Returns the updated table."""
+        """Background-demoter entry: demote resident pages until
+        `want_free` frames are free — census-coldest first when the
+        engine passes the per-page `coldness` fold (coldness_from_
+        heatmap), pure LRU otherwise. With min_idle_ticks > 0, pages
+        touched within that many ensure_resident rounds are spared
+        UNLESS the census marks them cold (the census is the stronger
+        signal: it counts idle slots, a touch tick only remembers the
+        last probe). Returns the updated table."""
         while len(self.free) < want_free:
-            victim = self._coldest_resident(set())
+            victim = self._pick_victim(coldness)
             if victim is None:
                 break
+            census_cold = bool(coldness) and coldness.get(victim, 0.0) > 0
             if (
-                min_idle_ticks > 0
+                not census_cold
+                and min_idle_ticks > 0
                 and self._tick - int(self.touch[victim]) < min_idle_ticks  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
             ):
                 break  # everything left is too recently touched

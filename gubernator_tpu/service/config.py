@@ -100,6 +100,39 @@ class BehaviorConfig:
     # GUBER_CONSISTENCY_AUDIT_KEYS: max owned keys sampled per pass.
     consistency_audit_keys: int = 32
 
+    # -- cooperative token leases (docs/architecture.md "Cooperative
+    # leases"; no reference analog: every reference check costs an RPC) --
+
+    # GUBER_LEASES: master switch. Off (default) keeps every path
+    # bit-exact with the pre-lease daemon — no LeaseManager is wired, no
+    # probe/carve checks run, snapshot chunks carry no lease rows.
+    leases: bool = False
+    # GUBER_LEASE_TTL: owner-side lease lifetime; the advertised holder
+    # ttl is this minus the worst observed peer clock skew, and never
+    # reaches past the bucket window's reset_time.
+    lease_ttl_s: float = 2.0
+    # GUBER_LEASE_FRACTION: max slice per grant as a fraction of the
+    # key's limit — bounds one holder's share of the budget (and with
+    # it the worst-case over-admission per holder per ttl).
+    lease_fraction: float = 0.1
+    # GUBER_LEASE_LOW_WATER: holders renew when the local slice falls
+    # below this fraction of its granted size.
+    lease_low_water: float = 0.25
+    # GUBER_LEASE_MAX_KEYS: cap on outstanding lease records per owner
+    # (grants reject past it) and on distinct leased keys per holder
+    # cache.
+    lease_max_keys: int = 4096
+    # GUBER_LEASE_SWEEP_INTERVAL: cadence of the owner-side expiry sweep
+    # that reclaims lapsed slices (conservation's `expired` term).
+    lease_sweep_interval_s: float = 1.0
+
+    # GUBER_RETRY_AFTER: server-suggested backoff — OVER_LIMIT responses
+    # (leased and unleased) carry retry_after_ms derived from
+    # reset_time. Off (default) keeps responses bit-exact with today;
+    # on trades the columnar fast edge for the richer responses (only
+    # the object path attaches metadata, service/fastpath.py).
+    retry_after: bool = False
+
 
 @dataclasses.dataclass
 class EtcdConfig:

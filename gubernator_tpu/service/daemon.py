@@ -119,6 +119,9 @@ class Daemon:
             metrics=metrics,
             force_global=conf.behaviors.force_global,
         )
+        # Server-suggested backoff (GUBER_RETRY_AFTER): OVER_LIMIT
+        # responses carry retry_after_ms; off keeps responses bit-exact.
+        self.svc.retry_after = conf.behaviors.retry_after
         # Columnar serving edge. A Store no longer disables it:
         # check_columns runs the same per-wave probe -> read-through ->
         # decide -> write-behind sequence as the object path (and records
@@ -233,6 +236,23 @@ class Daemon:
         from gubernator_tpu.parallel.peers import wire_peers
 
         wire_peers(self, global_mode=conf.global_mode)
+
+        # Cooperative token leases (docs/architecture.md "Cooperative
+        # leases"): owner-side authority + expiry sweep, only under
+        # GUBER_LEASES — the None default keeps every path bit-exact.
+        self._lease_mgr = None
+        if conf.behaviors.leases:
+            from gubernator_tpu.parallel.leases import LeaseManager
+
+            self._lease_mgr = LeaseManager(
+                self.svc,
+                ttl_s=conf.behaviors.lease_ttl_s,
+                fraction=conf.behaviors.lease_fraction,
+                max_leases=conf.behaviors.lease_max_keys,
+                sweep_interval_s=conf.behaviors.lease_sweep_interval_s,
+            )
+            self.svc.lease_mgr = self._lease_mgr
+            self._lease_mgr.start()
 
         # Background divergence auditor (consistency observatory,
         # docs/monitoring.md "Consistency"): samples broadcast keys and
@@ -407,6 +427,10 @@ class Daemon:
             await self.svc.global_mgr.close()
         if self.svc is not None and getattr(self.svc, "region_mgr", None) is not None:
             await self.svc.region_mgr.close()
+        # After drain_handover: the handover ships outstanding lease
+        # records to ring successors, so the manager must outlive it.
+        if getattr(self, "_lease_mgr", None) is not None:
+            await self._lease_mgr.close()
         if self.engine is not None:
             # Engine close blocks for its own drain pass; keep the event
             # loop responsive (other in-process daemons share it).

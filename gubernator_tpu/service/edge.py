@@ -21,6 +21,7 @@ Frame format (little-endian):
     response: u32 frame_len | u8 status | u64 call_id | payload
 methods: 1 = V1/GetRateLimits (payload = GetRateLimitsReq bytes)
          2 = V1/HealthCheck   (payload ignored)
+         3 = V1/Lease         (payload = lease request bytes, pb.py codec)
 status:  0 = ok    (payload = response message bytes)
          1 = error (payload = u8 code_len | grpc-code-name | utf-8 message)
 Responses are matched by call_id and may arrive out of order (the
@@ -40,6 +41,7 @@ log = logging.getLogger("gubernator_tpu.edge")
 
 METHOD_GET_RATE_LIMITS = 1
 METHOD_HEALTH_CHECK = 2
+METHOD_LEASE = 3
 
 _HDR = struct.Struct("<IBQ")  # frame_len (of method..payload) | method | call_id
 MAX_FRAME = 8 << 20  # a 1000-item batch is ~100KB; 8MB is generous
@@ -141,6 +143,7 @@ class EdgeListener:
         from gubernator_tpu.service import pb
         from gubernator_tpu.service.grpc_service import (
             serve_get_rate_limits_bytes,
+            serve_lease_bytes,
         )
         from gubernator_tpu.service.server import ApiError
 
@@ -156,6 +159,11 @@ class EdgeListener:
                     self.svc.metrics, "/pb.gubernator.V1/GetRateLimits"
                 ):
                     out = await serve_get_rate_limits_bytes(self.svc, payload)
+            elif method == METHOD_LEASE:
+                async with _instrumented(
+                    self.svc.metrics, "/pb.gubernator.V1/Lease"
+                ):
+                    out = await serve_lease_bytes(self.svc, payload, None)
             elif method == METHOD_HEALTH_CHECK:
                 async with _instrumented(
                     self.svc.metrics, "/pb.gubernator.V1/HealthCheck"
@@ -321,17 +329,150 @@ class EdgeClient:
         self._conns = [None] * self._n
 
 
-class EdgeV1Servicer:
-    """grpc.aio servicer for the edge process: relays raw bytes."""
+class EdgeLeases:
+    """Edge-tier lease holder: a LeaseCache plus the maintenance driver
+    that reconciles it with the device daemon over METHOD_LEASE frames.
 
-    def __init__(self, client: EdgeClient):
+    Wired into EdgeV1Servicer / build_edge_app when GUBER_LEASES is on
+    at the edge process; None (the default) keeps the edge a pure byte
+    relay — bit-exact with today's wire behavior. Maintenance is lazy:
+    each served call checks cache.due() and fires at most one
+    background Lease RPC (renew at the low-water mark, returns for
+    retired slices, grants for newly-wanted keys) — the cache's
+    `inflight` flag is the only serialization needed because the edge
+    process is single-loop."""
+
+    def __init__(self, client: EdgeClient, cache, holder: str = "edge",
+                 local_counter=None):
         self.client = client
+        self.cache = cache
+        self.holder = holder
+        self.local_counter = local_counter
+        self._tasks: set = set()
+
+    def try_serve(self, req):
+        resp = self.cache.try_serve(req)
+        if resp is not None and self.local_counter is not None:
+            self.local_counter.inc()
+        return resp
+
+    def kick(self) -> None:
+        if not self.cache.due():
+            return
+        t = asyncio.ensure_future(self.maintain())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def maintain(self) -> None:
+        from gubernator_tpu.service import pb
+
+        grants, returns = self.cache.collect()
+        if not grants and not returns:
+            self.cache.inflight = False
+            return
+        try:
+            raw = await self.client.call(
+                METHOD_LEASE,
+                pb.lease_req_to_bytes(grants, returns, holder=self.holder),
+            )
+            g_res, _r_res, _md = pb.lease_resp_from_bytes(raw)
+        # guberlint: allow-swallow -- maintenance is advisory; failed renews re-send next round and the owner-side sweep reclaims anything we never return
+        except (EdgeError, ValueError, TypeError) as e:
+            log.debug("edge lease maintenance failed: %s", e)
+            self.cache.abort()
+            return
+        self.cache.apply(grants, g_res)
+
+    async def close(self) -> None:
+        """Best-effort final return of every held slice so the owner
+        reclaims tokens as `returned` instead of waiting for expiry."""
+        # A renewal in flight re-installs an entry on apply(); let it
+        # land first so the final return covers every live slice.
+        for t in list(self._tasks):
+            try:
+                await asyncio.wait_for(t, timeout=2.0)
+            except (asyncio.TimeoutError, EdgeError):
+                pass
+        self.cache.drain_for_close()
+        try:
+            await asyncio.wait_for(self.maintain(), timeout=2.0)
+        except (asyncio.TimeoutError, EdgeError):
+            pass
+
+
+async def serve_edge_get_rate_limits(
+    client: EdgeClient, raw: bytes, leases: Optional[EdgeLeases] = None
+) -> bytes:
+    """GetRateLimits over the framed upstream, optionally through the
+    edge lease cache: leased items are answered locally (zero frames to
+    the daemon), only the misses are forwarded, and the responses are
+    spliced back in request order. With `leases` None this is exactly
+    the old one-line byte relay."""
+    if leases is None:
+        return await client.call(METHOD_GET_RATE_LIMITS, raw)
+    from gubernator_tpu.service import pb
+
+    try:
+        msg = pb.pb.GetRateLimitsReq.FromString(raw)
+    except Exception:  # guberlint: allow-swallow -- unparseable payload relays verbatim so the daemon produces the same error a lease-less edge would
+        return await client.call(METHOD_GET_RATE_LIMITS, raw)
+    local = {}
+    miss: list = []
+    for i, m in enumerate(msg.requests):
+        resp = leases.try_serve(pb.req_from_pb(m))
+        if resp is not None:
+            local[i] = resp
+        else:
+            miss.append(i)
+    leases.kick()
+    if not local:
+        return await client.call(METHOD_GET_RATE_LIMITS, raw)
+    fwd_resps = []
+    if miss:
+        sub = pb.pb.GetRateLimitsReq()
+        for i in miss:
+            sub.requests.append(msg.requests[i])
+        fwd_raw = await client.call(
+            METHOD_GET_RATE_LIMITS, sub.SerializeToString()
+        )
+        fwd_resps = list(
+            pb.pb.GetRateLimitsResp.FromString(fwd_raw).responses
+        )
+    out = pb.pb.GetRateLimitsResp()
+    from gubernator_tpu.api.types import RateLimitResp
+
+    fwd_it = iter(fwd_resps)
+    for i in range(len(msg.requests)):
+        if i in local:
+            out.responses.append(pb.resp_to_pb(local[i]))
+        else:
+            nxt = next(fwd_it, None)
+            if nxt is None:  # daemon returned fewer rows than sent
+                out.responses.append(
+                    pb.resp_to_pb(RateLimitResp(error="missing response"))
+                )
+            else:
+                out.responses.append(nxt)
+    return out.SerializeToString()
+
+
+class EdgeV1Servicer:
+    """grpc.aio servicer for the edge process: relays raw bytes.
+
+    With `leases` (an EdgeLeases), GetRateLimits serves leased items
+    from the local slice cache and relays only the misses."""
+
+    def __init__(self, client: EdgeClient, leases: Optional[EdgeLeases] = None):
+        self.client = client
+        self.leases = leases
 
     async def GetRateLimits(self, request_bytes, context):
         import grpc
 
         try:
-            return await self.client.call(METHOD_GET_RATE_LIMITS, request_bytes)
+            return await serve_edge_get_rate_limits(
+                self.client, request_bytes, self.leases
+            )
         except EdgeError as e:
             await context.abort(
                 getattr(grpc.StatusCode, e.code, grpc.StatusCode.INTERNAL), str(e)
@@ -342,6 +483,18 @@ class EdgeV1Servicer:
 
         try:
             return await self.client.call(METHOD_HEALTH_CHECK, b"")
+        except EdgeError as e:
+            await context.abort(
+                getattr(grpc.StatusCode, e.code, grpc.StatusCode.INTERNAL), str(e)
+            )
+
+    async def Lease(self, request_bytes, context):
+        """Relay client-SDK Lease calls: holders behind an edge lease
+        from the daemon exactly as holders dialing it directly."""
+        import grpc
+
+        try:
+            return await self.client.call(METHOD_LEASE, request_bytes)
         except EdgeError as e:
             await context.abort(
                 getattr(grpc.StatusCode, e.code, grpc.StatusCode.INTERNAL), str(e)
@@ -363,13 +516,15 @@ _EDGE_JSON_CODES = {  # gRPC status numbers for the JSON error body
 }
 
 
-def build_edge_app(client: EdgeClient, metrics=None):
+def build_edge_app(client: EdgeClient, metrics=None, leases=None):
     """aiohttp app mirroring the daemon's HTTP/JSON gateway
     (service/gateway.py) over the framed upstream — the edge presents
     the daemon's full client-facing surface (gRPC + JSON + /healthz).
     With `metrics` (a gubernator_tpu.metrics.Metrics), the edge also
     serves its own /metrics — edge-local series like
-    gubernator_edge_call_timeouts live here, not on the daemon."""
+    gubernator_edge_call_timeouts live here, not on the daemon. With
+    `leases` (an EdgeLeases) the JSON path shares the gRPC path's
+    local lease serving."""
     from aiohttp import web
 
     from gubernator_tpu.service import pb
@@ -391,8 +546,8 @@ def build_edge_app(client: EdgeClient, metrics=None):
         for r in reqs:
             msg.requests.append(pb.req_to_pb(r))
         try:
-            raw = await client.call(
-                METHOD_GET_RATE_LIMITS, msg.SerializeToString()
+            raw = await serve_edge_get_rate_limits(
+                client, msg.SerializeToString(), leases
             )
         except EdgeError as e:
             return _edge_err(e)
@@ -459,6 +614,11 @@ def edge_v1_handler(servicer) -> "grpc.GenericRpcHandler":  # noqa: F821
             ),
             "HealthCheck": grpc.unary_unary_rpc_method_handler(
                 servicer.HealthCheck,
+                request_deserializer=None,
+                response_serializer=None,
+            ),
+            "Lease": grpc.unary_unary_rpc_method_handler(
+                servicer.Lease,
                 request_deserializer=None,
                 response_serializer=None,
             ),

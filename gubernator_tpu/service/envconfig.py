@@ -83,6 +83,13 @@ def _env_bool(name: str, default: bool = False) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _parse_census_thresholds(v: str) -> tuple:
     """GUBER_TABLE_CENSUS_THRESHOLDS: comma-separated idleness
     multipliers for the census cold-set table (e.g. "1,4,16")."""
@@ -139,7 +146,25 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env("GUBER_CONSISTENCY_AUDIT_INTERVAL"), 60.0
         ),
         consistency_audit_keys=_env_int("GUBER_CONSISTENCY_AUDIT_KEYS", 32),
+        # Cooperative token leases (docs/architecture.md "Cooperative
+        # leases"): GUBER_LEASES off keeps every path bit-exact with the
+        # pre-lease daemon.
+        leases=_env_bool("GUBER_LEASES"),
+        lease_ttl_s=parse_duration_s(_env("GUBER_LEASE_TTL"), 2.0),
+        lease_fraction=_env_float("GUBER_LEASE_FRACTION", 0.1),
+        lease_low_water=_env_float("GUBER_LEASE_LOW_WATER", 0.25),
+        lease_max_keys=_env_int("GUBER_LEASE_MAX_KEYS", 4096),
+        lease_sweep_interval_s=parse_duration_s(
+            _env("GUBER_LEASE_SWEEP_INTERVAL"), 1.0
+        ),
+        # Server-suggested backoff (ROADMAP item 3 first step).
+        retry_after=_env_bool("GUBER_RETRY_AFTER"),
     )
+    if not (0.0 < behaviors.lease_fraction <= 1.0):
+        raise ValueError(
+            f"'GUBER_LEASE_FRACTION={behaviors.lease_fraction}' is "
+            "invalid; expected a fraction in (0, 1]"
+        )
     if behaviors.owner_unreachable not in ("error", "local"):
         raise ValueError(
             f"'GUBER_OWNER_UNREACHABLE={behaviors.owner_unreachable}' is "

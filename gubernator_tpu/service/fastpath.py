@@ -86,6 +86,10 @@ def enabled(svc) -> bool:
         and not getattr(
             getattr(svc.engine, "cfg", None), "stage_metadata", False
         )
+        # GUBER_RETRY_AFTER promises retry_after_ms on OVER_LIMIT
+        # responses, which only the object path attaches — same
+        # trade as stage_metadata above.
+        and not getattr(svc, "retry_after", False)
     )
 
 

@@ -112,6 +112,18 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         )
         return web.json_response(snap)
 
+    async def debug_leases(request: web.Request) -> web.Response:
+        """Owner-side lease ledger (docs/architecture.md "Cooperative
+        leases"): record/key counts, granted/returned/expired/credited
+        hit flows, the outstanding over-admission bound, revocation
+        state, and the top outstanding keys. Pure host-side dict reads;
+        {"enabled": false} when GUBER_LEASES is off."""
+        if svc.lease_mgr is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(
+            {"enabled": True, **svc.lease_mgr.summary()}
+        )
+
     async def debug_cluster(request: web.Request) -> web.Response:
         """Cluster-wide debug view (docs/monitoring.md "Consistency"):
         this node's local_debug_info plus a breaker-gated, shared-deadline
@@ -154,6 +166,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/table", debug_table)
     app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/profile", debug_profile)
+    app.router.add_get("/debug/leases", debug_leases)
     app.router.add_get("/debug/cluster", debug_cluster)
 
 

@@ -79,6 +79,32 @@ async def serve_get_rate_limits_bytes(svc: V1Service, request_bytes) -> bytes:
     return resp.SerializeToString()
 
 
+async def serve_lease_bytes(svc: V1Service, request_bytes, context) -> bytes:
+    """Shared Lease serving core (V1 + PeersV1 + the edge framed
+    listener): decode, route through V1Service.lease, encode."""
+    from gubernator_tpu.utils import tracing
+
+    try:
+        grants, returns, holder, md = pb.lease_req_from_bytes(request_bytes)
+    except (ValueError, TypeError):
+        if context is not None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "malformed lease request"
+            )
+        raise ApiError("malformed lease request")
+    ctx = tracing.propagate_extract(md)
+    with tracing.attached(ctx):
+        with tracing.span(
+            "V1Instance.Lease", level="DEBUG",
+            grants=len(grants), returns=len(returns),
+        ):
+            g_res, r_res = await svc.lease(
+                grants, returns, holder=holder,
+                no_forward=md.get("no_forward") == "1",
+            )
+    return pb.lease_resp_to_bytes(g_res, r_res)
+
+
 class V1Servicer:
     """GetRateLimits runs in BYTES mode (identity deserializer): the
     columnar fast path serves eligible calls without building a single
@@ -98,6 +124,14 @@ class V1Servicer:
     async def HealthCheck(self, request, context):
         async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/HealthCheck"):
             return pb.health_to_pb(await self.svc.health_check())
+
+    async def Lease(self, request_bytes, context):
+        """Cooperative token leases (docs/architecture.md): grant/renew/
+        return quota slices. The service routes each row to the owning
+        daemon — local grants hit the LeaseManager, remote ones forward
+        over PeersV1/Lease."""
+        async with _instrumented(self.svc.metrics, "/pb.gubernator.V1/Lease"):
+            return await serve_lease_bytes(self.svc, request_bytes, context)
 
 
 class PeersV1Servicer:
@@ -160,7 +194,9 @@ class PeersV1Servicer:
             self.svc.metrics, "/pb.gubernator.PeersV1/TransferSnapshots"
         ):
             try:
-                snaps, md = pb.snapshots_md_from_bytes(request_bytes)
+                snaps, md, leases = pb.snapshots_full_from_bytes(
+                    request_bytes
+                )
             except (ValueError, TypeError):
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
@@ -173,7 +209,7 @@ class PeersV1Servicer:
                     keys=len(snaps),
                 ):
                     accepted, stale = await self.svc.transfer_snapshots(
-                        snaps
+                        snaps, leases=leases
                     )
             return pb.transfer_resp_to_bytes(accepted, stale)
 
@@ -204,3 +240,13 @@ class PeersV1Servicer:
                         None, self.svc.local_debug_info, keys or None
                     )
             return pb.debug_resp_to_bytes(info)
+
+    async def Lease(self, request_bytes, context):
+        """Daemon-to-owner forwarded lease traffic: same payload and
+        serving core as V1/Lease (the service refuses to re-forward a
+        peer-forwarded request — `no_forward` rides the payload md — so
+        disagreeing ring views cannot loop)."""
+        async with _instrumented(
+            self.svc.metrics, "/pb.gubernator.PeersV1/Lease"
+        ):
+            return await serve_lease_bytes(self.svc, request_bytes, context)

@@ -34,6 +34,15 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
                 request_deserializer=pb.pb.HealthCheckReq.FromString,
                 response_serializer=pb.pb.HealthCheckResp.SerializeToString,
             ),
+            # Cooperative token leases (docs/architecture.md): BYTES mode
+            # with a hand-encoded versioned payload
+            # (pb.lease_req_to_bytes / pb.lease_resp_to_bytes). Runs at
+            # renew cadence — the whole point is that checks don't RPC.
+            "Lease": grpc.unary_unary_rpc_method_handler(
+                servicer.Lease,
+                request_deserializer=None,
+                response_serializer=None,
+            ),
         },
     )
 
@@ -72,6 +81,13 @@ def peers_handler(servicer) -> grpc.GenericRpcHandler:
                 request_deserializer=None,
                 response_serializer=None,
             ),
+            # Cooperative token leases: daemon-to-owner forwarding leg of
+            # the same BYTES-mode payload as V1.Lease.
+            "Lease": grpc.unary_unary_rpc_method_handler(
+                servicer.Lease,
+                request_deserializer=None,
+                response_serializer=None,
+            ),
         },
     )
 
@@ -89,6 +105,12 @@ class V1Stub:
             f"/{V1_SERVICE}/HealthCheck",
             request_serializer=pb.pb.HealthCheckReq.SerializeToString,
             response_deserializer=pb.pb.HealthCheckResp.FromString,
+        )
+        # BYTES mode both ways (payload is pb.lease_req_to_bytes output).
+        self.lease = channel.unary_unary(
+            f"/{V1_SERVICE}/Lease",
+            request_serializer=None,
+            response_deserializer=None,
         )
 
 
@@ -115,6 +137,12 @@ class PeersV1Stub:
         # BYTES mode both ways (payload is pb.debug_req_to_bytes output).
         self.debug_info = channel.unary_unary(
             f"/{PEERS_SERVICE}/DebugInfo",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        # BYTES mode both ways (payload is pb.lease_req_to_bytes output).
+        self.lease = channel.unary_unary(
+            f"/{PEERS_SERVICE}/Lease",
             request_serializer=None,
             response_deserializer=None,
         )

@@ -23,11 +23,16 @@ from gubernator_tpu.api.types import (
     PeerInfo,
     RateLimitReq,
     RateLimitResp,
+    Status,
     UpdatePeerGlobal,
     has_behavior,
 )
 from gubernator_tpu.metrics import Metrics
 from gubernator_tpu.parallel.global_sync import ORIGIN_MD_KEY
+from gubernator_tpu.parallel.leases import (
+    LEASE_REVOKE_MD_KEY,
+    RETRY_AFTER_MD_KEY,
+)
 from gubernator_tpu.runtime.engine import DeviceEngine
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
@@ -79,6 +84,18 @@ class V1Service:
         self._global_last_update: "OrderedDict[str, int]" = OrderedDict()
         self.auditor = None  # ConsistencyAuditor; None when not wired
         self.profiler = None  # ContinuousProfiler; None when not wired
+        # Cooperative token leases (docs/architecture.md "Cooperative
+        # leases"): the owner-side authority, wired by the daemon when
+        # GUBER_LEASES is on. None (default) keeps every path bit-exact
+        # with the pre-lease daemon.
+        self.lease_mgr = None
+        # Server-suggested backoff (GUBER_RETRY_AFTER): OVER_LIMIT
+        # responses carry retry_after_ms derived from reset_time.
+        self.retry_after = False
+        # Replica-noted lease revocations (key -> owner-clock ms until
+        # which grants are refused), learned from the LEASE_REVOKE_MD_KEY
+        # riding owner broadcasts. Bounded LRU like the staleness map.
+        self._lease_revoked: "OrderedDict[str, int]" = OrderedDict()
         # pre-resolved metric children (labels() lookups are hot-loop cost)
         m = self.metrics
         self._m_local = m.getratelimit_counter.labels("local")
@@ -190,6 +207,7 @@ class V1Service:
                     # Merge, don't replace: the engine may have attached
                     # stage_breakdown_us (GUBER_STAGE_METADATA) already.
                     resp.metadata["owner"] = owner.grpc_address
+                    self._attach_retry_after(resp, now)
                     if stage_md:
                         # Replica-staleness bound: age of the last owner
                         # broadcast applied locally for this key. Absent
@@ -212,6 +230,7 @@ class V1Service:
                     responses[i] = resp
                     if resp.error:
                         continue
+                    self._attach_retry_after(resp, now)
                     # Replication legs queue only AFTER a successful local
                     # apply (reference gubernator.go:603-606 order) — a
                     # failed apply must not push hits it never counted.
@@ -247,6 +266,145 @@ class V1Service:
         if self.forwarder is None:
             raise RuntimeError("no peer forwarder configured")
         return await self.forwarder.forward(peer, req)
+
+    def _attach_retry_after(self, resp: RateLimitResp, now: int) -> None:
+        """Server-suggested backoff (GUBER_RETRY_AFTER, default off):
+        OVER_LIMIT answers carry the ms until the window refills. Gated
+        so the off state stays bit-exact with today's responses."""
+        if (
+            self.retry_after
+            and resp.status == Status.OVER_LIMIT
+            and not resp.error
+        ):
+            resp.metadata.setdefault(
+                RETRY_AFTER_MD_KEY, str(max(0, resp.reset_time - now))
+            )
+
+    # ---- V1/PeersV1.Lease (cooperative token leases) -----------------------
+
+    def _lease_reject(self, g: dict, error: str, retry_after_ms: int = 0) -> dict:
+        return {
+            "ok": 0, "lease_id": "", "slice": 0, "ttl_ms": 0,
+            "expiry_ms": 0, "limit": int(g.get("limit", 0)), "remaining": 0,
+            "reset_time": 0, "retry_after_ms": retry_after_ms, "error": error,
+        }
+
+    async def lease(
+        self,
+        grants: List[dict],
+        returns: List[dict],
+        holder: str = "",
+        no_forward: bool = False,
+    ) -> tuple:
+        """Route one Lease RPC: rows for keys this daemon owns go to the
+        local LeaseManager; the rest forward to their owners over
+        PeersV1/Lease (one hop — `no_forward` stops ring-view
+        disagreements from looping). Returns (grant_results,
+        return_results), positional with the inputs."""
+        now = self.now_fn()
+        g_res: List[Optional[dict]] = [None] * len(grants)
+        r_res: List[Optional[dict]] = [
+            {"lease_id": str(r.get("lease_id", "")), "status": "unknown"}
+            for r in returns
+        ]
+        local_g: List[int] = []
+        local_r: List[int] = []
+        remote: Dict[str, tuple] = {}  # addr -> (peer, g_idx, r_idx)
+
+        def _route(key: str):
+            try:
+                return self._get_peer(key), None
+            except Exception as e:  # guberlint: allow-swallow -- ring empty / picker failure becomes a per-row UNAVAILABLE reject, not a dropped error
+                return None, str(e)
+
+        for i, g in enumerate(grants):
+            key = str(g.get("name", "")) + "_" + str(g.get("unique_key", ""))
+            until = self._lease_revoked.get(key)
+            if until is not None and until > now:
+                g_res[i] = self._lease_reject(g, "revoked", until - now)
+                continue
+            peer, err = _route(key)
+            if peer is None:
+                g_res[i] = self._lease_reject(g, f"UNAVAILABLE: {err}")
+            elif peer.info.is_owner:
+                local_g.append(i)
+            elif no_forward:
+                g_res[i] = self._lease_reject(g, "UNAVAILABLE: not owner")
+            else:
+                addr = peer.info.grpc_address
+                ent = remote.setdefault(addr, (peer, [], []))
+                ent[1].append(i)
+        for i, r in enumerate(returns):
+            key = str(r.get("name", "")) + "_" + str(r.get("unique_key", ""))
+            peer, err = _route(key)
+            if peer is None:
+                continue  # stays "unknown"; the holder drops its copy
+            if peer.info.is_owner:
+                local_r.append(i)
+            elif not no_forward:
+                addr = peer.info.grpc_address
+                ent = remote.setdefault(addr, (peer, [], []))
+                ent[2].append(i)
+
+        if local_g or local_r:
+            if self.lease_mgr is None:
+                for i in local_g:
+                    g_res[i] = self._lease_reject(grants[i], "leases disabled")
+            else:
+                gr, rr = await self.lease_mgr.handle(
+                    [grants[i] for i in local_g],
+                    [returns[i] for i in local_r],
+                    holder=holder,
+                )
+                for i, res in zip(local_g, gr):
+                    g_res[i] = res
+                for i, res in zip(local_r, rr):
+                    r_res[i] = res
+
+        if remote:
+            from gubernator_tpu.service import pb as _pb
+
+            async def _one(peer, g_idx, r_idx):
+                md = tracing.propagate_inject({"no_forward": "1"})
+                payload = _pb.lease_req_to_bytes(
+                    [grants[i] for i in g_idx],
+                    [returns[i] for i in r_idx],
+                    holder=holder, metadata=md,
+                )
+                raw = await peer.lease(payload)
+                return _pb.lease_resp_from_bytes(raw)
+
+            ents = list(remote.values())
+            outs = await asyncio.gather(
+                *(_one(p, gi, ri) for p, gi, ri in ents),
+                return_exceptions=True,
+            )
+            for (peer, g_idx, r_idx), out in zip(ents, outs):
+                if isinstance(out, BaseException):
+                    for i in g_idx:
+                        g_res[i] = self._lease_reject(
+                            grants[i], f"UNAVAILABLE: {out}"
+                        )
+                    continue  # returns stay "unknown"
+                gr, rr, _md = out
+                for i, res in zip(g_idx, gr):
+                    g_res[i] = res
+                for i, res in zip(r_idx, rr):
+                    r_res[i] = res
+
+        for i, g in enumerate(grants):
+            if g_res[i] is None:
+                g_res[i] = self._lease_reject(g, "internal: no response")
+        return g_res, r_res
+
+    def _note_lease_revoked(self, key: str, until_ms: int) -> None:
+        """Record a revocation learned from an owner broadcast (LRU,
+        bounded like the staleness map; event-loop only)."""
+        mp = self._lease_revoked
+        mp[key] = max(mp.get(key, 0), until_ms)
+        mp.move_to_end(key)
+        while len(mp) > _STALENESS_MAP_MAX:
+            mp.popitem(last=False)
 
     # ---- PeersV1.GetPeerRateLimits (reference gubernator.go:462-539) -------
 
@@ -294,9 +452,11 @@ class V1Service:
             self.metrics.global_sync_leg_duration.labels("owner_apply").observe(
                 time.perf_counter() - t_apply
             )
+        now = self.now_fn()
         for req, resp in zip(reqs, results):
             if resp.error:
                 continue
+            self._attach_retry_after(resp, now)
             # Replication legs queue only AFTER a successful apply — a
             # failed apply must not push hits it never counted.
             if self.global_mgr is not None and has_behavior(req.behavior, Behavior.GLOBAL):
@@ -318,6 +478,15 @@ class V1Service:
         trace_id = tracing.trace_id_of(tracing.current_span())
         for g in globals_:
             md = getattr(g.status, "metadata", None)
+            revoke = md.pop(LEASE_REVOKE_MD_KEY, None) if md else None
+            if revoke is not None:
+                # Revocation riding the broadcast leg: refuse new grants
+                # for this key here too, so a holder renewing through a
+                # replica is turned away without an extra owner hop.
+                try:
+                    self._note_lease_revoked(g.key, int(revoke))
+                except ValueError:
+                    pass
             origin = md.pop(ORIGIN_MD_KEY, None) if md else None
             if origin is not None:
                 # Close the end-to-end loop: origin stamp (sampled at the
@@ -350,10 +519,13 @@ class V1Service:
 
     # ---- PeersV1.TransferSnapshots (ownership handover) --------------------
 
-    async def transfer_snapshots(self, snaps) -> tuple:
+    async def transfer_snapshots(self, snaps, leases=None) -> tuple:
         """Receiver half of ring-change/drain handover: merge incoming
         counter state last-writer-wins on stamp (docs/robustness.md
-        "Rolling restarts & handover"). Returns (accepted, stale)."""
+        "Rolling restarts & handover"). `leases` carries the sender's
+        outstanding lease records for the re-homed keys (same LWW
+        discipline, keyed on lease id) so holders keep serving through
+        the handover without re-granting. Returns (accepted, stale)."""
         from gubernator_tpu.store.store import merge_snapshots_lww
 
         loop = asyncio.get_running_loop()
@@ -365,6 +537,8 @@ class V1Service:
             m.handover_keys_received.inc(accepted)
         if stale:
             m.handover_keys_dropped.labels("stale").inc(stale)
+        if leases and self.lease_mgr is not None:
+            self.lease_mgr.adopt(leases)
         return accepted, stale
 
     # ---- V1.HealthCheck (reference gubernator.go:542-586) ------------------
@@ -480,6 +654,11 @@ class V1Service:
         if self.auditor is not None:
             consistency.update(self.auditor.summary())
         info["consistency"] = consistency
+        if self.lease_mgr is not None:
+            # Lease ledger rides the free-form DebugInfo dict like the
+            # census — /debug/cluster aggregates fleet-wide outstanding
+            # slices (the over-admission bound) with no wire bump.
+            info["leases"] = self.lease_mgr.summary()
         if keys:
             from gubernator_tpu.store.store import snapshots_from_engine
 

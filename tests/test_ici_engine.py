@@ -293,3 +293,43 @@ def test_replica_capacity_pressure_no_cross_key_credit():
         )
     finally:
         eng.close()
+
+
+def test_paging_request_serves_flat_with_single_warning(caplog):
+    """GUBER_TABLE_PAGE_GROUPS on the ici engine: serve flat, say so —
+    one process-wide warning, and an explicit `paging: "unsupported
+    (flat)"` marker in /debug/engine and the census pages section
+    (a silent absence would read as "paging is off on purpose")."""
+    import logging
+
+    IciEngine._paging_warned = False  # isolate from other tests
+    cfg = IciEngineConfig(
+        num_groups=1 << 7, num_slots=1 << 9, batch_size=16,
+        sync_wait_s=3600, page_groups=32,
+    )
+    with caplog.at_level(logging.WARNING, logger="gubernator_tpu.ici"):
+        eng = IciEngine(cfg, now_fn=lambda: NOW)
+        try:
+            assert eng.debug_snapshot()["paging"] == "unsupported (flat)"
+            census = eng.table_census(max_age_s=0)
+            assert census["pages"] == {
+                "enabled": False, "paging": "unsupported (flat)",
+            }
+        finally:
+            eng.close()
+        # second construction in the same process: the latch holds
+        eng2 = IciEngine(cfg, now_fn=lambda: NOW)
+        eng2.close()
+    warns = [r for r in caplog.records if "not yet implemented" in r.message]
+    assert len(warns) == 1, [r.message for r in warns]
+
+    # without page_groups the markers must be absent entirely
+    flat_cfg = IciEngineConfig(
+        num_groups=1 << 7, num_slots=1 << 9, batch_size=16, sync_wait_s=3600,
+    )
+    eng3 = IciEngine(flat_cfg, now_fn=lambda: NOW)
+    try:
+        assert "paging" not in eng3.debug_snapshot()
+        assert "pages" not in eng3.table_census(max_age_s=0)
+    finally:
+        eng3.close()

@@ -1,0 +1,613 @@
+"""Cooperative token leases (docs/architecture.md "Cooperative leases"):
+conservation vs the bucket oracle, revocation riding the GLOBAL
+broadcast, handover keeping leases, partition over-admission bound, the
+leases-off bit-exact pin, the retry_after satellite, and the end-to-end
+zero-RPC client path.
+
+Conservation model under test (parallel/leases.py):
+
+    granted − returned − expired == outstanding        (ledger identity)
+    probe.remaining == limit − granted + credited      (single-key oracle,
+                                                        one window, no
+                                                        outside traffic)
+
+The second identity IS the honesty claim: every leased token was
+pre-consumed from the slot at grant time, and every credited token was a
+verifiably-unused slice remainder returned within the same window.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.parallel.leases import (
+    LEASE_STALENESS_MD_KEY,
+    LeaseCache,
+    LeaseManager,
+)
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+
+from tests.test_global import metric_value, wait_until
+
+MINUTE = 60_000
+LIMIT = 1000
+
+
+def tmpl(name, key, limit=LIMIT, duration=3 * MINUTE, behavior=0, want=0):
+    return {
+        "name": name, "unique_key": key, "limit": limit,
+        "duration": duration, "algorithm": int(Algorithm.TOKEN_BUCKET),
+        "behavior": int(behavior), "burst": 0, "want": want,
+    }
+
+
+def ret_row(name, key, lease_id, used, limit=LIMIT, behavior=0):
+    r = tmpl(name, key, limit=limit, behavior=behavior)
+    r.pop("want")
+    r.update(lease_id=lease_id, used=used)
+    return r
+
+
+def probe_remaining(loop_thread, daemon, name, key, limit=LIMIT,
+                    duration=3 * MINUTE):
+    [rl] = loop_thread.run(daemon.svc.get_rate_limits([
+        RateLimitReq(
+            name=name, unique_key=key, hits=0, limit=limit,
+            duration=duration, algorithm=Algorithm.TOKEN_BUCKET,
+        )
+    ]))
+    assert rl.error == "", rl.error
+    return rl.remaining
+
+
+def _ledger_ok(lm: LeaseManager):
+    assert (
+        lm.granted_hits - lm.returned_hits - lm.expired_hits
+        == lm.outstanding_hits()
+    )
+    assert lm.outstanding_hits() == sum(lm.outstanding_by_key().values())
+
+
+# ---- wire codec -------------------------------------------------------------
+
+
+def test_lease_wire_roundtrip():
+    grants = [tmpl("w", "g1", want=25), tmpl("w", "g2")]
+    returns = [ret_row("w", "r1", "a/1", 7)]
+    g2, r2, holder, md = pb.lease_req_from_bytes(
+        pb.lease_req_to_bytes(grants, returns, holder="edge:x",
+                              metadata={"no_forward": "1"})
+    )
+    assert holder == "edge:x"
+    assert md.get("no_forward") == "1"
+    assert [g["unique_key"] for g in g2] == ["g1", "g2"]
+    assert g2[0]["want"] == 25
+    assert r2[0]["lease_id"] == "a/1" and r2[0]["used"] == 7
+
+    g_res = [{
+        "ok": 1, "lease_id": "a/2", "slice": 100, "ttl_ms": 1500,
+        "expiry_ms": 99, "limit": LIMIT, "remaining": 900,
+        "reset_time": 123, "retry_after_ms": 0, "error": "",
+    }]
+    r_res = [{"lease_id": "a/1", "status": "ok"}]
+    go, ro, _ = pb.lease_resp_from_bytes(pb.lease_resp_to_bytes(g_res, r_res))
+    assert go == g_res and ro == r_res
+
+
+def test_lease_wire_rejects_malformed():
+    with pytest.raises(ValueError):
+        pb.lease_req_from_bytes(b"[]")
+    with pytest.raises(ValueError):
+        pb.lease_req_from_bytes(b'{"v": 999}')
+    with pytest.raises(ValueError):
+        pb.lease_resp_from_bytes(b"junk{")
+
+
+def test_snapshot_bytes_identical_without_leases():
+    # The handover payload only grows a "leases" key when lease rows
+    # actually ship — leases off ⇒ byte-identical snapshot chunks.
+    assert pb.snapshots_to_bytes([]) == pb.snapshots_to_bytes([], leases=None)
+    assert pb.snapshots_to_bytes([]) == pb.snapshots_to_bytes([], leases=[])
+    assert b"leases" in pb.snapshots_to_bytes([], leases=[["x"] * 10])
+
+
+# ---- single daemon, leases on ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lease_daemon(loop_thread):
+    conf = DaemonConfig(
+        cache_size=8192,
+        behaviors=BehaviorConfig(
+            leases=True, lease_ttl_s=2.0, lease_fraction=0.1,
+            lease_sweep_interval_s=0.1, retry_after=True,
+        ),
+    )
+    d = loop_thread.run(Daemon.spawn(conf), timeout=120)
+    d.set_peers([d.peer_info()])
+    yield d
+    loop_thread.run(d.close(), timeout=60)
+
+
+def test_grant_return_conservation_fuzz_vs_oracle(lease_daemon, loop_thread):
+    rng = random.Random(0x1EA5E)
+    d = lease_daemon
+    lm = d.svc.lease_mgr
+    assert lm is not None
+    keys = [f"fz{i}" for i in range(4)]
+    name = "lease_fuzz"
+    live = []  # (key, lease_id, slice)
+
+    for _ in range(60):
+        key = rng.choice(keys)
+        if live and rng.random() < 0.4:
+            key, lid, slc = live.pop(rng.randrange(len(live)))
+            used = rng.randint(0, slc)
+            _, rr = loop_thread.run(
+                d.svc.lease([], [ret_row(name, key, lid, used)])
+            )
+            assert rr[0]["status"] in ("ok", "stale", "unknown")
+        else:
+            want = rng.randint(1, 120)
+            gr, _ = loop_thread.run(
+                d.svc.lease([tmpl(name, key, want=want)], [])
+            )
+            res = gr[0]
+            if res["ok"]:
+                assert 1 <= res["slice"] <= max(1, LIMIT // 10)
+                assert res["ttl_ms"] >= 1
+                live.append((key, res["lease_id"], res["slice"]))
+            else:
+                assert res["error"] != ""
+        _ledger_ok(lm)
+
+    # Drain: return every live lease fully-unused; the bucket refunds
+    # the unused slices (same window) and the ledger stays exact.
+    for key, lid, slc in live:
+        loop_thread.run(d.svc.lease([], [ret_row(name, key, lid, 0)]))
+    _ledger_ok(lm)
+    for key in keys:
+        rem = probe_remaining(loop_thread, d, name, key)
+        assert 0 <= rem <= LIMIT
+
+
+def test_single_key_remaining_oracle(lease_daemon, loop_thread):
+    d = lease_daemon
+    lm = d.svc.lease_mgr
+    name, key = "lease_oracle", "k1"
+    g0, c0 = lm.granted_hits, lm.credited_hits
+    gr, _ = loop_thread.run(d.svc.lease([tmpl(name, key, want=50)], []))
+    res = gr[0]
+    assert res["ok"] == 1
+    slc = res["slice"]
+    assert probe_remaining(loop_thread, d, name, key) == LIMIT - slc
+    # return half-used: exactly the unused half is credited back
+    _, rr = loop_thread.run(
+        d.svc.lease([], [ret_row(name, key, res["lease_id"], slc // 2)])
+    )
+    assert rr[0]["status"] == "ok"
+    assert probe_remaining(loop_thread, d, name, key) \
+        == LIMIT - slc + (slc - slc // 2)
+    assert lm.granted_hits - g0 == slc
+    assert lm.credited_hits - c0 == slc - slc // 2
+    _ledger_ok(lm)
+
+
+def test_rejected_grant_has_no_side_effects(lease_daemon, loop_thread):
+    # Probe-then-carve: an over-limit grant must not flip the stored
+    # status (the sticky OVER_LIMIT quirk) or consume anything.
+    d = lease_daemon
+    name, key = "lease_sticky", "k1"
+    small = 10
+    [rl] = loop_thread.run(d.svc.get_rate_limits([
+        RateLimitReq(name=name, unique_key=key, hits=small, limit=small,
+                     duration=3 * MINUTE, algorithm=Algorithm.TOKEN_BUCKET)
+    ]))
+    assert rl.remaining == 0
+    gr, _ = loop_thread.run(
+        d.svc.lease([tmpl(name, key, limit=small, want=5)], [])
+    )
+    assert gr[0]["ok"] == 0
+    assert gr[0]["error"] == "over limit"
+    assert gr[0]["retry_after_ms"] > 0
+    # a hits=0 probe afterwards still sees UNDER_LIMIT (no sticky flip)
+    [rl] = loop_thread.run(d.svc.get_rate_limits([
+        RateLimitReq(name=name, unique_key=key, hits=0, limit=small,
+                     duration=3 * MINUTE, algorithm=Algorithm.TOKEN_BUCKET)
+    ]))
+    assert rl.status == Status.UNDER_LIMIT
+
+
+def test_expiry_sweep_reclaims_and_gauge_falls_to_zero(
+    lease_daemon, loop_thread
+):
+    d = lease_daemon
+    lm = d.svc.lease_mgr
+    name, key = "lease_expiry", "k1"
+    hkey = f"{name}_{key}"
+    gr, _ = loop_thread.run(d.svc.lease([tmpl(name, key)], []))
+    assert gr[0]["ok"] == 1
+    # Partition chaos, distilled: the holder is unreachable and never
+    # returns. Worst-case over-admission is bounded by the outstanding
+    # slice (it was pre-consumed at grant), and after the ttl the sweep
+    # reclaims it — the gauge falling back to 0 is the heal signal.
+    bound = lm.outstanding_by_key().get(hkey, 0)
+    assert 0 < bound <= LIMIT // 10
+    assert wait_until(
+        lambda: lm.outstanding_by_key().get(hkey, 0) == 0, timeout=10
+    ), "sweep never reclaimed the expired lease"
+    _ledger_ok(lm)
+    assert wait_until(
+        lambda: metric_value(d, "gubernator_lease_outstanding_hits")
+        == float(lm.outstanding_hits()),
+        timeout=5,
+    )
+
+
+def test_retry_after_metadata_on_over_limit(lease_daemon, loop_thread):
+    d = lease_daemon  # retry_after=True in the fixture
+    name, key = "lease_ra", "k1"
+    small = 5
+
+    async def hit(hits):
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(pb.pb.RateLimitReq(
+            name=name, unique_key=key,
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=3 * MINUTE, limit=small, hits=hits,
+        ))
+        return (await d.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+    rl = loop_thread.run(hit(small + 1))
+    assert rl.status == Status.OVER_LIMIT
+    ra = int(rl.metadata["retry_after_ms"])
+    assert 0 <= ra <= 3 * MINUTE
+    rl = loop_thread.run(hit(0))
+    assert rl.status == Status.UNDER_LIMIT
+    assert "retry_after_ms" not in rl.metadata
+
+
+def test_clock_skew_clamps_advertised_ttl(lease_daemon, loop_thread):
+    d = lease_daemon
+    lm = d.svc.lease_mgr
+    d.svc.metrics.peer_clock_skew.labels("peer:test").set(600.0)
+    try:
+        assert lm._skew_margin_ms() == 600
+        gr, _ = loop_thread.run(d.svc.lease([tmpl("lease_skew", "k1")], []))
+        res = gr[0]
+        assert res["ok"] == 1
+        now = d.svc.now_fn()
+        # The advertised relative ttl is shrunk by the margin: owner-side
+        # expiry sits ~600ms past where the holder will stop serving
+        # (minus the wall time elapsed since the grant).
+        assert res["expiry_ms"] - now - res["ttl_ms"] >= 500
+    finally:
+        d.svc.metrics.peer_clock_skew.labels("peer:test").set(0.0)
+
+
+def test_auditor_lease_pass_reports_bound(lease_daemon, loop_thread):
+    d = lease_daemon
+    gr, _ = loop_thread.run(d.svc.lease([tmpl("lease_audit", "k1")], []))
+    assert gr[0]["ok"] == 1
+    auditor = getattr(d.svc, "auditor", None)
+    if auditor is None:
+        pytest.skip("daemon has no auditor wired")
+    summary = loop_thread.run(auditor.audit_once())
+    leases = summary.get("leases")
+    assert leases is not None
+    assert leases["over_admission_bound_hits"] >= gr[0]["slice"]
+    assert leases["outstanding_hits"] == leases["ledger_outstanding_hits"]
+    # clean up so later tests see a drained manager
+    loop_thread.run(d.svc.lease(
+        [], [ret_row("lease_audit", "k1", gr[0]["lease_id"], 0)]
+    ))
+
+
+def test_zero_rpc_client_path(lease_daemon, loop_thread):
+    from gubernator_tpu.client import GubernatorClient
+
+    d = lease_daemon
+    name, key = "lease_e2e", "hotkey"
+    counter = (
+        'gubernator_grpc_request_duration_count'
+        '{method="/pb.gubernator.V1/GetRateLimits"}'
+    )
+
+    req = RateLimitReq(
+        name=name, unique_key=key, hits=1, limit=LIMIT,
+        duration=3 * MINUTE, algorithm=Algorithm.TOKEN_BUCKET,
+    )
+
+    async def acquire():
+        c = GubernatorClient(d.grpc_address, leases=True)
+        # first calls miss and mark the key wanted; the maintenance
+        # task grabs a lease asynchronously
+        await c.get_rate_limits([req])
+        for _ in range(100):
+            if c.lease_cache._entries:
+                break
+            await asyncio.sleep(0.05)
+            await c.get_rate_limits([req])
+        assert c.lease_cache._entries, "client never obtained a lease"
+        return c
+
+    async def serve(c):
+        out = []
+        for _ in range(100):
+            [rl] = await c.get_rate_limits([req])
+            out.append(rl)
+        return out
+
+    # metric reads are sync HTTP against the daemon's own event loop —
+    # they must run on the test thread, between loop_thread hops
+    c = loop_thread.run(acquire(), timeout=60)
+    before = metric_value(d, counter)
+    served = loop_thread.run(serve(c), timeout=60)
+    after = metric_value(d, counter)
+    loop_thread.run(c.close())
+    # >=10x RPC reduction: 100 checks cost at most a handful of
+    # GetRateLimits RPCs (renews ride the separate Lease RPC).
+    assert after - before <= 10, (before, after)
+    for rl in served:
+        assert rl.error == ""
+        assert rl.status == Status.UNDER_LIMIT
+    # lease-served answers carry the staleness honesty metadata
+    assert any(LEASE_STALENESS_MD_KEY in rl.metadata for rl in served)
+    for rl in served:
+        if LEASE_STALENESS_MD_KEY in rl.metadata:
+            assert int(rl.metadata[LEASE_STALENESS_MD_KEY]) >= 0
+
+
+# ---- leases off: bit-exact pin ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def plain_daemon(loop_thread):
+    d = loop_thread.run(
+        Daemon.spawn(DaemonConfig(cache_size=4096)), timeout=120
+    )
+    d.set_peers([d.peer_info()])
+    yield d
+    loop_thread.run(d.close(), timeout=60)
+
+
+def test_leases_off_is_inert(plain_daemon, loop_thread):
+    d = plain_daemon
+    assert d.svc.lease_mgr is None
+    assert d.svc.retry_after is False
+    gr, _ = loop_thread.run(d.svc.lease([tmpl("off", "k1")], []))
+    assert gr[0]["ok"] == 0 and gr[0]["error"] == "leases disabled"
+
+    async def hit(hits):
+        msg = pb.pb.GetRateLimitsReq()
+        msg.requests.append(pb.pb.RateLimitReq(
+            name="off_md", unique_key="k",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=3 * MINUTE, limit=3, hits=hits,
+        ))
+        return (await d.client().get_rate_limits(msg, timeout=10)).responses[0]
+
+    rl = loop_thread.run(hit(5))
+    assert rl.status == Status.OVER_LIMIT
+    # off ⇒ no retry_after / lease metadata ever appears on the wire
+    assert "retry_after_ms" not in rl.metadata
+    assert LEASE_STALENESS_MD_KEY not in rl.metadata
+
+
+# ---- cluster: forwarding, revocation, handover -----------------------------
+
+
+@pytest.fixture(scope="module")
+def lease_cluster(loop_thread):
+    c = loop_thread.run(
+        Cluster.start(
+            3,
+            behaviors=BehaviorConfig(
+                leases=True, lease_ttl_s=5.0,
+                lease_sweep_interval_s=0.2,
+                global_sync_wait_s=0.05,
+            ),
+        ),
+        timeout=180,
+    )
+    yield c
+    loop_thread.run(c.stop(), timeout=120)
+
+
+def test_lease_rpc_forwards_to_owner(lease_cluster, loop_thread):
+    name, key = "lease_fwd", "k1"
+    owner = lease_cluster.find_owning_daemon(name, key)
+    other = lease_cluster.list_non_owning_daemons(name, key)[0]
+    gr, _ = loop_thread.run(other.svc.lease([tmpl(name, key)], []))
+    res = gr[0]
+    assert res["ok"] == 1, res
+    # the record lives at the OWNER's manager, not the forwarding node
+    hkey = f"{name}_{key}"
+    assert owner.svc.lease_mgr.outstanding_by_key().get(hkey, 0) \
+        == res["slice"]
+    assert hkey not in other.svc.lease_mgr.outstanding_by_key()
+
+
+def test_revocation_rides_global_broadcast(lease_cluster, loop_thread):
+    name, key = "lease_revoke", "k1"
+    owner = lease_cluster.find_owning_daemon(name, key)
+    replica = lease_cluster.list_non_owning_daemons(name, key)[0]
+    hkey = f"{name}_{key}"
+    small = 40
+
+    # grant a lease on a GLOBAL key at the owner
+    gr, _ = loop_thread.run(owner.svc.lease(
+        [tmpl(name, key, limit=small, behavior=Behavior.GLOBAL)], []
+    ))
+    assert gr[0]["ok"] == 1, gr[0]
+    assert owner.svc.lease_mgr.has_leases(hkey)
+
+    # Drive the key over limit through the normal GLOBAL path: drain
+    # the post-carve remaining exactly, then hit again — the stored
+    # status flips OVER_LIMIT (sticky) and the next broadcast's status
+    # probe sees it.
+    def req(hits):
+        return RateLimitReq(
+            name=name, unique_key=key, hits=hits, limit=small,
+            duration=3 * MINUTE, algorithm=Algorithm.TOKEN_BUCKET,
+            behavior=int(Behavior.GLOBAL),
+        )
+
+    [rl] = loop_thread.run(owner.svc.get_rate_limits(
+        [req(small - gr[0]["slice"])]
+    ))
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+    [rl] = loop_thread.run(owner.svc.get_rate_limits([req(1)]))
+    assert rl.status == Status.OVER_LIMIT
+
+    # the owner's broadcast pass revokes its local leases...
+    assert wait_until(
+        lambda: not owner.svc.lease_mgr.has_leases(hkey), timeout=5
+    ), "owner never revoked the over-limit key's leases"
+    assert owner.svc.lease_mgr.revocations >= 1
+    _ledger_ok(owner.svc.lease_mgr)
+    # ...and replicas learn the revocation window from the broadcast
+    assert wait_until(
+        lambda: replica.svc._lease_revoked.get(hkey, 0) > 0, timeout=5
+    ), "replica never learned the revocation from the broadcast"
+    # a grant attempted AT the replica is refused locally, zero hops
+    gr, _ = loop_thread.run(replica.svc.lease(
+        [tmpl(name, key, limit=small, behavior=Behavior.GLOBAL)], []
+    ))
+    assert gr[0]["ok"] == 0 and gr[0]["error"] == "revoked"
+    assert gr[0]["retry_after_ms"] > 0
+
+
+def test_handover_keeps_leases(loop_thread):
+    async def main():
+        c = await Cluster.start(
+            2,
+            behaviors=BehaviorConfig(leases=True, lease_ttl_s=30.0),
+            cache_size=4096,
+        )
+        try:
+            name, key = "lease_handover", "k1"
+            owner = c.find_owning_daemon(name, key)
+            survivor = c.list_non_owning_daemons(name, key)[0]
+            gr, _ = await owner.svc.lease([tmpl(name, key)], [])
+            assert gr[0]["ok"] == 1
+            lid = gr[0]["lease_id"]
+            hkey = f"{name}_{key}"
+            g_before = survivor.svc.lease_mgr.granted_hits
+
+            # Decommission signal: push survivor-only membership to the
+            # owner; its handover ships counter snapshots AND the lease
+            # rows to ring successors.
+            owner.set_peers([PeerInfo(
+                grpc_address=survivor.grpc_address,
+                http_address=survivor.http_address,
+            )])
+            t = owner.svc.picker.handover_last
+            if isinstance(t, asyncio.Task) and not t.done():
+                await asyncio.wait_for(t, timeout=30)
+
+            lm = survivor.svc.lease_mgr
+            assert lid in lm._leases, "lease record lost in handover"
+            assert lm._leases[lid].key == hkey
+            # sender counted the slice returned, adopter counts it
+            # granted — each manager's conservation stays exact
+            assert lm.granted_hits > g_before
+            _ledger_ok(lm)
+            _ledger_ok(owner.svc.lease_mgr)
+            assert not owner.svc.lease_mgr.has_leases(hkey)
+        finally:
+            await c.stop()
+
+    loop_thread.run(main(), timeout=180)
+
+
+# ---- holder-side cache unit ------------------------------------------------
+
+
+def _grant_res(lease_id="o/1", slc=100, ttl=1000, limit=LIMIT,
+               remaining=900, reset=10_000):
+    return {
+        "ok": 1, "lease_id": lease_id, "slice": slc, "ttl_ms": ttl,
+        "expiry_ms": 0, "limit": limit, "remaining": remaining,
+        "reset_time": reset, "retry_after_ms": 0, "error": "",
+    }
+
+
+def test_lease_cache_serves_and_renews_at_low_water():
+    clock = {"now": 1000}
+    cache = LeaseCache(low_water=0.25, now_fn=lambda: clock["now"])
+    req = RateLimitReq(
+        name="c", unique_key="k", hits=1, limit=LIMIT,
+        duration=MINUTE, algorithm=Algorithm.TOKEN_BUCKET,
+    )
+    assert cache.try_serve(req) is None  # miss marks the key wanted
+    grants, returns = cache.collect()
+    assert len(grants) == 1 and returns == []
+    cache.apply(grants, [_grant_res(slc=8)])
+    for _ in range(6):
+        rl = cache.try_serve(req)
+        assert rl is not None and rl.status == Status.UNDER_LIMIT
+        assert int(rl.metadata[LEASE_STALENESS_MD_KEY]) >= 0
+    assert cache.due()  # 2/8 left <= low water
+    grants, returns = cache.collect()
+    assert len(grants) == 1 and len(returns) == 1
+    assert returns[0]["used"] == 6
+    # renew-overlap accounting: a hit served while the renew RPC flies
+    # is charged against the NEW slice when it lands
+    assert cache.try_serve(req) is not None
+    cache.apply(grants, [_grant_res(lease_id="o/2", slc=8)])
+    e = cache._entries["c_k"]
+    assert e.lease_id == "o/2"
+    assert e.local_remaining == 7 and e.used == 1
+    assert cache.stats["renews"] == 1
+
+
+def test_lease_cache_rejection_backoff_and_expiry():
+    clock = {"now": 1000}
+    cache = LeaseCache(now_fn=lambda: clock["now"])
+    req = RateLimitReq(
+        name="c", unique_key="k2", hits=1, limit=LIMIT,
+        duration=MINUTE, algorithm=Algorithm.TOKEN_BUCKET,
+    )
+    assert cache.try_serve(req) is None
+    grants, _ = cache.collect()
+    rej = dict(_grant_res(), ok=0, error="revoked", retry_after_ms=500)
+    cache.apply(grants, [rej])
+    assert cache.try_serve(req) is None
+    assert not cache._wanted  # denied: not re-requested during backoff
+    clock["now"] += 600
+    assert cache.try_serve(req) is None
+    assert cache._wanted  # backoff elapsed: wanted again
+    grants, _ = cache.collect()
+    cache.apply(grants, [_grant_res(slc=4, ttl=100)])
+    assert cache.try_serve(req) is not None
+    clock["now"] += 200  # past the local expiry
+    assert cache.try_serve(req) is None
+    _, returns = cache.collect()
+    assert any(r["lease_id"] == "o/1" for r in returns)  # final return
+
+
+def test_lease_cache_ineligible_requests_pass_through():
+    cache = LeaseCache(now_fn=lambda: 0)
+    leaky = RateLimitReq(
+        name="c", unique_key="k", hits=1, limit=10, duration=MINUTE,
+        algorithm=Algorithm.LEAKY_BUCKET,
+    )
+    neg = RateLimitReq(
+        name="c", unique_key="k", hits=-1, limit=10, duration=MINUTE,
+        algorithm=Algorithm.TOKEN_BUCKET,
+    )
+    assert cache.try_serve(leaky) is None
+    assert cache.try_serve(neg) is None
+    assert not cache._wanted  # neither is leaseable

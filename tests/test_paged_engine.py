@@ -319,6 +319,91 @@ def test_promotion_races_flushes_and_demoter():
         eng.close()
 
 
+# ---------------------------------------------------------------------------
+# demoter victim policy: census coldness first, LRU tiebreak
+
+
+class _FakePK:
+    """Minimal PagedKernels stand-in for Pager unit tests: positional
+    page moves are identity ops on a dummy table."""
+
+    num_logical_pages = 4
+    num_phys_pages = 2
+    groups_per_page = 4
+    page_slots = 16
+
+    def bind_page(self, table, lp, pp):
+        return table
+
+    def unbind_page(self, table, lp, pp):
+        return table
+
+    def write_page(self, table, lp, pp, rows):
+        return table
+
+    def extract_page(self, table, pp):
+        from gubernator_tpu.ops.layout import SlotTable
+        from gubernator_tpu.runtime.pager import wide_zeros
+
+        return SlotTable(**wide_zeros(self.page_slots))
+
+
+def _resident_pager():
+    from gubernator_tpu.runtime.pager import Pager
+
+    p = Pager(_FakePK())
+    # bind lp 0 -> frame 0 and lp 1 -> frame 1 by hand
+    p.page_map[0], p.page_map[1] = 0, 1
+    p.free = []
+    return p
+
+
+def test_coldness_from_heatmap_folds_regions_to_pages():
+    p = _resident_pager()
+    # 4 groups per page, 2 groups per census region -> page 0 (frame 0)
+    # covers regions 0-1, page 1 (frame 1) covers regions 2-3
+    hm = [5, 1, 0, 2]
+    cold = p.coldness_from_heatmap(hm, groups_per_region=2)
+    assert cold == {0: 6.0, 1: 2.0}
+    # region wider than a page: overlap-weighted share
+    cold = p.coldness_from_heatmap([8], groups_per_region=8)
+    assert cold == {0: 4.0, 1: 4.0}
+
+
+def test_census_cold_page_evicted_before_hot_touched():
+    """The ISSUE-13 satellite contract: a page whose touch tick is HOT
+    (a single probe just re-warmed it) but whose slots the census counts
+    idle must be evicted before a census-busy page with an older touch.
+    Census coldness also overrides the min_idle_ticks spare gate."""
+    p = _resident_pager()
+    p._tick = 10
+    p.touch[0] = 10  # hot-touched...
+    p.touch[1] = 2   # ...vs old-touched
+    coldness = {0: 6.0, 1: 0.0}  # ...but census-cold vs census-busy
+    assert p._pick_victim(coldness) == 0
+    p.demote_victims(
+        object(), want_free=1, min_idle_ticks=100, coldness=coldness
+    )
+    assert p.page_map[0] == -1, "census-cold page was not evicted"
+    assert p.page_map[1] == 1, "census-busy page was evicted instead"
+    assert p.free == [0]
+
+
+def test_pure_lru_fallback_and_min_idle_spare():
+    p = _resident_pager()
+    p._tick = 10
+    p.touch[0], p.touch[1] = 9, 10
+    # no census signal: LRU picks the older touch
+    assert p._pick_victim(None) == 0
+    # both pages touched within min_idle_ticks and no census coldness:
+    # the demoter must spare them all and stop
+    p.demote_victims(object(), want_free=2, min_idle_ticks=5, coldness=None)
+    assert p.free == [] and p.page_map[0] == 0 and p.page_map[1] == 1
+    # without the idle gate the LRU victim goes
+    p.demote_victims(object(), want_free=1)
+    assert p.page_map[0] == -1 and p.page_map[1] == 1
+
+
 def test_background_demoter_fills_free_target():
     """With the demote interval armed and traffic parked on every page,
     the background thread must evacuate down to the free-frame floor

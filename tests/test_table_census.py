@@ -76,7 +76,10 @@ def assert_census_equals_oracle(out: CensusOutput, want: dict):
     assert int(got["age_sum"]) == want["age_sum"]
     assert int(got["idle_sum"]) == want["idle_sum"]
     assert int(got["max_full_run"]) == want["max_full_run"]
-    for field in ("age_hist", "idle_hist", "heatmap", "fill_hist", "cold"):
+    for field in (
+        "age_hist", "idle_hist", "heatmap", "fill_hist", "cold",
+        "cold_heatmap",
+    ):
         np.testing.assert_array_equal(got[field], want[field], err_msg=field)
 
 
