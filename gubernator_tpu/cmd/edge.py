@@ -98,6 +98,7 @@ def main() -> None:
             "1", "true", "yes", "on",
         ):
             from gubernator_tpu.parallel.leases import LeaseCache
+            from gubernator_tpu.service.admission import DecisionRecorder
 
             leases = EdgeLeases(
                 client,
@@ -113,6 +114,13 @@ def main() -> None:
                 ),
                 holder=f"edge:{listen}",
                 local_counter=metrics.lease_local_answers,
+                # knob: GUBER_ADMISSION_RING (decision flight recorder)
+                recorder=DecisionRecorder(
+                    metrics,
+                    ring_size=int(
+                        os.environ.get("GUBER_ADMISSION_RING", "") or 256
+                    ),
+                ),
             )
         server = grpc.aio.server()
         server.add_generic_rpc_handlers(

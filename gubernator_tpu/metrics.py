@@ -362,6 +362,53 @@ class CensusSnapshotHistogram:
         return out
 
 
+# Log2-hit bin count of the admission scan (ops/admission.py
+# ADMISSION_BUCKETS; mirrored literally for the same jax-free reason).
+ADMISSION_BUCKETS = 32
+
+
+class AdmissionExcessHistogram:
+    """Per-window admission-excess distribution as Prometheus histogram
+    series. Same snapshot-replace contract as CensusSnapshotHistogram,
+    but the `le` bounds are HITS (2**i), not seconds: bucket i counts
+    resident keys whose hits-admitted-beyond-limit falls in
+    [2^(i-1), 2^i); `_count` is the excess-key population and `_sum`
+    the total excess hits. Fed from the TTL-cached admission snapshot
+    by engine_sync — a scrape never runs device work."""
+
+    def __init__(self, name: str, doc: str):
+        self.name = name
+        self.doc = doc
+        self._lock = lockorder.make_lock("metrics.admission")
+        self._hist: list = [0] * ADMISSION_BUCKETS
+        self._sum_hits = 0
+
+    def sample_names(self) -> list:
+        return [self.name, f"{self.name}_bucket",
+                f"{self.name}_sum", f"{self.name}_count"]
+
+    def update(self, hist, sum_hits) -> None:
+        with self._lock:
+            self._hist = list(hist)
+            self._sum_hits = int(sum_hits)
+
+    def render_lines(self, openmetrics: bool = False) -> list:
+        with self._lock:
+            counts = list(self._hist)
+            total = self._sum_hits
+        out = [f"# HELP {self.name} {self.doc}",
+               f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{1 << i}"}} {cum}')
+        cum += counts[-1] if counts else 0
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {total}")
+        out.append(f"{self.name}_count {cum}")
+        return out
+
+
 class HotKeySketch:
     """Top-K hot-key attribution via a weighted space-saving (Misra-
     Gries) sketch: at most `k` tracked keys, each entry carrying its
@@ -628,7 +675,12 @@ class Metrics:
         )
         self.over_limit_counter = counter(
             "gubernator_over_limit_counter",
-            "The number of rate limit checks that are over the limit.",
+            "The number of rate limit checks that are over the limit. "
+            "The bare sample is the engine's total; {path=...} children "
+            "split over-limit answers by the serving path that produced "
+            "them (decision provenance, docs/monitoring.md "
+            '"Admission").',
+            ["path"],
         )
         self.concurrent_checks = Gauge(
             "gubernator_concurrent_checks_counter",
@@ -1158,6 +1210,50 @@ class Metrics:
             "(edge tier).",
         )
 
+        # Admission observatory (docs/monitoring.md "Admission"):
+        # decision provenance + ground-truth enforcement-error SLIs.
+        self.admission_decisions = counter(
+            "gubernator_admission_decisions",
+            "Rate-limit answers by the serving path that produced them "
+            "(owner | replica | degraded_local | lease | fastpath | "
+            "forwarded) and resulting status (under_limit | over_limit "
+            "| error).",
+            ["path", "status"],
+        )
+        self.admission_excess_ratio = Gauge(
+            "gubernator_admission_excess_ratio",
+            "Over-admission SLI for this node: hits admitted beyond "
+            "configured limits per configured limit hit, from the "
+            "TTL-cached admission scan reconciled with the lease "
+            "ledger's outstanding slices and this node's un-relayed "
+            "GLOBAL hits; falls back to 0 after heal.",
+            registry=r,
+        )
+        self.admission_audit_max_excess_ratio = Gauge(
+            "gubernator_admission_audit_max_excess_ratio",
+            "Max over-admission ratio seen in the last audit pass "
+            "across this owner and the sampled replica (auditor "
+            "admission pass); re-set every cycle, so its return to 0 "
+            "after heal is the enforcement reconvergence signal.",
+            registry=r,
+        )
+        self.admission_false_over_limit = Gauge(
+            "gubernator_admission_false_over_limit_keys",
+            "Under-admission SLI: sampled keys the last audit pass saw "
+            "refused (OVER_LIMIT) at a transport-current replica while "
+            "the owner still had remaining budget; re-set every pass, "
+            "falls back to 0 after reconvergence.",
+            registry=r,
+        )
+        self.admission_excess_hits = AdmissionExcessHistogram(
+            "gubernator_admission_excess_hits",
+            "Per-window excess snapshot: resident keys by hits "
+            "admitted beyond their configured limit (log2 hit buckets; "
+            "re-published per admission scan — the CURRENT population, "
+            "not a cumulative event stream).",
+        )
+        self.register_renderable(self.admission_excess_hits)
+
         self._syncs = []
 
     # -- registration --------------------------------------------------------
@@ -1305,6 +1401,15 @@ def engine_sync(engine):
             m.table_slot_idle_seconds.update(
                 c["idle_ms_hist"], c["idle_ms_sum"]
             )
+            # Admission accounting rides the same scrape bridge: the
+            # TTL-cached snapshot feeds the excess histogram (the
+            # reconciled SLI gauges are set by the service-level sync /
+            # auditor, which also see the lease + GLOBAL ledgers).
+            if hasattr(engine, "admission_snapshot"):
+                a = engine.admission_snapshot()
+                m.admission_excess_hits.update(
+                    a["excess_hist"], a["excess_hits"]
+                )
             pages = c.get("pages")
             if pages:
                 m.table_page_count.labels("resident").set(pages["resident"])

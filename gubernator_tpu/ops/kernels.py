@@ -200,6 +200,22 @@ def get_census(layout: str, ways: int, **kwargs):
     return make_census(layout, ways, **kwargs)
 
 
+def get_admission(layout: str, ways: int, **kwargs):
+    """Admission-accounting program for `layout` (ops/admission.py):
+    one jitted, NON-donating scan per (layout, geometry) reducing
+    per-key admitted-this-window vs. configured limit to O(buckets)
+    device scalars — the enforcement-error SLI's ground truth,
+    registered here alongside the kernel registry so every
+    layout-selection surface resolves both from one place. Lazy
+    import: admission accounting is a scrape-cadence diagnostic, not
+    a serving dependency."""
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown table layout: {layout!r}")
+    from gubernator_tpu.ops.admission import make_admission
+
+    return make_admission(layout, ways, **kwargs)
+
+
 def get_paged_kernels(
     layout: str,
     num_groups: int,

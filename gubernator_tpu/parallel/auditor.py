@@ -68,6 +68,7 @@ class ConsistencyAuditor:
         self._last_max_ms = 0
         self._counts: Dict[str, int] = {"lag": 0, "lost": 0, "conflict": 0}
         self._lease_last: Dict[str, int] = {}
+        self._admission_last: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -105,6 +106,8 @@ class ConsistencyAuditor:
         self._pass_n += 1
         found: Dict[str, int] = {"lag": 0, "lost": 0, "conflict": 0}
         max_ms = 0
+        false_over = 0  # sampled keys where a replica refuses but the owner has tokens
+        peer_admission = None  # sampled replica's admission blob
         gm = getattr(self.svc, "global_mgr", None)
         picker = getattr(self.svc, "picker", None)
         peers = []
@@ -133,11 +136,18 @@ class ConsistencyAuditor:
                     for k, v in (info.get("global_updates") or {}).items()
                 }
                 now_ms = _clock.now_ms()
+                peer_admission = info.get("admission")
                 for key in keys:
                     s = owner_view.get(key)
                     bcast_ms = gm.broadcast_keys.get(key)
                     if s is None or bcast_ms is None:
                         continue  # expired/evicted at the owner since
+                    false_over += self._false_over_limit(
+                        int(bcast_ms),
+                        s,
+                        replica_view.get(key),
+                        r_applied.get(key),
+                    )
                     kind, stale = self._classify(
                         int(bcast_ms),
                         s,
@@ -158,6 +168,7 @@ class ConsistencyAuditor:
         m.consistency_max_staleness.set(max_ms)
         self._last_max_ms = max_ms
         self._audit_leases()
+        await self._audit_admission(false_over, peer_admission)
         return self.summary()
 
     def _audit_leases(self) -> None:
@@ -190,6 +201,62 @@ class ConsistencyAuditor:
             "over_admission_bound_hits": records,
             "leases": len(lm._leases),
         }
+
+    def _false_over_limit(self, bcast_ms, owner, replica, r_applied_ms) -> int:
+        """1 when this key is a sampled FALSE OVER_LIMIT — the
+        under-admission half of the enforcement-error SLI: the replica's
+        transport is current (it applied the owner's last broadcast, so
+        this is divergence, not in-flight lag), yet it would refuse
+        (OVER_LIMIT status or no tokens) while the owner still has
+        tokens to give. Requests landing on that replica are denied hits
+        the configured limit allows."""
+        if r_applied_ms is None or r_applied_ms < bcast_ms:
+            return 0  # transport behind: lag/lost classify it instead
+        if replica is None:
+            return 0
+        refuses = (
+            int(replica.get("status", 0)) == 1
+            or int(replica.get("remaining", 0)) <= 0
+        )
+        return 1 if refuses and int(owner.remaining) > 0 else 0
+
+    async def _audit_admission(self, false_over, peer_admission) -> None:
+        """Admission pass (docs/monitoring.md "Admission"): publish the
+        max measured over-admission ratio across this owner's table scan
+        and the sampled replica's (from its DebugInfo admission blob),
+        plus the sampled false-OVER_LIMIT key count. Both gauges re-set
+        every pass — the falls-toward-zero contract: after a partition
+        heals and the queues drain, the next pass reads 0."""
+        m = self.svc.metrics
+        ratios = []
+        owner_window = None
+        eng = self.svc.engine
+        if hasattr(eng, "admission_snapshot"):
+            owner_window = await asyncio.get_running_loop().run_in_executor(
+                None, eng.admission_snapshot
+            )
+            ratios.append(float(owner_window.get("excess_ratio", 0.0)))
+        replica_ratio = None
+        if peer_admission:
+            window = peer_admission.get("window") or {}
+            replica_ratio = float(window.get("excess_ratio", 0.0))
+            ratios.append(replica_ratio)
+        max_ratio = max(ratios) if ratios else 0.0
+        m.admission_audit_max_excess_ratio.set(max_ratio)
+        m.admission_false_over_limit.set(false_over)
+        last = {
+            "max_excess_ratio": max_ratio,
+            "false_over_limit_keys": false_over,
+        }
+        if owner_window is not None:
+            last["owner"] = {
+                "excess_ratio": float(owner_window.get("excess_ratio", 0.0)),
+                "excess_hits": int(owner_window.get("excess_hits", 0)),
+                "limit_hits": int(owner_window.get("limit_hits", 0)),
+            }
+        if replica_ratio is not None:
+            last["sampled_replica_excess_ratio"] = replica_ratio
+        self._admission_last = last
 
     async def _owner_snapshots(self, keys) -> Dict[str, object]:
         from gubernator_tpu.store.store import snapshots_from_engine
@@ -237,4 +304,6 @@ class ConsistencyAuditor:
         }
         if self._lease_last:
             out["leases"] = dict(self._lease_last)
+        if self._admission_last:
+            out["admission"] = dict(self._admission_last)
         return out

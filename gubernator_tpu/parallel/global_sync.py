@@ -148,6 +148,11 @@ class GlobalManager:
         # (service/fastpath.py), so queued items stay metadata-free and
         # only ONE sampled probe per flush carries the wire stamp.
         self._hit_enq: Dict[str, float] = {}
+        # Hits in the flush currently on the wire (the BatchQueue swap
+        # empties items before _send_hits runs, so queued + in-flight
+        # together are this replica's un-relayed admissions — the GLOBAL
+        # leg of the over-admission bound, admission_debug_info()).
+        self._sending_hits_count = 0
         # Keys this owner has broadcast (key -> wall ms of last
         # broadcast), bounded LRU. The divergence auditor samples from
         # here: exactly the keys whose state SHOULD exist at replicas.
@@ -191,6 +196,16 @@ class GlobalManager:
     @property
     def hits(self) -> Dict[str, RateLimitReq]:
         return self._hits_q.items
+
+    def inflight_hits(self) -> int:
+        """Hits this node admitted from GLOBAL replica state that the
+        owners' tables have not yet absorbed: queued hit-updates plus
+        the flush currently on the wire. The GLOBAL contribution to the
+        node's over-admission bound (docs/monitoring.md "Admission")."""
+        queued = sum(
+            max(r.hits, 0) for r in self._hits_q.items.values()
+        )
+        return queued + self._sending_hits_count
 
     @property
     def updates(self) -> Dict[str, RateLimitReq]:
@@ -296,6 +311,7 @@ class GlobalManager:
 
     async def _send_hits_traced(self, hits: Dict[str, RateLimitReq]) -> None:
         t0 = time.perf_counter()
+        self._sending_hits_count = sum(max(r.hits, 0) for r in hits.values())
         self.svc.metrics.global_send_keys.observe(len(hits))
         wait_leg = self.svc.metrics.global_sync_leg_duration.labels(
             "hit_queue_wait"
@@ -365,6 +381,10 @@ class GlobalManager:
 
             await asyncio.gather(*(send(p, rs) for p, rs in by_peer.values()))
         finally:
+            # Reset BEFORE requeueing: requeued hits re-enter the queued
+            # half of inflight_hits(); counting them on the wire too
+            # would double the bound for a beat.
+            self._sending_hits_count = 0
             for reqs, aged in failed:
                 self._requeue_hits(reqs, aged=aged)
             self.svc.metrics.global_send_duration.observe(time.perf_counter() - t0)

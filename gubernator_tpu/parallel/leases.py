@@ -636,14 +636,26 @@ class LeaseCache:
         e.local_remaining -= req.hits
         e.used += req.hits
         self.stats["local_answers"] += 1
-        return RateLimitResp(
-            status=Status.UNDER_LIMIT,
-            limit=e.limit,
-            remaining=max(0, e.remaining_at_grant - e.used),
-            reset_time=e.reset_time,
-            metadata={
-                LEASE_STALENESS_MD_KEY: str(max(0, now - e.granted_ms))
-            },
+        from gubernator_tpu.service.admission import (
+            PATH_LEASE,
+            stamp_decision,
+        )
+
+        # Lease answers ALWAYS carry provenance (no stage_metadata gate):
+        # the debit is invisible to the owner until renew, so the stamp
+        # + grant age IS the honesty contract of client-side enforcement.
+        return stamp_decision(
+            RateLimitResp(
+                status=Status.UNDER_LIMIT,
+                limit=e.limit,
+                remaining=max(0, e.remaining_at_grant - e.used),
+                reset_time=e.reset_time,
+                metadata={
+                    LEASE_STALENESS_MD_KEY: str(max(0, now - e.granted_ms))
+                },
+            ),
+            PATH_LEASE,
+            max(0, now - e.granted_ms),
         )
 
     def _retire(self, key: str, e: _CacheEntry) -> None:

@@ -39,6 +39,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.parallel.hash_ring import ReplicatedConsistentHash
 from gubernator_tpu.parallel.region import RegionPicker
+from gubernator_tpu.service import admission as _admission
 from gubernator_tpu.service import pb
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.rpc import PeersV1Stub
@@ -836,6 +837,18 @@ class PeerMesh:
         resp.metadata["owner"] = addr
         resp.metadata["degraded"] = "owner-unreachable"
         self.svc.metrics.degraded_local_answers.inc()
+        # Decision provenance (docs/monitoring.md "Admission"): a
+        # degraded-local answer's staleness bound is unknowable — the
+        # owner is unreachable, so we can't know how far the local view
+        # lags it. Stamp the path, omit the bound.
+        cfg = getattr(self.svc.engine, "cfg", None)
+        if bool(getattr(cfg, "stage_metadata", False)):
+            _admission.stamp_decision(resp, _admission.PATH_DEGRADED_LOCAL)
+        recorder = getattr(self.svc, "recorder", None)
+        if recorder is not None:
+            recorder.record_decision(
+                _admission.PATH_DEGRADED_LOCAL, resp, key=req.hash_key()
+            )
         if self.svc.global_mgr is not None and req.hits:
             # Redelivery path: the hit-update queue retries with bounded
             # aging until the owner's circuit closes (global_sync.py).

@@ -44,6 +44,7 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.ops.encode import EncodeError, encode_one, encode_rows
 from gubernator_tpu.ops.layout import RequestBatch, SlotTable
 from gubernator_tpu.ops.kernels import (
+    get_admission,
     get_census,
     get_kernels,
     get_paged_kernels,
@@ -145,6 +146,12 @@ class EngineConfig:
     # axis aggregates into this many contiguous regions — the future
     # paged-table "page" axis (ROADMAP item 1).
     census_heatmap_width: int = 64
+    # Admission observatory (docs/monitoring.md "Admission"): TTL of
+    # the cached admitted-vs-limit accounting scan (GUBER_ADMISSION_TTL)
+    # — every scrape surface (/debug/admission, the SLI gauges, the
+    # auditor's admission pass) reads the cache, so at most ONE
+    # admission program runs per interval.
+    admission_ttl_s: float = 5.0
     # ---- paged table (GUBER_TABLE_PAGE_*, docs/architecture.md
     # "Paged table") ----
     # Groups per page (GUBER_TABLE_PAGE_GROUPS): 0 keeps the classic
@@ -432,6 +439,12 @@ class EngineBase:
         self._census_cache: Optional[dict] = None
         self._census_ts = 0.0
         self._census_prev = None  # (t_mono, misses, evictions, live)
+        # Admission-accounting cache (docs/monitoring.md "Admission"):
+        # same single-scan-per-TTL contract as the census, separate
+        # cadence knob (GUBER_ADMISSION_TTL).
+        self._admission_lock = lockorder.make_lock("engine.admission")
+        self._admission_cache: Optional[dict] = None
+        self._admission_ts = 0.0
         # Cumulative pump time spent in _dispatch (host encode + launch);
         # pump-thread-only writer, read by the completion stage for the
         # host/device overlap ratio.
@@ -866,6 +879,33 @@ class EngineBase:
             self._census_ts = time.monotonic()
             return snap
 
+    # -- admission accounting (docs/monitoring.md "Admission") ---------------
+
+    def admission_snapshot(self, max_age_s: Optional[float] = None) -> dict:
+        """TTL-cached admitted-vs-limit accounting — the admission
+        observatory's single entry point (/debug/admission, the SLI
+        gauges, DebugInfo, and the auditor's admission pass all read
+        it). Same dispatch discipline as table_census: the engine lock
+        is held only long enough to dispatch the NON-donating admission
+        program (async — no host sync under the lock); the O(buckets)
+        materialization happens after release, in _admission_scan.
+        Pass max_age_s=0 to force a fresh scan."""
+        ttl = (
+            float(getattr(self.cfg, "admission_ttl_s", 5.0))
+            if max_age_s is None
+            else float(max_age_s)
+        )
+        with self._admission_lock:
+            if (
+                self._admission_cache is not None
+                and time.monotonic() - self._admission_ts < ttl
+            ):
+                return self._admission_cache
+            snap = self._admission_scan()
+            self._admission_cache = snap
+            self._admission_ts = time.monotonic()
+            return snap
+
     def _census_churn(self, snap: dict) -> dict:
         """Churn ledger: interval deltas of the flush bookkeeping the
         engine already keeps, turned into rates at census cadence.
@@ -1170,6 +1210,63 @@ def _census_combine(tiers: Dict[str, dict], primary: str) -> dict:
     }
 
 
+def _admission_tier_dict(out) -> dict:
+    """Materialize one AdmissionOutput (or an oracle dict) into plain
+    host ints/lists — the per-tier payload of admission_snapshot."""
+    if isinstance(out, dict):
+        d = dict(out)
+    else:
+        d = {
+            f: np.asarray(getattr(out, f))  # guberlint: allow-host-sync -- admission readback: O(buckets) scalars at TTL cadence, outside the serving lock
+            for f in out._fields
+        }
+    keys, admitted, limit, excess, excess_keys, max_excess, over, hist = (
+        d[f]
+        for f in (
+            "keys", "admitted_sum", "limit_sum", "excess_sum",
+            "excess_keys", "max_excess", "over_limit_keys", "excess_hist",
+        )
+    )
+    return {
+        "keys": int(keys),
+        "admitted_hits": int(admitted),
+        "limit_hits": int(limit),
+        "excess_hits": int(excess),
+        "excess_keys": int(excess_keys),
+        "max_excess": int(max_excess),
+        "over_limit_keys": int(over),
+        "excess_hist": [int(x) for x in hist],
+    }
+
+
+def _admission_combine(tiers: Dict[str, dict]) -> dict:
+    """Top-level admission snapshot: everything is additive across
+    tiers (each key lives in exactly one tier) except max_excess, which
+    takes the max. The over-admission SLI ratio is derived at the top:
+    excess hits per configured limit hit, 0 on an empty table."""
+    excess = sum(t["excess_hits"] for t in tiers.values())
+    limit = sum(t["limit_hits"] for t in tiers.values())
+    snap = {
+        "v": 1,
+        "keys": sum(t["keys"] for t in tiers.values()),
+        "admitted_hits": sum(t["admitted_hits"] for t in tiers.values()),
+        "limit_hits": limit,
+        "excess_hits": excess,
+        "excess_keys": sum(t["excess_keys"] for t in tiers.values()),
+        "max_excess": max(t["max_excess"] for t in tiers.values()),
+        "over_limit_keys": sum(
+            t["over_limit_keys"] for t in tiers.values()
+        ),
+        "excess_ratio": excess / float(limit) if limit else 0.0,
+        "excess_hist": [
+            sum(vals)
+            for vals in zip(*(t["excess_hist"] for t in tiers.values()))
+        ],
+        "tiers": tiers,
+    }
+    return snap
+
+
 class DeviceEngine(EngineBase):
     """Owns the device slot table; turns request streams into decisions.
 
@@ -1247,6 +1344,9 @@ class DeviceEngine(EngineBase):
             heatmap_width=int(config.census_heatmap_width),
             thresholds=self._census_thresholds,
         )
+        # Admission-accounting program (ops/admission.py): same
+        # non-donating scan contract as the census, warmed alongside it.
+        self._admission = get_admission(config.layout, config.ways)
 
         # HBM attribution (utils/devicemem.py): static geometry sized
         # once; device_memory() folds in allocator stats per call.
@@ -1456,9 +1556,13 @@ class DeviceEngine(EngineBase):
             * 8
             * 8
         )
+        # Admission output: one excess histogram plus a handful of int64
+        # scalars (ops/admission.py AdmissionOutput).
+        admission_b = 8 * (32 + 8)
         subs = {
             "slot_table": table_b,
             "census": census_b,
+            "admission": admission_b,
             "pipeline_ring": ring_b,
         }
         if self._pager is not None:
@@ -1488,6 +1592,10 @@ class DeviceEngine(EngineBase):
             # scrape must dispatch a warm program, not pay a compile.
             c = self._census(self._census_view(table), now)
             tx.add(np.asarray(c.live))  # guberlint: allow-host-sync -- warmup: compile the census program before serving
+            # Admission accounting likewise: the first /debug/admission
+            # scrape or auditor pass must never compile.
+            a = self._admission(self._census_view(table), now)
+            tx.add(np.asarray(a.keys))  # guberlint: allow-host-sync -- warmup: compile the admission program before serving
         if self._pager is not None:
             # Compile the page-migration programs (bind/extract/write/
             # unbind) on a throwaway cycle over frame 0: the first
@@ -1655,6 +1763,49 @@ class DeviceEngine(EngineBase):
             bytes_per_slot=self.K.bytes_per_slot,
             thresholds=self._census_thresholds,
             heatmap_width=int(cfg.census_heatmap_width),
+        )
+
+    def _admission_scan(self) -> dict:
+        """One admission-accounting pass (called by admission_snapshot
+        with _admission_lock held): dispatch the non-donating program on
+        the live table reference under the engine lock, materialize
+        after release. Paged mode scans the PHYSICAL frames on device
+        and the demoted host pages with the numpy oracle (same split as
+        the census) — a demoted key's window still counts."""
+        now = self.now_fn()
+        host_pages = None
+        with self._lock:
+            out = self._admission(self._census_view(self.table), now)
+            if self._pager is not None:
+                host_pages = self._pager.host_tier_copy()
+        with _transfer.account(self.metrics, "d2h", "admission") as tx:
+            tier = _admission_tier_dict(out)
+            tx.add(out)
+        tiers = {"device": tier}
+        if self._pager is not None:
+            tiers["host"] = self._admission_host_tier(host_pages, now)
+        snap = _admission_combine(tiers)
+        snap["now_ms"] = now
+        return snap
+
+    def _admission_host_tier(self, host_pages: dict, now: int) -> dict:
+        """Admission-account the demoted pages with the numpy oracle;
+        returns the same tier dict shape as the device tier so
+        _admission_combine sums them. Empty host tier -> all zeros."""
+        from gubernator_tpu.ops.admission import admission_oracle
+        from gubernator_tpu.runtime.pager import wide_zeros
+
+        ps = self.K.page_slots
+        if host_pages:
+            lps = sorted(host_pages)
+            fields = {
+                f: np.concatenate([host_pages[lp][f] for lp in lps])
+                for f in SlotTable._fields
+            }
+        else:
+            fields = wide_zeros(ps)  # one empty page: zero counts
+        return _admission_tier_dict(
+            admission_oracle(SlotTable(**fields), now)
         )
 
     def hotkeys_snapshot(self) -> dict:

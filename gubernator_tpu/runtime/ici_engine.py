@@ -42,7 +42,7 @@ from gubernator_tpu.utils import lockorder
 from gubernator_tpu.api.keys import group_of, key_hash128_batch
 from gubernator_tpu.api.types import Behavior, RateLimitResp
 from gubernator_tpu.ops.encode import EncodeError, encode_one
-from gubernator_tpu.ops.kernels import BYTES_PER_SLOT, get_census
+from gubernator_tpu.ops.kernels import BYTES_PER_SLOT, get_admission, get_census
 from gubernator_tpu.ops.layout import RequestBatch
 from gubernator_tpu.parallel import ici
 from gubernator_tpu.parallel import mesh as pmesh
@@ -52,6 +52,8 @@ from gubernator_tpu.runtime.engine import (
     TableCommittedError,
     _FlushTicket,
     _WaveAssembler,
+    _admission_combine,
+    _admission_tier_dict,
     _assemble_column_waves,
     _census_combine,
     _census_tier_snapshot,
@@ -93,6 +95,9 @@ class IciEngineConfig:
     census_ttl_s: float = 5.0
     census_thresholds: tuple = (1, 4, 16)
     census_heatmap_width: int = 64
+    # Admission-accounting cadence — same semantics as EngineConfig
+    # (GUBER_ADMISSION_TTL; the scan covers BOTH tiers).
+    admission_ttl_s: float = 5.0
     # Table layout for BOTH the sharded and replica tiers (the
     # ops/kernels.py LAYOUTS registry; "narrow" halves probe DMA at
     # large tables); fused is the TPU production layout (VERDICT r4
@@ -228,6 +233,11 @@ class IciEngine(EngineBase):
             thresholds=self._census_thresholds,
             stacked=True,
         )
+        # Admission accounting (ops/admission.py): same two-tier split.
+        self._admission_sharded = get_admission(cfg.layout, cfg.ways)
+        self._admission_replica = get_admission(
+            cfg.layout, cfg.replica_ways, stacked=True
+        )
 
         # HBM attribution (utils/devicemem.py): static geometry sized
         # once; EngineBase.device_memory() folds in allocator stats.
@@ -245,6 +255,8 @@ class IciEngine(EngineBase):
             # pending deltas + tick scalar, ops/ici.py).
             "ici_replicas": self.n_dev * cfg.num_slots * (bps + 8) + 8 * self.n_dev,
             "census": census_b,
+            # Two AdmissionOutputs: histogram + scalar rows per tier.
+            "admission": 2 * 8 * (32 + 8),
             "pipeline_ring": (
                 max(int(cfg.pipeline_depth), 1)
                 * cfg.max_waves * cfg.batch_size * 8 * 8
@@ -635,6 +647,28 @@ class IciEngine(EngineBase):
             snap["pages"] = {"enabled": False, "paging": "unsupported (flat)"}
         return snap
 
+    def _admission_scan(self) -> dict:
+        """One admission pass over both tiers (called by
+        admission_snapshot with _admission_lock held): dispatch both
+        non-donating programs under the engine lock, materialize after
+        release. A key lives in exactly one tier (GLOBAL keys count in
+        the replica tier, everything else in the sharded table), so the
+        combine's additive sums stay a true fleet count."""
+        now = self.now_fn()
+        with self._lock:
+            out_s = self._admission_sharded(self.table, now)
+            out_r = self._admission_replica(self.ici_state.table, now)
+        with _transfer.account(self.metrics, "d2h", "admission") as tx:
+            tiers = {
+                "sharded": _admission_tier_dict(out_s),
+                "replica": _admission_tier_dict(out_r),
+            }
+            tx.add(out_s)
+            tx.add(out_r)
+        snap = _admission_combine(tiers)
+        snap["now_ms"] = now
+        return snap
+
     def debug_snapshot(self) -> dict:
         snap = super().debug_snapshot()
         if self._paging_requested:
@@ -671,6 +705,11 @@ class IciEngine(EngineBase):
             cr = self._census_replica(self.ici_state.table, now)
             tx.add(np.asarray(cs.live))  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
             tx.add(np.asarray(cr.live))  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
+            # Admission accounting likewise, both tiers.
+            ads = self._admission_sharded(self.table, now)
+            adr = self._admission_replica(self.ici_state.table, now)
+            tx.add(np.asarray(ads.keys))  # guberlint: allow-host-sync -- warmup: compile both admission programs before serving
+            tx.add(np.asarray(adr.keys))  # guberlint: allow-host-sync -- warmup: compile both admission programs before serving
         # Final fence: __init__ returns with every program compiled and
         # the replica state resident.
         jax.block_until_ready(self.ici_state.pending)

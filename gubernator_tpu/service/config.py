@@ -323,6 +323,16 @@ class DaemonConfig:
     census_thresholds: tuple = (1, 4, 16)
     census_heatmap_width: int = 64
 
+    # Admission observatory (docs/monitoring.md "Admission"):
+    # GUBER_ADMISSION_TTL caches the device admission scan (ground-truth
+    # admitted-vs-limit accounting) for this many seconds — scrapes of
+    # /metrics and /debug/admission within the window reuse it, zero
+    # device work; GUBER_ADMISSION_RING bounds the decision
+    # flight-recorder ring (last N answers with path, status, key hash,
+    # staleness, trace id).
+    admission_ttl_s: float = 5.0
+    admission_ring: int = 256
+
     # Paged slot table (docs/architecture.md "Paged table"):
     # GUBER_TABLE_PAGE_GROUPS > 0 carves the table into pages of that
     # many contiguous groups behind a device-resident indirection map,
@@ -378,6 +388,7 @@ class DaemonConfig:
             census_ttl_s=self.census_ttl_s,
             census_thresholds=self.census_thresholds,
             census_heatmap_width=self.census_heatmap_width,
+            admission_ttl_s=self.admission_ttl_s,
             page_groups=self.page_groups,
             page_budget=self.page_budget,
             page_demote_interval_s=self.page_demote_interval_s,

@@ -118,6 +118,8 @@ class Daemon:
             self.engine,
             metrics=metrics,
             force_global=conf.behaviors.force_global,
+            # knob: GUBER_ADMISSION_RING (decision flight recorder)
+            admission_ring=getattr(conf, "admission_ring", 256),
         )
         # Server-suggested backoff (GUBER_RETRY_AFTER): OVER_LIMIT
         # responses carry retry_after_ms; off keeps responses bit-exact.

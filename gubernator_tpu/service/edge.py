@@ -343,17 +343,35 @@ class EdgeLeases:
     process is single-loop."""
 
     def __init__(self, client: EdgeClient, cache, holder: str = "edge",
-                 local_counter=None):
+                 local_counter=None, recorder=None):
         self.client = client
         self.cache = cache
         self.holder = holder
         self.local_counter = local_counter
+        # DecisionRecorder (service/admission.py): edge-answered debits
+        # count under path=lease like holder-side daemon answers do.
+        self.recorder = recorder
         self._tasks: set = set()
 
     def try_serve(self, req):
         resp = self.cache.try_serve(req)
-        if resp is not None and self.local_counter is not None:
-            self.local_counter.inc()
+        if resp is not None:
+            if self.local_counter is not None:
+                self.local_counter.inc()
+            if self.recorder is not None:
+                from gubernator_tpu.parallel.leases import (
+                    LEASE_STALENESS_MD_KEY,
+                )
+                from gubernator_tpu.service.admission import PATH_LEASE
+
+                self.recorder.record_decision(
+                    PATH_LEASE,
+                    resp,
+                    key=req.hash_key(),
+                    staleness_ms=int(
+                        resp.metadata.get(LEASE_STALENESS_MD_KEY, 0)
+                    ),
+                )
         return resp
 
     def kick(self) -> None:

@@ -217,6 +217,10 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env("GUBER_TABLE_CENSUS_THRESHOLDS")
         ),
         census_heatmap_width=_env_int("GUBER_TABLE_CENSUS_HEATMAP", 64),
+        # Admission observatory (docs/monitoring.md "Admission"):
+        # admission-scan TTL and decision flight-recorder ring size.
+        admission_ttl_s=parse_duration_s(_env("GUBER_ADMISSION_TTL"), 5.0),
+        admission_ring=_env_int("GUBER_ADMISSION_RING", 256),
         # Paged slot table (docs/architecture.md "Paged table"): page
         # granularity in groups (0 = flat table), resident-page budget,
         # background-demoter cadence, and free-frame headroom target.
@@ -239,6 +243,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_PROFILE_KEEP={conf.profile_keep}' is invalid; the "
             "rotation must keep at least 1 trace"
+        )
+    if conf.admission_ring < 1:
+        raise ValueError(
+            f"'GUBER_ADMISSION_RING={conf.admission_ring}' is invalid; "
+            "the decision flight recorder must hold at least 1 entry"
         )
     if conf.census_heatmap_width < 1:
         raise ValueError(

@@ -264,6 +264,37 @@ def try_serve(svc, data: bytes, peer_call: bool):
         if m is not None:
             m.inc(int(served_mask.sum()) - n_glob)
 
+    def record_provenance(out, positions):
+        # Decision provenance (docs/monitoring.md "Admission"), with the
+        # same replica/local split as the labels above: GLOBAL non-owner
+        # lanes answered from the local table are path=replica, the rest
+        # path=fastpath. Peer-call batches are NOT recorded — the object
+        # path counts forwarded answers at the forwarding node only, and
+        # the columnar edge must match it decision-for-decision. Staleness
+        # bounds stay 0: the per-key bound lives in the object path's
+        # metadata, and GUBER_STAGE_METADATA disables this edge entirely.
+        rec = getattr(svc, "recorder", None)
+        if rec is None or peer_call:
+            return
+        status, _limit, remaining, _reset = out
+
+        def sample_key(j):
+            return _req_from_columns(cols, int(positions[j])).hash_key()
+
+        rest = None
+        if has_global:
+            rep = (g_mask & ~g_owned)[positions]
+            if bool(rep.any()):
+                rec.record_columnar(
+                    "replica", status, remaining,
+                    mask=rep, sample_key=sample_key,
+                )
+                rest = ~rep
+        rec.record_columnar(
+            "fastpath", status, remaining,
+            mask=rest, sample_key=sample_key,
+        )
+
     def owner_spans(positions):
         """(owner_data, owner_offsets) for build_responses_md: non-owned
         GLOBAL items report their authoritative owner; everything else
@@ -287,6 +318,7 @@ def try_serve(svc, data: bytes, peer_call: bool):
         if out is None:
             return None
         count_metrics(np.ones(cols.n, dtype=bool))
+        record_provenance(out, np.arange(cols.n))
         if has_global or mr_queue:
             queue_legs()
         if has_global and owner_addrs is not None and bool(
@@ -323,6 +355,7 @@ def try_serve(svc, data: bytes, peer_call: bool):
     if out is None:
         return None
     count_metrics(local)
+    record_provenance(out, local_pos)
     md = None
     if has_global or mr_queue:
         queue_legs()

@@ -124,6 +124,21 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
             {"enabled": True, **svc.lease_mgr.summary()}
         )
 
+    async def debug_admission(request: web.Request) -> web.Response:
+        """Admission observatory (docs/monitoring.md "Admission"): the
+        engine's TTL-cached ground-truth window accounting (admitted vs
+        configured limit over the resident table), decision counts by
+        serving path, the node's over-admission bound (outstanding lease
+        hits + un-relayed GLOBAL hits), and the decision flight-recorder
+        ring. TTL-cached engine snapshot + host dict copies — scraping
+        this endpoint never compiles or dispatches device work beyond
+        one scan per TTL interval; the cache read takes engine locks,
+        so executor."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.admission_debug_info
+        )
+        return web.json_response(snap)
+
     async def debug_cluster(request: web.Request) -> web.Response:
         """Cluster-wide debug view (docs/monitoring.md "Consistency"):
         this node's local_debug_info plus a breaker-gated, shared-deadline
@@ -167,6 +182,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/leases", debug_leases)
+    app.router.add_get("/debug/admission", debug_admission)
     app.router.add_get("/debug/cluster", debug_cluster)
 
 

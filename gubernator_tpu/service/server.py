@@ -34,6 +34,13 @@ from gubernator_tpu.parallel.leases import (
     RETRY_AFTER_MD_KEY,
 )
 from gubernator_tpu.runtime.engine import DeviceEngine
+from gubernator_tpu.service.admission import (
+    DecisionRecorder,
+    PATH_FORWARDED,
+    PATH_OWNER,
+    PATH_REPLICA,
+    stamp_decision,
+)
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
 
@@ -60,6 +67,7 @@ class V1Service:
         local_info: Optional[PeerInfo] = None,
         force_global: bool = False,
         now_fn=_clock.now_ms,
+        admission_ring: int = 256,
     ):
         self.engine = engine
         self.metrics = metrics or Metrics()
@@ -101,6 +109,13 @@ class V1Service:
         self._m_local = m.getratelimit_counter.labels("local")
         self._m_global = m.getratelimit_counter.labels("global")
         self._m_forward = m.getratelimit_counter.labels("forward")
+        # Admission observatory (docs/monitoring.md "Admission"): every
+        # answer this node produces is counted by serving path and logged
+        # in the bounded flight recorder; the scrape-time bridge publishes
+        # the node's measured over-admission ratio from the engine's
+        # TTL-cached admission scan.
+        self.recorder = DecisionRecorder(self.metrics, ring_size=admission_ring)
+        self.metrics.add_sync(self._admission_sync)
 
     # ---- V1.GetRateLimits (reference gubernator.go:183-309) ----------------
 
@@ -197,8 +212,8 @@ class V1Service:
         if local_items:
             local_fut = self.engine.check_bulk([r for _, r in local_items])
 
+        stage_md = bool(getattr(self.engine.cfg, "stage_metadata", False))
         if global_fut is not None:
-            stage_md = bool(getattr(self.engine.cfg, "stage_metadata", False))
             try:
                 results = await asyncio.wrap_future(global_fut)
                 for (i, req, owner), resp in zip(global_items, results):
@@ -208,16 +223,22 @@ class V1Service:
                     # stage_breakdown_us (GUBER_STAGE_METADATA) already.
                     resp.metadata["owner"] = owner.grpc_address
                     self._attach_retry_after(resp, now)
+                    # Replica-staleness bound: age of the last owner
+                    # broadcast applied locally for this key. Absent
+                    # until the first broadcast lands (a fresh replica
+                    # has no bound to honestly report).
+                    ts = self._global_last_update.get(req.hash_key())
+                    stale = max(0, now - ts) if ts is not None else None
                     if stage_md:
-                        # Replica-staleness bound: age of the last owner
-                        # broadcast applied locally for this key. Absent
-                        # until the first broadcast lands (a fresh replica
-                        # has no bound to honestly report).
-                        ts = self._global_last_update.get(req.hash_key())
-                        if ts is not None:
-                            resp.metadata["global_staleness_ms"] = str(
-                                max(0, now - ts)
-                            )
+                        if stale is not None:
+                            resp.metadata["global_staleness_ms"] = str(stale)
+                        stamp_decision(resp, PATH_REPLICA, stale)
+                    self.recorder.record_decision(
+                        PATH_REPLICA,
+                        resp,
+                        key=req.hash_key(),
+                        staleness_ms=stale or 0,
+                    )
                     responses[i] = resp
             except Exception as e:
                 for i, _, _ in global_items:
@@ -229,8 +250,17 @@ class V1Service:
                 for (i, req), resp in zip(local_items, results):
                     responses[i] = resp
                     if resp.error:
+                        self.recorder.record_decision(
+                            PATH_OWNER, resp, key=req.hash_key()
+                        )
                         continue
                     self._attach_retry_after(resp, now)
+                    # Owner answers are authoritative: staleness bound 0.
+                    if stage_md:
+                        stamp_decision(resp, PATH_OWNER, 0)
+                    self.recorder.record_decision(
+                        PATH_OWNER, resp, key=req.hash_key()
+                    )
                     # Replication legs queue only AFTER a successful local
                     # apply (reference gubernator.go:603-606 order) — a
                     # failed apply must not push hits it never counted.
@@ -249,10 +279,25 @@ class V1Service:
 
         for i, task in forward_tasks:
             try:
-                responses[i] = await task
+                resp = await task
             except Exception as e:
                 m.check_error_counter.labels("Error in asyncRequests").inc()
-                responses[i] = RateLimitResp(error=str(e))
+                resp = RateLimitResp(error=str(e))
+            else:
+                # The degraded-local fallback stamps its own provenance
+                # (peers.py _owner_unreachable + its recorder hook) —
+                # don't overwrite it or double-count here. The "degraded"
+                # marker is unconditional there, unlike the stage_md-gated
+                # path stamp, so it discriminates at every knob setting.
+                degraded = bool(resp.metadata) and "degraded" in resp.metadata
+                if not degraded:
+                    if stage_md and not resp.error:
+                        # Answered by the owner's engine: authoritative.
+                        stamp_decision(resp, PATH_FORWARDED, 0)
+                    self.recorder.record_decision(
+                        PATH_FORWARDED, resp, key=reqs[i].hash_key()
+                    )
+            responses[i] = resp
         return [r if r is not None else RateLimitResp(error="internal: no response") for r in responses]
 
     def _get_peer(self, key: str):
@@ -647,6 +692,12 @@ class V1Service:
         # bandwidth with no wire-format bump (docs/monitoring.md
         # "Device resources").
         info["device"] = self.device_debug_info()
+        # Admission blob rides DebugInfo as well (sans flight-recorder
+        # ring — 256 rows per node is wire weight the fleet view doesn't
+        # need; /debug/admission serves the ring locally): the auditor's
+        # admission pass reads each node's measured window accounting and
+        # over-admission bound from here.
+        info["admission"] = self.admission_debug_info(include_ring=False)
         consistency: dict = {
             "propagation_lag": m.global_propagation_lag.summary(),
             "staleness_keys_tracked": len(self._global_last_update),
@@ -678,6 +729,57 @@ class V1Service:
                 if k in self._global_last_update
             }
         return info
+
+    def admission_debug_info(self, include_ring: bool = True) -> dict:
+        """/debug/admission payload (docs/monitoring.md "Admission"):
+        the engine's TTL-cached ground-truth window accounting, the
+        decision counters by path, the over-admission BOUND this node
+        contributes (outstanding lease hits + queued GLOBAL hits not yet
+        relayed), and — locally only — the decision flight recorder.
+        Scrape-safe: the engine snapshot is TTL-cached (GL009), the rest
+        is host-side dict copies."""
+        blob: dict = {"v": 1}
+        if hasattr(self.engine, "admission_snapshot"):
+            blob["window"] = self.engine.admission_snapshot()
+        rec = self.recorder.snapshot()
+        blob["decisions"] = rec["decisions"]
+        blob["ring_size"] = rec["ring_size"]
+        if include_ring:
+            blob["ring"] = rec["ring"]
+        # The over-admission bound: hits this node has admitted (or will
+        # admit) that the owners' tables have not yet absorbed. During a
+        # partition the fleet's measured excess must stay within the sum
+        # of these across nodes; after heal both legs drain to 0.
+        bound: dict = {}
+        if self.lease_mgr is not None:
+            bound["lease_outstanding_hits"] = int(
+                self.lease_mgr.outstanding_hits()
+            )
+        if self.global_mgr is not None and hasattr(
+            self.global_mgr, "inflight_hits"
+        ):
+            bound["global_inflight_hits"] = int(
+                self.global_mgr.inflight_hits()
+            )
+        bound["total_hits"] = sum(bound.values())
+        blob["bound"] = bound
+        return blob
+
+    def _admission_sync(self) -> None:
+        """Scrape-time bridge: publish this node's measured over-admission
+        ratio (excess hits / configured limit over active windows, from
+        the engine's TTL-cached admission scan). Single writer for
+        gubernator_admission_excess_ratio — the auditor's fleet-max lives
+        in a separate gauge (admission_audit_max_excess_ratio)."""
+        if not hasattr(self.engine, "admission_snapshot"):
+            return
+        try:
+            snap = self.engine.admission_snapshot()
+        except Exception:  # guberlint: allow-swallow -- scrape bridge: a failed scan must not poison /metrics
+            return
+        self.metrics.admission_excess_ratio.set(
+            float(snap.get("excess_ratio", 0.0))
+        )
 
     def device_debug_info(self) -> dict:
         """/debug/device payload (docs/monitoring.md "Device

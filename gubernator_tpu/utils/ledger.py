@@ -251,7 +251,7 @@ def gate(
 
 _MODE_FROM_JOB = re.compile(
     r"(kernel10m|kernel|engine_ab|engine|server|global|latency|edge|ici"
-    r"|paged_table|lease_soak)"
+    r"|paged_table|lease_soak|admission_soak)"
 )
 _LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide|narrow)")
 

@@ -1,0 +1,56 @@
+"""GL012 violation fixture: RateLimitResp answers constructed on a
+serving path without decision provenance (no stamp_decision /
+record_decision in the enclosing function, no error= kwarg)."""
+
+
+class RateLimitResp:
+    def __init__(self, **kw):
+        self.metadata = kw.get("metadata", {})
+
+
+def stamp_decision(resp, path, staleness_ms=None):
+    return resp
+
+
+def serve_unstamped(req):
+    # fires: an answer with no provenance call anywhere in the function
+    return RateLimitResp(status=0, limit=10, remaining=9, metadata={})
+
+
+def serve_unstamped_over(req):
+    # fires: OVER_LIMIT answers need provenance too
+    return RateLimitResp(status=1, limit=10, remaining=0, metadata={})
+
+
+def serve_error(req):
+    # ok: error answers are exempt — the error string IS the provenance
+    return RateLimitResp(error="boom")
+
+
+def serve_stamped(req):
+    # ok: the enclosing function stamps the decision path
+    resp = RateLimitResp(status=0, limit=10, remaining=9, metadata={})
+    return stamp_decision(resp, "owner", 0)
+
+
+def serve_recorded(recorder, req):
+    # ok: counting through the flight recorder is provenance too
+    resp = RateLimitResp(status=0, limit=10, remaining=9, metadata={})
+    recorder.record_decision("owner", resp, key="k")
+    return resp
+
+
+def serve_columnar(recorder, statuses, remaining):
+    # ok: the vectorized recording call qualifies as well
+    recorder.record_columnar("fastpath", statuses, remaining)
+    return RateLimitResp(status=0, limit=10, remaining=9, metadata={})
+
+
+def serve_pragma(req):
+    # ok: witnessed-intentional site with a reasoned pragma
+    return RateLimitResp(status=0, limit=1, remaining=1, metadata={})  # guberlint: allow-decision-provenance -- fixture: synthetic response never served to a client
+
+
+def serve_pragma_reasonless(req):
+    # fires (re-messaged): the pragma must carry a reason
+    return RateLimitResp(status=0, limit=1, remaining=1, metadata={})  # guberlint: allow-decision-provenance

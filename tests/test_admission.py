@@ -1,0 +1,431 @@
+"""Admission observatory (docs/monitoring.md "Admission"): decision
+provenance on every serving path + the ground-truth window accounting.
+
+Three tiers under test:
+
+- DecisionRecorder / stamp_decision units: counter children, the
+  bounded flight-recorder ring, vectorized columnar recording;
+- engine admission_snapshot: contents vs hand-computed window math, the
+  TTL cache identity contract, expiry, and the scrape-never-compiles
+  invariant (guberlint GL009) the observatory is built around;
+- serving paths end-to-end: owner, forwarded, replica (GLOBAL at a
+  non-owner), degraded_local (owner circuit open), lease (holder-side
+  zero-RPC debit), and the columnar fastpath — each asserting the
+  expected `decision_path` metadata stamp and recorder count, plus the
+  /debug/admission payload and the new /metrics families.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+import requests
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq
+from gubernator_tpu.metrics import Metrics, engine_sync
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.service.admission import (
+    DECISION_PATH_MD_KEY,
+    DECISION_STALENESS_MD_KEY,
+    PATH_DEGRADED_LOCAL,
+    PATH_FORWARDED,
+    PATH_LEASE,
+    PATH_OWNER,
+    PATH_REPLICA,
+    PATHS,
+    DecisionRecorder,
+    stamp_decision,
+    status_label,
+)
+from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.service.daemon import Daemon
+from gubernator_tpu.cluster import Cluster
+
+NOW = 1_753_700_000_000
+MINUTE = 60_000
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "adm")
+    kw.setdefault("duration", MINUTE)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+def resp_like(status=0, remaining=5, error="", metadata=None):
+    return SimpleNamespace(
+        status=status, remaining=remaining, error=error,
+        metadata=metadata if metadata is not None else {},
+    )
+
+
+# ---- stamp_decision / status_label ------------------------------------------
+
+
+def test_stamp_decision_writes_metadata():
+    r = resp_like()
+    assert stamp_decision(r, PATH_OWNER, 0) is r
+    assert r.metadata[DECISION_PATH_MD_KEY] == PATH_OWNER
+    assert r.metadata[DECISION_STALENESS_MD_KEY] == "0"
+    # unknown staleness bound is omitted, not written as a lie
+    r2 = resp_like()
+    stamp_decision(r2, PATH_DEGRADED_LOCAL)
+    assert r2.metadata[DECISION_PATH_MD_KEY] == PATH_DEGRADED_LOCAL
+    assert DECISION_STALENESS_MD_KEY not in r2.metadata
+    # negative bounds clamp to honest zero
+    r3 = resp_like()
+    stamp_decision(r3, PATH_REPLICA, -40)
+    assert r3.metadata[DECISION_STALENESS_MD_KEY] == "0"
+    # a metadata-less response (peer-internal) is a no-op, not a crash
+    r4 = SimpleNamespace(metadata=None)
+    assert stamp_decision(r4, PATH_OWNER, 0) is r4
+
+
+def test_status_label():
+    assert status_label(resp_like(status=0)) == "under_limit"
+    assert status_label(resp_like(status=1)) == "over_limit"
+    assert status_label(resp_like(status=1, error="boom")) == "error"
+
+
+# ---- DecisionRecorder -------------------------------------------------------
+
+
+def test_recorder_counts_ring_and_metric_children():
+    m = Metrics()
+    rec = DecisionRecorder(m, ring_size=4)
+    for _ in range(3):
+        rec.record_decision(PATH_OWNER, resp_like(), key="a")
+    for _ in range(2):
+        rec.record_decision(
+            PATH_OWNER, resp_like(status=1, remaining=0), key="b",
+            staleness_ms=7,
+        )
+    snap = rec.snapshot()
+    assert snap["decisions"] == {
+        f"{PATH_OWNER}:over_limit": 2,
+        f"{PATH_OWNER}:under_limit": 3,
+    }
+    # ring is bounded (5 decisions, maxlen 4) and newest-last
+    assert snap["ring_size"] == 4 and len(snap["ring"]) == 4
+    last = snap["ring"][-1]
+    assert last["path"] == PATH_OWNER and last["status"] == "over_limit"
+    assert last["staleness_ms"] == 7 and last["ts_ms"] > 0
+    assert (last["key_hi"], last["key_lo"]) != (0, 0)
+    # metric children: the decisions counter AND the provenance-labeled
+    # over_limit_counter child both landed
+    text = m.render().decode()
+    assert (
+        'gubernator_admission_decisions{path="owner",status="under_limit"} 3.0'
+        in text
+    )
+    assert (
+        'gubernator_admission_decisions{path="owner",status="over_limit"} 2.0'
+        in text
+    )
+    assert 'gubernator_over_limit_counter{path="owner"} 2.0' in text
+
+
+def test_recorder_columnar_masked_sums_and_sample():
+    m = Metrics()
+    rec = DecisionRecorder(m, ring_size=8)
+    statuses = np.array([0, 1, 0, 1, 1, 0])
+    remaining = np.array([9, 0, 8, 0, 0, 7])
+    mask = np.array([True, True, True, True, False, False])
+    rec.record_columnar(
+        "fastpath", statuses, remaining, mask=mask,
+        sample_key=lambda i: f"adm_k{i}",
+    )
+    assert rec.snapshot()["decisions"] == {
+        "fastpath:over_limit": 2,
+        "fastpath:under_limit": 2,
+    }
+    # ONE sample row per call: the last served lane (index 3)
+    ring = rec.snapshot()["ring"]
+    assert len(ring) == 1
+    assert ring[0]["status"] == "over_limit" and ring[0]["remaining"] == 0
+    # empty mask: no counts, no ring growth
+    rec.record_columnar("fastpath", statuses, remaining, mask=np.zeros(6, bool))
+    assert len(rec.snapshot()["ring"]) == 1
+
+
+# ---- engine window accounting ----------------------------------------------
+
+
+@pytest.fixture
+def engine():
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.002),
+        now_fn=lambda: clock["now"],
+    )
+    eng._test_clock = clock
+    yield eng
+    eng.close()
+
+
+def test_admission_snapshot_contents(engine):
+    engine.check_batch([mk(f"k{i}", limit=10, hits=3) for i in range(16)])
+    snap = engine.admission_snapshot(max_age_s=0)
+    assert snap["v"] == 1
+    assert snap["keys"] == 16
+    assert snap["admitted_hits"] == 16 * 3
+    assert snap["limit_hits"] == 16 * 10
+    # kernels never over-admit a single table: excess is identically 0
+    assert snap["excess_hits"] == 0 and snap["excess_keys"] == 0
+    assert snap["max_excess"] == 0
+    assert snap["excess_ratio"] == 0.0
+    # the hist bins only keys WITH excess — all-zero here, 32 log2 bins
+    assert len(snap["excess_hist"]) == 32
+    assert sum(snap["excess_hist"]) == 0
+    assert set(snap["tiers"]) == {"device"}
+    # refused hits don't count as admitted: an over-ask changes nothing
+    engine.check_batch([mk("k0", limit=10, hits=100)])
+    snap = engine.admission_snapshot(max_age_s=0)
+    assert snap["admitted_hits"] == 16 * 3  # unchanged — refusal admits 0
+    # exact drain, then one more hit: the at-limit refusal flips the
+    # sticky stored OVER_LIMIT status while admitted stays == limit —
+    # the scan proves the key was refused, not over-admitted
+    engine.check_batch([mk("k0", limit=10, hits=7)])
+    engine.check_batch([mk("k0", limit=10, hits=1)])
+    snap = engine.admission_snapshot(max_age_s=0)
+    assert snap["admitted_hits"] == 16 * 3 + 7
+    assert snap["over_limit_keys"] == 1
+    assert snap["excess_hits"] == 0  # still zero: refusal is not excess
+
+
+def test_admission_ttl_cache_identity(engine):
+    engine.check_batch([mk(f"t{i}") for i in range(4)])
+    a = engine.admission_snapshot()
+    assert engine.admission_snapshot() is a  # inside TTL: cached object
+    b = engine.admission_snapshot(max_age_s=0)  # forced fresh
+    assert b is not a
+    assert engine.admission_snapshot() is b  # fresh scan repopulated cache
+
+
+def test_admission_sees_expiry(engine):
+    engine.check_batch([mk(f"e{i}", duration=1_000, hits=2) for i in range(8)])
+    assert engine.admission_snapshot(max_age_s=0)["keys"] == 8
+    engine._test_clock["now"] = NOW + 3_600_000
+    snap = engine.admission_snapshot(max_age_s=0)
+    # expired windows are inactive: they admitted nothing CURRENT
+    assert snap["keys"] == 0 and snap["admitted_hits"] == 0
+
+
+def test_admission_scrape_under_load_never_compiles(engine):
+    """The acceptance pin: serving traffic while /metrics +
+    /debug/admission consumers force admission scans keeps cold
+    compiles at ZERO (warmup compiled the admission program)."""
+    m = Metrics()
+    m.add_sync(engine_sync(engine))
+    engine.check_batch([mk(f"w{i}") for i in range(50)])
+    for i in range(5):
+        engine.check_batch([mk(f"l{i}_{j}") for j in range(20)])
+        snap = engine.admission_snapshot(max_age_s=0)  # forced cold
+        assert snap["keys"] > 0
+        m.render()  # /metrics path incl. the admission-excess histogram
+    assert engine.metrics.cold_compiles == 0
+    text = m.render().decode()
+    assert "gubernator_admission_excess_hits" in text
+
+
+# ---- serving paths end-to-end ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def prov_cluster(loop_thread):
+    """Two daemons with every provenance-bearing subsystem on: leases,
+    GLOBAL sync, degraded-local fallback, and GUBER_STAGE_METADATA (so
+    the path stamp rides response metadata, not just the counters)."""
+    behaviors = BehaviorConfig(
+        leases=True, lease_ttl_s=5.0, lease_fraction=0.1,
+        lease_sweep_interval_s=0.1, owner_unreachable="local",
+        global_sync_wait_s=0.1,
+    )
+    c = Cluster()
+    for _ in range(2):
+        c.daemons.append(
+            loop_thread.run(
+                Daemon.spawn(
+                    DaemonConfig(
+                        cache_size=4096,
+                        behaviors=behaviors,
+                        stage_metadata=True,
+                        admission_ttl_s=0.2,
+                    )
+                ),
+                timeout=120,
+            )
+        )
+    c.rewire()
+    yield c
+    loop_thread.run(c.stop(), timeout=60)
+
+
+def _owned_key(c, owner, prefix="pk"):
+    """A unique_key whose (name='adm', key) hashes to `owner`."""
+    for i in range(4000):
+        if c.find_owning_daemon("adm", f"{prefix}{i}") is owner:
+            return f"{prefix}{i}"
+    raise AssertionError("no owned key found")
+
+
+def test_owner_path_stamp(prov_cluster, loop_thread):
+    owner = prov_cluster.daemons[0]
+    key = _owned_key(prov_cluster, owner, "own")
+    [rl] = loop_thread.run(owner.svc.get_rate_limits([mk(key)]))
+    assert rl.error == ""
+    assert rl.metadata[DECISION_PATH_MD_KEY] == PATH_OWNER
+    assert rl.metadata[DECISION_STALENESS_MD_KEY] == "0"  # authoritative
+    dec = owner.svc.admission_debug_info(include_ring=False)["decisions"]
+    assert dec.get("owner:under_limit", 0) >= 1
+
+
+def test_forwarded_path_stamp(prov_cluster, loop_thread):
+    owner, edge = prov_cluster.daemons
+    key = _owned_key(prov_cluster, owner, "fwd")
+    [rl] = loop_thread.run(edge.svc.get_rate_limits([mk(key)]))
+    assert rl.error == ""
+    # the owner's engine answered — authoritative, but the EDGE's path
+    # stamp wins: the client asked the edge
+    assert rl.metadata[DECISION_PATH_MD_KEY] == PATH_FORWARDED
+    assert rl.metadata[DECISION_STALENESS_MD_KEY] == "0"
+    dec = edge.svc.admission_debug_info(include_ring=False)["decisions"]
+    assert dec.get("forwarded:under_limit", 0) >= 1
+
+
+def test_replica_path_stamp(prov_cluster, loop_thread):
+    owner, edge = prov_cluster.daemons
+    key = _owned_key(prov_cluster, owner, "rep")
+    req = mk(key, behavior=int(Behavior.GLOBAL))
+    [rl] = loop_thread.run(edge.svc.get_rate_limits([req]))
+    assert rl.error == ""
+    assert rl.metadata[DECISION_PATH_MD_KEY] == PATH_REPLICA
+    dec = edge.svc.admission_debug_info(include_ring=False)["decisions"]
+    assert dec.get("replica:under_limit", 0) >= 1
+    ring = edge.svc.recorder.snapshot()["ring"]
+    assert any(e["path"] == PATH_REPLICA for e in ring)
+
+
+def test_degraded_local_path_stamp(prov_cluster, loop_thread):
+    owner, edge = prov_cluster.daemons
+    key = _owned_key(prov_cluster, owner, "deg")
+    req = mk(key)
+    peer = edge.svc.forwarder.get(req.hash_key())
+    before = edge.svc.admission_debug_info(include_ring=False)[
+        "decisions"
+    ].get("degraded_local:under_limit", 0)
+    rl = loop_thread.run(edge.svc.forwarder._owner_unreachable(peer, req))
+    assert rl.error == ""
+    assert rl.metadata["degraded"] == "owner-unreachable"
+    assert rl.metadata[DECISION_PATH_MD_KEY] == PATH_DEGRADED_LOCAL
+    # the owner is unreachable: the staleness bound is unknowable and
+    # must be OMITTED, never fabricated
+    assert DECISION_STALENESS_MD_KEY not in rl.metadata
+    after = edge.svc.admission_debug_info(include_ring=False)[
+        "decisions"
+    ].get("degraded_local:under_limit", 0)
+    assert after == before + 1
+
+
+def test_lease_path_stamp(prov_cluster, loop_thread):
+    from gubernator_tpu.client import GubernatorClient
+    from gubernator_tpu.parallel.leases import LEASE_STALENESS_MD_KEY
+
+    owner, edge = prov_cluster.daemons
+    key = _owned_key(prov_cluster, owner, "lea")
+    req = mk(key, limit=1000)
+
+    async def scenario():
+        c = GubernatorClient(edge.grpc_address, leases=True)
+        try:
+            await c.get_rate_limits([req])
+            for _ in range(100):
+                if c.lease_cache._entries:
+                    break
+                await asyncio.sleep(0.05)
+                await c.get_rate_limits([req])
+            assert c.lease_cache._entries, "client never obtained a lease"
+            [rl] = await c.get_rate_limits([req])
+            return rl
+        finally:
+            await c.close()
+
+    rl = loop_thread.run(scenario(), timeout=60)
+    assert rl.error == ""
+    # lease answers ALWAYS stamp (not gated on stage_metadata): staleness
+    # is the honesty contract of client-side enforcement
+    assert rl.metadata[DECISION_PATH_MD_KEY] == PATH_LEASE
+    assert DECISION_STALENESS_MD_KEY in rl.metadata
+    assert LEASE_STALENESS_MD_KEY in rl.metadata
+
+
+def test_debug_admission_endpoint_and_metrics(prov_cluster):
+    d = prov_cluster.daemons[0]
+    blob = requests.get(
+        f"http://{d.http_address}/debug/admission", timeout=5
+    ).json()
+    assert blob["v"] == 1
+    assert set(blob["bound"]) >= {"total_hits"}
+    assert blob["ring_size"] >= 1 and isinstance(blob["ring"], list)
+    for entry in blob["ring"]:
+        assert entry["path"] in PATHS
+        assert entry["status"] in ("under_limit", "over_limit", "error")
+    w = blob["window"]
+    assert w["admitted_hits"] >= 0 and w["limit_hits"] >= 0
+    assert len(w["excess_hist"]) == 32
+    # the same blob (sans ring) rides DebugInfo into /debug/cluster
+    info = d.svc.local_debug_info()
+    assert "admission" in info and "ring" not in info["admission"]
+    # and the families are on /metrics
+    text = requests.get(
+        f"http://{d.http_address}/metrics", timeout=5
+    ).text
+    assert "gubernator_admission_decisions{" in text
+    assert "gubernator_admission_excess_ratio" in text
+
+
+# ---- columnar fastpath ------------------------------------------------------
+
+
+def test_fastpath_columnar_provenance():
+    from gubernator_tpu import wire
+    from gubernator_tpu.service import fastpath, pb
+
+    if not wire.available():
+        pytest.skip("native wirepath unavailable")
+
+    clock = {"now": NOW}
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 8, batch_size=64, batch_wait_s=0.001),
+        now_fn=lambda: clock["now"],
+    )
+    rec = DecisionRecorder(Metrics(), ring_size=8)
+    svc = SimpleNamespace(
+        engine=eng, picker=None, region_mgr=None, global_mgr=None,
+        fast_edge=True, recorder=rec,
+    )
+    try:
+        batch = [mk(f"fpk{i}", limit=3, hits=2) for i in range(6)]
+        msg = pb.pb.GetRateLimitsReq()
+        for r in batch:
+            msg.requests.append(pb.req_to_pb(r))
+        raw = fastpath.try_serve(svc, msg.SerializeToString(), False)
+        assert isinstance(raw, bytes)  # whole batch served columnar
+        snap = rec.snapshot()
+        assert snap["decisions"] == {"fastpath:under_limit": 6}
+        assert snap["ring"][-1]["path"] == "fastpath"
+        # second round drives every key over: the over_limit split lands
+        raw = fastpath.try_serve(svc, msg.SerializeToString(), False)
+        assert isinstance(raw, bytes)
+        assert rec.snapshot()["decisions"] == {
+            "fastpath:under_limit": 6,
+            "fastpath:over_limit": 6,
+        }
+        # peer calls are exempt — no double counting across the mesh
+        before = dict(rec.snapshot()["decisions"])
+        fastpath.try_serve(svc, msg.SerializeToString(), True)
+        assert rec.snapshot()["decisions"] == before
+    finally:
+        eng.close()

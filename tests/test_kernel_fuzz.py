@@ -460,6 +460,200 @@ def test_paged_nonresident_probe_safe(layout):
     assert int(out.remaining[0]) == 8
 
 
+# ---------------------------------------------------------------------------
+# Admission accounting (ops/admission.py): the jitted scan must be a
+# bit-exact twin of the numpy oracle over the same table state — every
+# layout, fuzz-built tables at several expiry horizons, injected debt
+# (negative remaining, the only state that can show excess), and the
+# paged table's device-frames + host-tier split (the engine's own
+# decomposition in _admission_scan).
+# ---------------------------------------------------------------------------
+
+from gubernator_tpu.ops.admission import admission_oracle, make_admission  # noqa: E402
+from gubernator_tpu.ops.kernels import get_raw_kernels  # noqa: E402
+from gubernator_tpu.ops.layout import SlotTable  # noqa: E402
+
+_ADMISSION_SUMS = (
+    "keys", "admitted_sum", "limit_sum", "excess_sum",
+    "excess_keys", "over_limit_keys",
+)
+
+
+def _admission_assert(out, want, ctx):
+    for f in _ADMISSION_SUMS + ("max_excess",):
+        assert int(np.asarray(getattr(out, f))) == int(want[f]), (f, ctx)
+    got_hist = np.asarray(out.excess_hist).tolist()
+    assert got_hist == np.asarray(want["excess_hist"]).tolist(), ctx
+
+
+def _fuzz_table(layout, seed):
+    """Final table state after a fuzz sequence, plus the last `now`."""
+    import dataclasses
+
+    import jax
+
+    K = get_kernels(layout)
+    seq = _fuzz_reqs(seed)
+    batches = [
+        encode_batch([dataclasses.replace(r)], now, NUM_GROUPS, 1)
+        for r, now in seq
+    ]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    nows = np.array([now for _, now in seq], dtype=np.int64)
+    table, _ = K.decide_scan(K.create(NUM_GROUPS, WAYS), stacked, nows, WAYS, False)
+    return table, int(nows[-1])
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+@pytest.mark.parametrize("seed", [21, 22])
+def test_admission_bitexact_fuzz(seed, layout):
+    """Device scan == oracle on a fuzz-built table, at `now` horizons
+    that slide the active set from everything to nothing (the
+    expire_at > now filter is part of the contract)."""
+    table, last = _fuzz_table(layout, seed)
+    RK = get_raw_kernels(layout)
+    prog = make_admission(layout, WAYS)
+    for now in (NOW, last, last + 61_000, last + 10**9):
+        out = prog(table, now)
+        want = admission_oracle(RK.to_wide(table), now)
+        _admission_assert(out, want, (layout, seed, now))
+    # the far horizon really deactivated everything
+    assert int(np.asarray(prog(table, last + 10**9).keys)) == 0
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_admission_bitexact_injected_debt(layout):
+    """Excess accounting: kernels never drive `remaining` negative, so
+    debt (reconciled/injected state) is planted through the layout's
+    from_wide. Token slots carry raw hit debt, leaky slots Q44.20 —
+    the scan must agree with the oracle on sums, max, and histogram."""
+    table, last = _fuzz_table(layout, 21)
+    RK = get_raw_kernels(layout)
+    wide = RK.to_wide(table)
+    w = {f: np.asarray(getattr(wide, f)).copy() for f in SlotTable._fields}
+    rng = np.random.default_rng(7)
+    idx = np.flatnonzero(w["used"] & (w["limit"] > 0))
+    assert idx.size >= 8, "fuzz table too sparse for debt injection"
+    pick = rng.choice(idx, size=8, replace=False)
+    debt = rng.integers(1, 1 << 20, size=8).astype(np.int64)
+    w["remaining"][pick] = np.where(
+        w["algo"][pick] == 1, -(debt << 20), -debt
+    )
+    # keep the debtors in the current window — expired debt is invisible
+    # to the scan by design
+    w["expire_at"][pick] = last + 100_000
+    injected = RK.from_wide(SlotTable(**w))
+    # the layout must round-trip negative remaining losslessly
+    assert (
+        np.asarray(RK.to_wide(injected).remaining)[pick]
+        == w["remaining"][pick]
+    ).all(), f"{layout}: from_wide lost injected debt"
+    out = make_admission(layout, WAYS)(injected, last)
+    want = admission_oracle(SlotTable(**w), last)
+    assert want["excess_sum"] >= int(debt.sum()), "injection had no effect"
+    assert sum(want["excess_hist"][1:]) == 8
+    _admission_assert(out, want, (layout, "debt"))
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_admission_paged_tiers_bitexact(layout):
+    """The engine's paged split: admission-scan the resident physical
+    frames on device, oracle the demoted host pages, and the combined
+    tiers must equal the flat twin's totals bit-for-bit (each key lives
+    in exactly one tier)."""
+    import dataclasses
+
+    import jax
+
+    from gubernator_tpu.ops.kernels import get_paged_kernels
+
+    K = get_kernels(layout)
+    RK = get_raw_kernels(layout)
+    PK = get_paged_kernels(layout, NUM_GROUPS, WAYS, GROUPS_PER_PAGE, 4)
+    pt = PK.create()
+    flat = K.create(NUM_GROUPS, WAYS)
+
+    host_tier = {}
+    resident = {}
+    free = list(range(PK.num_phys_pages))
+    lru = {}
+    seq = _fuzz_reqs(31, n=160)
+    # Long-window tail: the fuzz clock jumps past every short duration,
+    # so without these the active set at `last` is empty and the
+    # additivity check would be vacuous.
+    tail_now = seq[-1][1]
+    seq += [
+        (
+            RateLimitReq(
+                name="rl_tail", unique_key=f"acct:{i}",
+                duration=600_000, limit=100, hits=3,
+            ),
+            tail_now,
+        )
+        for i in range(16)
+    ]
+    for i, (r, now) in enumerate(seq):
+        b = encode_batch([dataclasses.replace(r)], now, NUM_GROUPS, 1)
+        lp = int(b.group[0]) // GROUPS_PER_PAGE
+        if lp not in resident:
+            if free:
+                pp = free.pop()
+            else:
+                victim = min(resident, key=lambda p: lru[p])
+                pp = resident.pop(victim)
+                host_tier[victim] = jax.tree.map(
+                    np.asarray, PK.extract_page(pt, np.int32(pp))
+                )
+                pt = PK.unbind_page(pt, np.int32(victim), np.int32(pp))
+            if lp in host_tier:
+                pt = PK.write_page(
+                    pt, np.int32(lp), np.int32(pp), host_tier.pop(lp)
+                )
+            else:
+                pt = PK.bind_page(pt, np.int32(lp), np.int32(pp))
+            resident[lp] = pp
+        lru[lp] = i
+        flat, _ = K.decide(flat, b, now, WAYS, False)
+        pt, _ = PK.decide(pt, b, now, WAYS, False)
+    last = seq[-1][1]
+    assert host_tier, "churn never demoted a page; shrink the frame count"
+
+    # Device tier: the jitted scan over the resident frames (repacked
+    # through from_wide, the same raw-layout view the engine scans).
+    frames_wide = PK.to_wide(pt)
+    frames = RK.from_wide(
+        jax.tree.map(lambda x: np.asarray(x), frames_wide)
+    )
+    dev = make_admission(layout, WAYS)(frames, last)
+    dev_want = admission_oracle(frames_wide, last)
+    _admission_assert(dev, dev_want, (layout, "frames"))
+
+    # Host tier: oracle over the concatenated demoted rows.
+    lps = sorted(host_tier)
+    host_wide = SlotTable(
+        **{
+            f: np.concatenate(
+                [np.asarray(getattr(host_tier[lp], f)) for lp in lps]
+            )
+            for f in SlotTable._fields
+        }
+    )
+    host_want = admission_oracle(host_wide, last)
+
+    # Tier additivity == the flat twin's truth.
+    flat_want = admission_oracle(RK.to_wide(flat), last)
+    for f in _ADMISSION_SUMS:
+        assert int(np.asarray(getattr(dev, f))) + host_want[f] == flat_want[f], f
+    assert max(
+        int(np.asarray(dev.max_excess)), host_want["max_excess"]
+    ) == flat_want["max_excess"]
+    combined = (
+        np.asarray(dev.excess_hist) + np.asarray(host_want["excess_hist"])
+    ).tolist()
+    assert combined == np.asarray(flat_want["excess_hist"]).tolist()
+    assert flat_want["keys"] > 0  # the comparison wasn't vacuous
+
+
 @pytest.mark.parametrize("layout", LAYOUTS)
 def test_kernel_eviction_lru(layout):
     """Group overflow evicts the least-recently-used way

@@ -201,6 +201,52 @@ def test_bench_run_gate_prints_verdict(led, capsys):
     assert bench._run_gate(Args) is True
 
 
+def test_archive_results_emits_parseable_gate_line(led, capsys):
+    """The tools/jobs contract: every job that lands a RESULT gets a
+    `GATE {json}` line appended to its .out, and that line must parse
+    back into the full gate() verdict shape — a soak artifact carries
+    its own machine-readable verdict (ISSUE 14 acceptance evidence)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools import tpu_runner
+
+    def soak_payload(value):
+        return "[job] noise\nRESULT " + json.dumps(
+            {"metric": "degraded-partition admission soak (cpu, 3-daemon "
+                       "paged mesh, 48 keys) checks/s",
+             "value": value, "unit": "checks/s", "vs_baseline": None}
+        ) + "\n"
+
+    gate_txt = tpu_runner._archive_results(
+        "38_admission_soak", soak_payload(144.1)
+    )
+    assert gate_txt.startswith("GATE ")
+    assert not gate_txt.startswith("GATE ERROR")
+    verdict = json.loads(gate_txt[len("GATE "):].strip())
+    assert set(verdict) >= {
+        "ok", "reason", "threshold", "current", "best",
+        "throughput_ratio", "p99_ratio",
+    }
+    assert verdict["ok"] is True  # first run gates vacuously
+    rec = led.load()[-1]
+    # mode inference keyed the row so the NEXT run gates against it
+    assert rec["job"] == "38_admission_soak"
+    assert rec["mode"] == "admission_soak"
+    assert rec["platform"] == "cpu"
+
+    # a regressed second run gates non-vacuously, still parseable
+    gate_txt = tpu_runner._archive_results(
+        "38_admission_soak", soak_payload(100.0)
+    )
+    verdict = json.loads(gate_txt[len("GATE "):].strip())
+    assert verdict["ok"] is False
+    assert "throughput regression" in verdict["reason"]
+    assert verdict["best"]["value"] == 144.1
+
+    # a payload with no RESULT line archives nothing and emits no GATE
+    assert tpu_runner._archive_results("38_admission_soak", "noise\n") == ""
+
+
 def test_runner_watchdog_abandons_hung_job(tmp_path):
     """A job that never returns must not freeze the queue: the watchdog
     writes a timeout marker and the next job still runs (round-3 failure

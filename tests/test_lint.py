@@ -65,7 +65,7 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-        "GL007", "GL008", "GL009", "GL010", "GL011",
+        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
     }
 
 
@@ -161,6 +161,13 @@ _CASES = [
         {"'subscript_attr_chain'", "'subscript_bare_name'",
          "'asarray_pull'"},
         3,  # pragma'd + batch-struct (ib./wb./cols.) sites don't fire
+    ),
+    (
+        "GL012",
+        fixture("service", "gl012_provenance.py"),
+        {"'serve_unstamped'", "'serve_unstamped_over'"},
+        3,  # 2 unstamped answers + 1 reason-less pragma; error=/stamped/
+            # recorded/reasoned-pragma sites don't fire
     ),
 ]
 

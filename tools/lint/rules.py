@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL010.
+"""guberlint rule set GL000-GL012.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -960,6 +960,103 @@ class GL011RawTableIndex(Rule):
                     f"paged addressing layer (ops/paged.py) or the "
                     f"census view",
                     f"raw-table:{field}:{fn}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL012 — rate-limit answers constructed without decision provenance.
+
+_PROVENANCE_SCOPES = ("gubernator_tpu/service/",)
+_PROVENANCE_FILES = (
+    "gubernator_tpu/parallel/leases.py",
+    "gubernator_tpu/parallel/peers.py",
+)
+
+# A function that calls any of these is considered provenance-aware:
+# stamp_decision writes the decision_path metadata,
+# record_decision/record_columnar feed the counters + flight recorder
+# (service/admission.py).
+_STAMP_CALLS = ("stamp_decision", "record_decision", "record_columnar")
+
+
+class GL012DecisionProvenance(Rule):
+    code = "GL012"
+    name = "decision-provenance"
+    description = (
+        "a RateLimitResp constructed on a serving path without an "
+        "error= kwarg is an ANSWER, and every answer must name the "
+        "path that produced it (docs/monitoring.md \"Admission\"): the "
+        "enclosing function must call stamp_decision / record_decision "
+        "/ record_columnar (service/admission.py), or carry an "
+        "allow-decision-provenance pragma with a reason"
+    )
+    requires_reason = True
+
+    def _is_resp_ctor(self, node: ast.AST) -> bool:
+        """A call to the bare name RateLimitResp. Attribute forms
+        (pb.RateLimitResp) are the WIRE message class — serialization,
+        not a decision — and stay out of scope."""
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "RateLimitResp"
+        )
+
+    def _has_error_kwarg(self, node: ast.Call) -> bool:
+        return any(kw.arg == "error" for kw in node.keywords)
+
+    def _stamps(self, fn: Optional[ast.AST]) -> bool:
+        if fn is None:
+            return False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = (
+                f.id
+                if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None
+            )
+            if name in _STAMP_CALLS:
+                return True
+        return False
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        rel = scan_path(mod.relpath)
+        if not (
+            rel.startswith(_PROVENANCE_SCOPES) or rel in _PROVENANCE_FILES
+        ):
+            return []
+        if rel == "gubernator_tpu/service/admission.py":
+            return []  # the provenance module itself
+        out = []
+        for node, stack in walk_scoped(mod.tree):
+            if not self._is_resp_ctor(node):
+                continue
+            if self._has_error_kwarg(node):
+                # Error answers carry their provenance in the error
+                # string itself; status/remaining are meaningless.
+                continue
+            enclosing = None
+            for s in reversed(stack):
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing = s
+                    break
+            if self._stamps(enclosing):
+                continue
+            fn = func_name(stack)
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"'{fn}' constructs a RateLimitResp answer without "
+                    f"decision provenance — call stamp_decision / "
+                    f"record_decision (service/admission.py) in this "
+                    f"function, or carry an allow-decision-provenance "
+                    f"pragma with a reason",
+                    f"provenance:{fn}",
                 )
             )
         return out
