@@ -344,6 +344,8 @@ def _try_runner_relay(args, timeout_s: float = 2400.0):
         "    r = bench.bench_edge()\n"
         "elif args.mode == 'ab':\n"
         "    r = bench.bench_ab(cand=args.layout)\n"
+        "elif args.mode == 'mesh_ab':\n"
+        "    r = bench.bench_mesh_ab()\n"
         "else:\n"
         "    r = bench.bench_kernel(args.mode, args.layout)\n"
         "print('RESULT ' + json.dumps(r))\n"
@@ -1030,7 +1032,7 @@ def main() -> None:
     parser.add_argument(
         "--mode", default="kernel",
         choices=("kernel", "engine", "engine_ab", "server", "global",
-                 "kernel10m", "latency", "ici", "edge", "ab"),
+                 "kernel10m", "latency", "ici", "edge", "ab", "mesh_ab"),
         help="kernel: device decide throughput @1M keys (headline); "
         "engine: end-to-end host+device serving path; "
         "engine_ab: serial (depth 1) vs pipelined (depth 2) engine A/B, "
@@ -1043,7 +1045,9 @@ def main() -> None:
         "ici: multi-device tier — replica GLOBAL decide throughput + "
         "sync tick device time vs table size; "
         "ab: --layout vs fused decide-throughput A/B at the 2M- and "
-        "16M-slot geometries, comparison rows ledgered",
+        "16M-slot geometries, comparison rows ledgered; "
+        "mesh_ab: single-chip vs mesh unified-core A/B (fresh process "
+        "per cell), comparison row ledgered",
     )
     parser.add_argument(
         "--layout", default=None,
@@ -1144,6 +1148,9 @@ def main() -> None:
         return
     if args.mode == "ab":
         emit(bench_ab(cand=args.layout))
+        return
+    if args.mode == "mesh_ab":
+        emit(bench_mesh_ab())
         return
     emit(bench_kernel(args.mode, args.layout))
 
@@ -1484,6 +1491,173 @@ def bench_engine_ab(depths=(1, 2)) -> dict:
         "vs_baseline": round(ratio, 3),
     }
     ledger.append(row, job="bench_engine_ab", mode="engine_ab", layout="")
+    print("RESULT " + json.dumps(row), flush=True)
+    return row
+
+
+def bench_mesh(n_dev: int = 1) -> dict:
+    """Unified-core throughput at one mesh width: the SAME seeded trace
+    as bench_engine through MeshEngine at shape (1,) (n_dev=1 — the
+    single-chip engine) or IciEngine's owner-sharded tier at (n_dev,).
+    Both cells run fast_buckets=False (the mesh cannot narrow widths
+    without a per-width SPMD recompile, so the single-chip cell must
+    not narrow either or the A/B compares bucketing, not the mesh)."""
+    import jax
+
+    from gubernator_tpu.api.types import Algorithm, RateLimitReq
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    n = max(1, min(int(n_dev), len(devs)))
+    cfg_kw = dict(
+        num_groups=1 << 15, batch_size=2048, batch_limit=2048,
+        batch_wait_s=200e-6, max_flush_items=1 << 14,
+        keep_key_strings=False,
+    )
+    if n == 1:
+        from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+        eng = DeviceEngine(EngineConfig(fast_buckets=False, **cfg_kw))
+    else:
+        from gubernator_tpu.runtime.ici_engine import (
+            IciEngine,
+            IciEngineConfig,
+        )
+
+        eng = IciEngine(
+            IciEngineConfig(
+                devices=devs[:n], num_slots=1 << 14,
+                sync_wait_s=3600.0,  # non-GLOBAL trace: no tick noise
+                **cfg_kw,
+            )
+        )
+    rng = np.random.default_rng(3)
+    n_keys = 10_000
+    reqs = [
+        RateLimitReq(
+            name="bench", unique_key=f"acct:{i}",
+            algorithm=Algorithm.LEAKY_BUCKET if i % 4 == 0 else Algorithm.TOKEN_BUCKET,
+            duration=60_000, limit=100_000, hits=1,
+        )
+        for i in rng.integers(0, n_keys, 40_000)
+    ]
+    eng.check_batch(reqs[:2048])  # warm the full-width program
+    t0 = time.perf_counter()
+    futs = [
+        eng.check_bulk(reqs[i : i + 1000]) for i in range(0, len(reqs), 1000)
+    ]
+    for f in futs:
+        f.result()
+    dt = time.perf_counter() - t0
+    tput = len(reqs) / dt
+    telemetry = _engine_telemetry(eng)
+    eng.close()
+    fake = (
+        ", XLA host-platform FAKED devices (threads on one CPU, no ICI)"
+        if platform == "cpu" and n > 1
+        else ""
+    )
+    return {
+        "metric": (
+            f"unified-core engine decisions/sec at mesh width {n} "
+            f"({platform}, cores={os.cpu_count()}{fake}, 10k keys, "
+            f"host assembly incl., fast_buckets=off)"
+        ),
+        "value": round(tput, 0),
+        "unit": "decisions/s",
+        "vs_baseline": round(tput / 4000.0, 1),
+        "n_dev": n,
+        "telemetry": telemetry,
+    }
+
+
+def _bench_mesh_fresh(n_dev: int) -> dict:
+    """bench_mesh at one mesh width in a FRESH interpreter with the
+    device count forced to exactly n_dev (same contamination argument as
+    _bench_engine_fresh, plus: the single-chip cell must not even SEE
+    the faked 8-device topology). Falls back in-process on failure."""
+    import re as _re
+    import subprocess
+    import sys
+
+    script = (
+        "import json\n"
+        "import bench\n"
+        f"r = bench.bench_mesh(n_dev={int(n_dev)})\n"
+        "print('RESULT ' + json.dumps(r))\n"
+    )
+    env = dict(os.environ)
+    flags = _re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={int(n_dev)}"
+    ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("RESULT "):
+                return json.loads(line[len("RESULT "):])
+        print(f"[bench] fresh-process mesh n_dev={n_dev} gave no RESULT "
+              f"(rc={proc.returncode}); falling back in-process", flush=True)
+    except Exception as e:
+        print(f"[bench] fresh-process mesh n_dev={n_dev} failed ({e!r}); "
+              f"falling back in-process", flush=True)
+    return bench_mesh(n_dev)
+
+
+def bench_mesh_ab(widths=None) -> dict:
+    """Single-chip vs mesh A/B on the unified core: the same trace
+    through mesh width 1 and width N, each in a fresh process on CPU
+    (forced to exactly that device count), raw rows + one comparison
+    row ledgered. On CPU the N "devices" are XLA host-platform fakes —
+    threads on one CPU sharing its cores — so the ratio measures the
+    SPMD partition + collective-dispatch overhead of the sharded tier,
+    NOT scaling; tools/jobs/39_mesh_scaling.py runs the same cells on
+    real chips where decisions/s vs width is the point."""
+    import jax
+
+    from gubernator_tpu.utils import ledger
+
+    platform = jax.devices()[0].platform
+    if widths is None:
+        widths = (1, 8 if platform == "cpu" else len(jax.devices()))
+    cells = {}
+    for n in widths:
+        if platform == "cpu":
+            r = _bench_mesh_fresh(n)
+        else:
+            # A TPU is exclusively held by THIS process (see bench_ab).
+            r = bench_mesh(n)
+        ledger.append(r, job=f"bench_mesh_ab_n{n}", mode="mesh", layout="")
+        print("RESULT " + json.dumps(r), flush=True)
+        cells[n] = r
+    base, cand = widths[0], widths[-1]
+    ratio = float(cells[cand]["value"]) / max(float(cells[base]["value"]), 1.0)
+    note = ""
+    if platform == "cpu":
+        note = (
+            "; CPU cells use FAKED devices — ratio is SPMD overhead, "
+            "not scaling (job 39 measures real chips)"
+        )
+    row = {
+        "metric": (
+            f"mesh/single-chip engine decisions/s A/B ({platform}, "
+            f"cores={os.cpu_count()}, width {cand} vs {base}); "
+            f"single={cells[base]['value']:.0f} "
+            f"mesh={cells[cand]['value']:.0f} decisions/s{note}"
+        ),
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ratio, 3),
+    }
+    ledger.append(row, job="bench_mesh_ab", mode="mesh_ab", layout="")
     print("RESULT " + json.dumps(row), flush=True)
     return row
 

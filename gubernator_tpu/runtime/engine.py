@@ -1,10 +1,15 @@
-"""Device engine: micro-batch assembly + the TPU-resident counter table.
+"""Unified mesh engine: micro-batch assembly + the TPU-resident counter
+table, ONE core parameterized by mesh shape (runtime/topology.py).
 
 This is the TPU-native replacement for the reference's entire execution
 engine (reference workers.go:54-626): instead of sharding the key space
 across single-threaded goroutine workers with channel hops, requests
 accumulate into fixed-shape device batches and one jitted decide() call
-updates the HBM slot table in place.
+updates the HBM slot table in place. At mesh shape ``(1,)`` that table
+lives on one chip (DeviceEngine); at ``(chips,)`` it shards across the
+mesh under shard_map with psum-merged outputs, plus a per-device GLOBAL
+replica tier (IciEngine, runtime/ici_engine.py) — same core, same wave
+assembler, same pipeline, different strategy object.
 
 The micro-batching policy transfers directly from the reference's peer
 batching (reference peer_client.go:284-337; config.go:126-128): flush at
@@ -50,6 +55,7 @@ from gubernator_tpu.ops.kernels import (
     get_paged_kernels,
 )
 from gubernator_tpu.runtime import telemetry as _telemetry
+from gubernator_tpu.runtime.topology import SingleChipTopology
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
 from gubernator_tpu.utils import transfer as _transfer
@@ -1267,8 +1273,17 @@ def _admission_combine(tiers: Dict[str, dict]) -> dict:
     return snap
 
 
-class DeviceEngine(EngineBase):
-    """Owns the device slot table; turns request streams into decisions.
+class MeshEngine(EngineBase):
+    """Owns the slot table; turns request streams into decisions.
+
+    ONE engine core, parameterized by mesh shape (runtime/topology.py):
+    the strategy object binds the kernels (plain jits at mesh shape
+    ``(1,)``, shard_map ownership programs at ``(chips,)``), decides
+    whether a Pager manages page residency behind them, builds the
+    GLOBAL replica tier where a mesh exists, and supplies the
+    collective-dispatch guard. Everything else — pump, pipeline ring,
+    ticket lifecycle, failure recovery, drain, snapshots, census /
+    admission caching, flush telemetry — lives here exactly once.
 
     Thread model: callers (any thread / asyncio executor) enqueue
     (request, Future) pairs; one pump thread drains the queue, assembles
@@ -1278,13 +1293,19 @@ class DeviceEngine(EngineBase):
     with one writer for the whole table.
     """
 
+    # V1Service/fastpath read this to decide whether GLOBAL traffic can
+    # be answered locally; the ICI subclass (replica tier) flips it.
+    routes_global_internally = False
+
     def __init__(
         self,
         config: EngineConfig = EngineConfig(),
         now_fn: Callable[[], int] = _clock.now_ms,
+        topology=None,
     ):
         self.cfg = config
         self.now_fn = now_fn
+        self.topo = topology if topology is not None else SingleChipTopology()
         self.metrics = EngineMetrics()
         self.store = None  # optional Store plugin (gubernator_tpu.store)
         self._key_strings: Dict[Tuple[int, int], str] = {}
@@ -1294,47 +1315,34 @@ class DeviceEngine(EngineBase):
 
         if config.max_waves < 1:
             raise ValueError("max_waves must be >= 1")
-        dev = config.device
+        dev = getattr(config, "device", None)
 
-        # Paged table (docs/architecture.md "Paged table"): the kernel
-        # facade swaps to the paged addressing layer and the PHYSICAL
-        # table shrinks to the resident-page budget; the Pager tracks
-        # residency and owns the host-DRAM cold tier.
-        self._pager = None
-        pg = int(getattr(config, "page_groups", 0) or 0)
-        if pg > 0:
-            budget = int(getattr(config, "page_budget", 0) or 0)
-            if budget <= 0:
-                raise ValueError(
-                    "page_budget must be > 0 when page_groups > 0"
-                )
-            if pg > config.num_groups:
-                raise ValueError(
-                    f"page_groups ({pg}) exceeds num_groups "
-                    f"({config.num_groups})"
-                )
-            from gubernator_tpu.runtime.pager import Pager
+        # Kernel binding + table residency are the topology's call: the
+        # paged facade (docs/architecture.md "Paged table") swaps in the
+        # paged addressing layer — PHYSICAL table shrunk to the
+        # resident-frame budget, Pager tracking residency and the
+        # host-DRAM cold tier (one frame pool + cold tier PER SHARD on
+        # a mesh) — while flat binds the full-size table directly.
+        self.K, self._pager = self.topo.build_kernels(config, self.metrics)
+        with (
+            jax.default_device(dev) if dev is not None
+            else _nullcontext()
+        ):
+            # Every facade accepts (and the paged/mesh ones ignore) the
+            # flat geometry args, so creation is uniform across all
+            # four kernel cases.
+            self.table = self.K.create(config.num_groups, config.ways)
 
-            self.K = get_paged_kernels(
-                config.layout, config.num_groups, config.ways, pg, budget
-            )
-            with (
-                jax.default_device(dev) if dev is not None
-                else _nullcontext()
-            ):
-                self.table = self.K.create()
-            self._pager = Pager(self.K, metrics=self.metrics)
-        else:
-            self.K = get_kernels(config.layout)
-            with (
-                jax.default_device(dev) if dev is not None
-                else _nullcontext()
-            ):
-                self.table = self.K.create(config.num_groups, config.ways)
+        # GLOBAL replica tier (parallel/ici.py) — mesh topologies only.
+        self._rtier = self.topo.build_replica(config, self.metrics)
+        # Round-robin home cursor for GLOBAL replica placement; host
+        # bookkeeping shared by _dispatch and the columnar split.
+        self._home_rr = 0
 
         # Table-observatory program (ops/census.py): one jitted,
         # non-donating scan per (layout, geometry, knobs); warmed in
-        # _warmup so the first scrape never compiles.
+        # _warmup so the first scrape never compiles. On a mesh the
+        # same plain program runs over the sharded array under GSPMD.
         self._census_thresholds = tuple(
             int(k) for k in config.census_thresholds
         )
@@ -1354,7 +1362,7 @@ class DeviceEngine(EngineBase):
         self._snapshot_staging_bytes = 0
 
         self._warmup()
-        self._init_base("gubernator-tpu-engine")
+        self._init_base(self.topo.thread_name)
         # Columnar-path batch-width buckets compile in the background; the
         # fast path only uses already-warm shapes (a cold compile mid-
         # request would blow through forwarding timeouts — same reason
@@ -1364,7 +1372,7 @@ class DeviceEngine(EngineBase):
         # a shared set mid-iteration can raise in the reader).
         self._warm_shapes = (config.batch_size,)
         self._warm_thread = None
-        if config.fast_buckets:
+        if getattr(config, "fast_buckets", False):
             self._warm_thread = threading.Thread(
                 target=self._warm_buckets, name="gubernator-warm-buckets",
                 daemon=True,
@@ -1430,7 +1438,9 @@ class DeviceEngine(EngineBase):
                 if want <= 0 or len(pager.free) >= want:
                     continue
                 census = self.table_census()
-                dev = census.get("tiers", {}).get("device", census)
+                dev = census.get("tiers", {}).get(
+                    self.topo.primary_tier, census
+                )
                 cold = dev.get("cold") or []
                 cold_slots = int(cold[0]["slots"]) if cold else 0  # guberlint: allow-host-sync -- census dict is host data (TTL-cached scrape)
                 if int(dev.get("live", 0)) > 0 and cold_slots == 0:
@@ -1446,7 +1456,7 @@ class DeviceEngine(EngineBase):
                     coldness = pager.coldness_from_heatmap(
                         ch, int(dev.get("heatmap_groups_per_region", 1))
                     )
-                with self._lock:
+                with self._lock, self.topo.dispatch_guard():
                     self.table = pager.demote_victims(
                         self.table, want_free=want, min_idle_ticks=1,
                         coldness=coldness,
@@ -1567,6 +1577,23 @@ class DeviceEngine(EngineBase):
         }
         if self._pager is not None:
             subs["page_map"] = 4 * self.K.num_logical_pages
+        rt = self._rtier
+        if rt is not None:
+            # GLOBAL replica tier: per-device stacked replica tables +
+            # int64 pending deltas (parallel/ici.py IciState) plus the
+            # per-device tick scalars.
+            subs["ici_replicas"] = (
+                self.topo.n_dev * rt.num_slots * (self.K.bytes_per_slot + 8)
+                + 8 * self.topo.n_dev
+            )
+            # Second census/admission program pair over the replica tier.
+            subs["census"] += 8 * (
+                (rt.replica_ways + 1)
+                + int(cfg.census_heatmap_width)
+                + len(self._census_thresholds)
+                + 16
+            )
+            subs["admission"] += admission_b
         return subs
 
     def _warmup(self) -> None:
@@ -1578,41 +1605,62 @@ class DeviceEngine(EngineBase):
 
         now = self.now_fn()
         wb = RequestBatch.zeros(self.cfg.batch_size)
-        with _transfer.account(self.metrics, "d2h", "warmup") as tx:
-            table, out = self.K.decide(
-                self.table, wb, now, self.cfg.ways, self.store is not None
-            )
-            tx.add(np.asarray(out.status))
-            table, _, _ = self.K.inject(
-                table, InjectBatch.zeros(self.cfg.batch_size), now,
-                self.cfg.ways,
-            )
-            tx.add(np.asarray(table.used[:1]))  # guberlint: allow-raw-table-index -- warmup sync probe: any one physical row works, logical identity irrelevant
-            # Census compiles here too: the first /metrics or /debug/table
-            # scrape must dispatch a warm program, not pay a compile.
-            c = self._census(self._census_view(table), now)
-            tx.add(np.asarray(c.live))  # guberlint: allow-host-sync -- warmup: compile the census program before serving
-            # Admission accounting likewise: the first /debug/admission
-            # scrape or auditor pass must never compile.
-            a = self._admission(self._census_view(table), now)
-            tx.add(np.asarray(a.keys))  # guberlint: allow-host-sync -- warmup: compile the admission program before serving
-        if self._pager is not None:
-            # Compile the page-migration programs (bind/extract/write/
-            # unbind) on a throwaway cycle over frame 0: the first
-            # demand promote/demote must not pay a compile under the
-            # serving lock. Leaves the table empty and the map unbound.
-            PK = self.K
-            z = np.int32(0)
-            table = PK.bind_page(table, z, z)
-            rows = PK.extract_page(table, z)
+        with self.topo.dispatch_guard():
             with _transfer.account(self.metrics, "d2h", "warmup") as tx:
-                host = {
-                    f: np.asarray(getattr(rows, f))  # guberlint: allow-host-sync -- warmup: compile the demote extract path before serving
-                    for f in SlotTable._fields
-                }
-                tx.add(host)
-            table = PK.write_page(table, z, z, SlotTable(**host))
-            table = PK.unbind_page(table, z, z)
+                table, out = self.K.decide(
+                    self.table, wb, now, self.cfg.ways, self.store is not None
+                )
+                tx.add(np.asarray(out.status))
+                table, _, _ = self.K.inject(
+                    table, InjectBatch.zeros(self.cfg.batch_size), now,
+                    self.cfg.ways,
+                )
+                tx.add(np.asarray(table.used[:1]))  # guberlint: allow-raw-table-index -- warmup sync probe: any one physical row works, logical identity irrelevant
+                # Census compiles here too: the first /metrics or /debug/table
+                # scrape must dispatch a warm program, not pay a compile.
+                c = self._census(self._census_view(table), now)
+                tx.add(np.asarray(c.live))  # guberlint: allow-host-sync -- warmup: compile the census program before serving
+                # Admission accounting likewise: the first /debug/admission
+                # scrape or auditor pass must never compile.
+                a = self._admission(self._census_view(table), now)
+                tx.add(np.asarray(a.keys))  # guberlint: allow-host-sync -- warmup: compile the admission program before serving
+            if self._pager is not None:
+                # Compile the page-migration programs (bind/extract/write/
+                # unbind) on a throwaway cycle over frame 0: the first
+                # demand promote/demote must not pay a compile under the
+                # serving lock. Leaves the table empty and the map unbound.
+                PK = self.K
+                z = np.int32(0)
+                table = PK.bind_page(table, z, z)
+                rows = PK.extract_page(table, z)
+                with _transfer.account(self.metrics, "d2h", "warmup") as tx:
+                    host = {
+                        f: np.asarray(getattr(rows, f))  # guberlint: allow-host-sync -- warmup: compile the demote extract path before serving
+                        for f in SlotTable._fields
+                    }
+                    tx.add(host)
+                table = PK.write_page(table, z, z, SlotTable(**host))
+                table = PK.unbind_page(table, z, z)
+            rt = self._rtier
+            if rt is not None:
+                # Replica-tier programs: decide, the sync tick (both
+                # variants), and the stacked census/admission scans —
+                # the first GLOBAL request or sync tick must dispatch
+                # warm programs.
+                home = np.zeros(self.cfg.batch_size, np.int64)
+                with _transfer.account(self.metrics, "d2h", "warmup") as tx:
+                    rt.state, r_out = rt.decide(rt.state, wb, home, now)
+                    tx.add(np.asarray(r_out.status))  # guberlint: allow-host-sync -- warmup: compile the replica decide program before serving
+                    rt.state, diag = rt.sync(rt.state, now)
+                    tx.add(np.asarray(diag))  # guberlint: allow-host-sync -- warmup: compile the sync tick before the cadence thread runs it
+                    if rt.sync_full is not None:
+                        rt.state, diag = rt.sync_full(rt.state, now)
+                        tx.add(np.asarray(diag))  # guberlint: allow-host-sync -- warmup: compile the full-tick backstop before its first forced tick
+                    rc = rt.census(rt.state.table, now)
+                    tx.add(np.asarray(rc.live))  # guberlint: allow-host-sync -- warmup: compile the replica census program before serving
+                    ra = rt.admission(rt.state.table, now)
+                    tx.add(np.asarray(ra.keys))  # guberlint: allow-host-sync -- warmup: compile the replica admission program before serving
+                jax.block_until_ready(rt.state.pending)
         self.table = table
 
     def _census_view(self, table):
@@ -1631,7 +1679,7 @@ class DeviceEngine(EngineBase):
         cfg = self.cfg
         z64 = np.zeros(B, np.int64)
         now = self.now_fn()
-        with self._lock, _transfer.account(
+        with self._lock, self.topo.dispatch_guard(), _transfer.account(
             self.metrics, "d2h", "warmup"
         ) as tx:
             table, out = self.K.decide(
@@ -1685,8 +1733,11 @@ class DeviceEngine(EngineBase):
         now = self.now_fn()
         host_pages = None
         pages_snap = None
-        with self._lock:
+        out_r = None
+        with self._lock, self.topo.dispatch_guard():
             out = self._census(self._census_view(self.table), now)
+            if self._rtier is not None:
+                out_r = self._rtier.census(self._rtier.state.table, now)
             if self._pager is not None:
                 # Reference copies under the lock; the numpy census walk
                 # happens after release (rows blocks are replace-only).
@@ -1709,14 +1760,29 @@ class DeviceEngine(EngineBase):
                 heatmap_width=int(cfg.census_heatmap_width),
             )
             tx.add(out)
-        tiers = {"device": tier}
+        primary = self.topo.primary_tier
+        tiers = {primary: tier}
+        if out_r is not None:
+            rt = self._rtier
+            with _transfer.account(self.metrics, "d2h", "census") as tx:
+                tiers["replica"] = _census_tier_snapshot(
+                    out_r,
+                    now=now,
+                    layout=cfg.layout,
+                    groups=rt.num_rgroups,
+                    ways=rt.replica_ways,
+                    bytes_per_slot=self.K.bytes_per_slot,
+                    thresholds=self._census_thresholds,
+                    heatmap_width=int(cfg.census_heatmap_width),
+                )
+                tx.add(out_r)
         if self._pager is not None:
             # Host-DRAM tier census (satellite: per-tier counts — the
             # census must not under-report live keys once demotion is
             # on). Pure numpy over the demoted pages' wide rows
             # (ops/census.py census_oracle), no device work.
             tiers["host"] = self._census_host_tier(host_pages, now)
-        snap = _census_combine(tiers, primary="device")
+        snap = _census_combine(tiers, primary=primary)
         if pages_snap is not None:
             snap["pages"] = pages_snap
         return snap
@@ -1774,14 +1840,21 @@ class DeviceEngine(EngineBase):
         the census) — a demoted key's window still counts."""
         now = self.now_fn()
         host_pages = None
-        with self._lock:
+        out_r = None
+        with self._lock, self.topo.dispatch_guard():
             out = self._admission(self._census_view(self.table), now)
+            if self._rtier is not None:
+                out_r = self._rtier.admission(self._rtier.state.table, now)
             if self._pager is not None:
                 host_pages = self._pager.host_tier_copy()
         with _transfer.account(self.metrics, "d2h", "admission") as tx:
             tier = _admission_tier_dict(out)
             tx.add(out)
-        tiers = {"device": tier}
+        tiers = {self.topo.primary_tier: tier}
+        if out_r is not None:
+            with _transfer.account(self.metrics, "d2h", "admission") as tx:
+                tiers["replica"] = _admission_tier_dict(out_r)
+                tx.add(out_r)
         if self._pager is not None:
             tiers["host"] = self._admission_host_tier(host_pages, now)
         snap = _admission_combine(tiers)
@@ -1815,6 +1888,12 @@ class DeviceEngine(EngineBase):
         slot still held), or `evicted` — so operators can see whether
         hot keys are fighting cold residents for slots."""
         snap = super().hotkeys_snapshot()
+        if self._rtier is not None:
+            # GLOBAL keys hash into the replica keyspace (num_rgroups),
+            # not the sharded table's groups — the join below would
+            # mislabel them, so the replica topology serves the plain
+            # sketch snapshot (pre-unification IciEngine behavior).
+            return snap
         entries = snap.get("entries") or []
         hashes = [e.get("key_hash") for e in entries]
         if not hashes or any(h is None for h in hashes):
@@ -1931,11 +2010,19 @@ class DeviceEngine(EngineBase):
             self._maybe_prune_key_strings()
 
         asm = _WaveAssembler(RequestBatch.zeros, B)
-        placements: List[Optional[Tuple[int, int]]] = []
+        placements: List[Optional[tuple]] = []
         wave_rows: List[list] = []  # per-wave (req, hi, lo, grp) for bulk fill
         wave_lanes: List[list] = []
         GREG = int(Behavior.DURATION_IS_GREGORIAN)
+        GLOBAL = int(Behavior.GLOBAL)
         keep = cfg.keep_key_strings
+        rt = self._rtier
+        # GLOBAL replica routing (replica topologies): keys re-hash into
+        # the replica keyspace, waves assemble per (home, slot) so the
+        # round-robin home device rides the wave batch, and placements
+        # carry an "r" tag so _complete demuxes from the replica outputs.
+        r_asm = _WaveAssembler(RequestBatch.zeros, B) if rt is not None else None
+        replica_homes: List[np.ndarray] = []
 
         carry: List[Tuple[RateLimitReq, object]] = []
         new_strings: Dict[Tuple[int, int], str] = {}
@@ -1943,6 +2030,28 @@ class DeviceEngine(EngineBase):
             hi, lo = hi_l[i], lo_l[i]
             if keep:
                 new_strings[(hi, lo)] = req.hash_key()
+            if rt is not None and (req.behavior & GLOBAL):
+                slot = group_of(lo, rt.num_rgroups)
+                home = self._home_rr % self.topo.n_dev
+                placed = r_asm.place((home, slot), cfg.max_waves)
+                if placed is None:
+                    carry.append((req, fut))
+                    placements.append("carry")
+                    continue
+                self._home_rr += 1
+                wb, w, lane = placed
+                try:
+                    encode_one(wb, lane, req, now, rt.num_rgroups, key=(hi, lo))
+                except EncodeError as e:
+                    fut.set_result(RateLimitResp(error=str(e)))
+                    placements.append(None)
+                    continue
+                while len(replica_homes) < len(r_asm.waves):
+                    replica_homes.append(np.zeros(B, dtype=np.int64))
+                replica_homes[w][lane] = home
+                r_asm.commit(w, (home, slot))
+                placements.append(("r", w, lane, hi, lo))
+                continue
             grp = grp_l[i]
             placed = asm.place(grp, cfg.max_waves)
             if placed is None:
@@ -1968,7 +2077,7 @@ class DeviceEngine(EngineBase):
                 wave_rows[w].append((req, hi, lo, grp))
                 wave_lanes[w].append(lane)
             asm.commit(w, grp)
-            placements.append((w, lane, hi, lo))
+            placements.append(("s", w, lane, hi, lo))
 
         if new_strings:
             with self._keys_lock:
@@ -2007,21 +2116,24 @@ class DeviceEngine(EngineBase):
         wave_lane_req: List[Dict[int, tuple]] = [dict() for _ in waves]
         if self.store is not None:
             for i, place in enumerate(placements):
-                if isinstance(place, tuple):
-                    wave_lane_req[place[0]][place[1]] = (
-                        items[i][0], place[2], place[3],
+                if isinstance(place, tuple) and place[0] == "s":
+                    wave_lane_req[place[1]][place[2]] = (
+                        items[i][0], place[3], place[4],
                     )
         # Per-ticket flush span: starts here, rides the ticket across
         # the pipeline boundary, ends when _complete finishes (the
         # completion thread re-attaches its context — see
         # _complete_ticket). Request spans link to it and back.
+        r_waves = r_asm.waves if r_asm is not None else []
+        n_waves = len(waves) + len(r_waves)
         seq = self._flush_seq()
         fspan = self._start_flush_span(
             items, seq, path="object", layout=cfg.layout,
-            items=len(items), waves=len(waves),
+            items=len(items), waves=n_waves,
             batch_width=len(items) - len(carry),
         )
         widths = [int(w.active.shape[0]) for w in waves]  # guberlint: allow-host-sync -- static shape metadata, no device readback
+        widths += [B] * len(r_waves)  # replica waves stay full-width
         # Retrace attribution (runtime/telemetry.py): stamp this
         # thread's shape signature so a compile observed during the
         # flush names the widths that retraced, not just the program.
@@ -2031,17 +2143,19 @@ class DeviceEngine(EngineBase):
             with _telemetry.serving_scope(self.metrics), tracing.use_span_ctx(
                 fspan
             ):
-                outs, wave_rows_host, events = self._execute_waves(
-                    waves, wave_lane_req, now, prefetched
+                outs, r_outs, wave_rows_host, events = self._execute_waves(
+                    waves, wave_lane_req, now, prefetched,
+                    r_waves=r_waves, r_homes=replica_homes,
                 )
         except Exception as e:
             tracing.end_span(fspan, error=e)
             raise
         return carry, _FlushTicket(
             items=items, placements=placements, outs=outs,
+            r_outs=r_outs,
             rows=wave_rows_host, events=events,
             served=len(items) - len(carry), carry_n=len(carry),
-            waves=len(waves),
+            waves=n_waves,
             widths=widths,
             t0=t0, t_dev=t_dev, seq=seq, span=fspan,
             otel_ctx=tracing.context_of(fspan),
@@ -2055,8 +2169,13 @@ class DeviceEngine(EngineBase):
         cfg = self.cfg
         t_c0 = time.perf_counter()
         # The np.asarray syncs live in _materialize_out (the sanctioned
-        # completion-stage readback).
-        host = [_materialize_out(o) for o in t.outs]
+        # completion-stage readback). Sharded ("s") and replica ("r")
+        # outputs materialize side by side; placements tag which list a
+        # lane demuxes from.
+        host = {
+            "s": [_materialize_out(o) for o in t.outs],
+            "r": [_materialize_out(o) for o in t.r_outs],
+        }
         t_sync = time.perf_counter()
         dev_s = t_sync - t.t_dev
         # Transfer ledger: the serve-path d2h readback. Duration is the
@@ -2068,7 +2187,10 @@ class DeviceEngine(EngineBase):
 
         if cfg.keep_key_strings:
             self._drop_displaced_strings(t.events)
-        tot = [sum(h[i] for h in host) for i in (4, 5, 6, 7)]
+        tot = [
+            sum(h[i] for hs in host.values() for h in hs)
+            for i in (4, 5, 6, 7)
+        ]
         dur = time.perf_counter() - t.t0
         em = self.metrics
         trace_id = (t.trace_id or "") if cfg.exemplars else ""
@@ -2109,11 +2231,12 @@ class DeviceEngine(EngineBase):
         for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
                 continue  # resolved (encode error) or deferred
-            w, lane = place[0], place[1]
-            st, rem, rst, lim = host[w][0], host[w][1], host[w][2], host[w][3]
+            path, w, lane = place[0], place[1], place[2]
+            hw = host[path][w]
+            st, rem, rst, lim = hw[0], hw[1], hw[2], hw[3]
             status = int(st[lane])  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
             if hk is not None:
-                k = (place[2], place[3])
+                k = (place[3], place[4])
                 ent = hk_agg.get(k)
                 if ent is None:
                     hk_agg[k] = [
@@ -2217,6 +2340,14 @@ class DeviceEngine(EngineBase):
             )
         else:
             hi, lo, grp = hashes
+        if self._rtier is not None:
+            # Replica topologies serve GLOBAL columns internally: split
+            # the batch between the sharded decide and the replica tier
+            # (routes_global_internally — the caller does NOT filter
+            # GLOBAL out for this engine).
+            return self._check_columns_replica_split(
+                cols, now, select, (hi, lo, grp), t_start
+            )
         # Key strings resolve through the ORIGINAL columns (select drops
         # key_offsets); the store path decodes every key, the store-less
         # path only never-seen ones (record_columnar_keys).
@@ -2332,7 +2463,7 @@ class DeviceEngine(EngineBase):
             "engine.flush", level="DEBUG", path="columnar", items=n, waves=W,
             layout=cfg.layout,
         ) as fspan:
-            outs, wave_rows_host, events = self._execute_waves(
+            outs, _r_outs, wave_rows_host, events = self._execute_waves(
                 wave_slices, lane_reqs, now, prefetched,
                 req_resolver=resolver,
             )
@@ -2376,8 +2507,144 @@ class DeviceEngine(EngineBase):
             _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, st_req)
         return (st_req, r_limit[ix], remaining[ix], reset_time[ix])
 
+    def _check_columns_replica_split(self, cols, now, select, hashes, t_start):
+        """Columnar serving for replica topologies — the multi-chip
+        daemon's fast edge. Non-GLOBAL items feed the owner-sharded SPMD
+        decide (shared wave assembler, one collective call per wave);
+        GLOBAL items feed the per-device replica tier with the same
+        round-robin home assignment as the object path (replica decide
+        handles pending bookkeeping internally; the GLOBAL bit stays SET
+        — this engine routes_global_internally). Waves always run at the
+        full batch width — a narrower width would cold-compile a second
+        SPMD program per shape."""
+        cfg = self.cfg
+        rt = self._rtier
+        hi, lo, grp = hashes
+        if select is not None:
+            if len(select) == 0:
+                return None
+            hi, lo, grp = hi[select], lo[select], grp[select]
+            cols = _select_columns(cols, select)
+        n = cols.n
+        g_mask = (np.asarray(cols.behavior) & int(Behavior.GLOBAL)) != 0  # guberlint: allow-host-sync -- wire columns are host numpy (wire.parse_requests output), no device readback
+        ng_idx = np.nonzero(~g_mask)[0]
+        g_idx = np.nonzero(g_mask)[0]
+
+        # -- assemble the sharded (non-GLOBAL) waves --
+        s_asm = None
+        if len(ng_idx):
+            s_cols = (
+                cols if len(g_idx) == 0 else _select_columns(cols, ng_idx)
+            )
+            s_asm = _assemble_column_waves(
+                s_cols, hi[ng_idx], lo[ng_idx], grp[ng_idx], now,
+                cfg.batch_size, cfg.max_waves,
+            )
+            if s_asm is None:
+                return None
+
+        # -- assemble the replica (GLOBAL) waves --
+        r_asm, homes_wb = None, None
+        if len(g_idx):
+            r_cols = _select_columns(cols, g_idx)
+            r_lo = lo[g_idx]
+            slot = (r_lo.astype(np.uint64) % np.uint64(rt.num_rgroups)
+                    ).astype(np.int64)
+            with self._lock:  # round-robin base, racing the pump thread
+                rr0 = self._home_rr
+                self._home_rr += len(g_idx)
+            homes = (rr0 + np.arange(len(g_idx))) % self.topo.n_dev
+            # Wave conflicts are per (home, slot) PAIR (the object path's
+            # place key): encode the pair as the assembly "group", then
+            # overwrite the batch's group column with the real slot.
+            pair = homes * np.int64(rt.num_rgroups) + slot
+            r_asm = _assemble_column_waves(
+                r_cols, hi[g_idx], r_lo, pair, now,
+                cfg.batch_size, cfg.max_waves,
+            )
+            if r_asm is None:
+                return None
+            r_wb, _rw, _rl, r_ix, RW, RB = r_asm
+            r_wb.group[r_ix] = slot.astype(np.int32)
+            homes_wb = np.zeros((RW, RB), dtype=np.int64)
+            homes_wb[r_ix] = homes
+
+        wave_slices, r_slices, r_homes = [], [], []
+        if s_asm is not None:
+            wb = s_asm[0]
+            wave_slices = [
+                jax.tree.map(lambda a, w=w: a[w], wb)
+                for w in range(s_asm[4])
+            ]
+        if r_asm is not None:
+            r_wb = r_asm[0]
+            r_slices = [
+                jax.tree.map(lambda a, w=w: a[w], r_wb)
+                for w in range(r_asm[4])
+            ]
+            r_homes = [homes_wb[w] for w in range(r_asm[4])]
+
+        _telemetry.set_shape_hint(
+            f"{cfg.layout}:mesh-columnar:B{cfg.batch_size}"
+        )
+        t_dev = time.perf_counter()
+        with _telemetry.serving_scope(self.metrics), tracing.span(
+            "engine.flush", level="DEBUG", path="columnar", items=n,
+            layout=cfg.layout,
+        ) as fspan:
+            # _execute_waves supplies the lock, the collective guard,
+            # page residency (paged mesh), and unified recovery.
+            s_outs, r_outs, _rows, _events = self._execute_waves(
+                wave_slices, [{} for _ in wave_slices], now, {},
+                r_waves=r_slices, r_homes=r_homes,
+            )
+
+        status = np.zeros(n, np.int64)
+        r_limit = np.zeros(n, np.int64)
+        remaining = np.zeros(n, np.int64)
+        reset_time = np.zeros(n, np.int64)
+        waves_total = 0
+        tots = [0, 0, 0, 0]
+        with _transfer.account(self.metrics, "d2h", "serve") as tx:
+            for outs, asm, idx in (
+                (s_outs, s_asm, ng_idx), (r_outs, r_asm, g_idx),
+            ):
+                if asm is None:
+                    continue
+                st, li, re, rst = _stack_wave_outputs(outs)
+                tx.add((st, li, re, rst))
+                ix = asm[3]
+                status[idx] = st[ix]
+                r_limit[idx] = li[ix]
+                remaining[idx] = re[ix]
+                reset_time[idx] = rst[ix]
+                waves_total += asm[4]
+                for j, v in enumerate(_wave_totals(outs)):
+                    tots[j] += v
+        dev_s = time.perf_counter() - t_dev
+        dur = time.perf_counter() - t_start
+        flush_trace_id = tracing.trace_id_of(fspan)
+        em = self.metrics
+        em.observe(tots[0], tots[1], tots[2], tots[3], waves_total, n, dur)
+        em.observe_flush(
+            "columnar", n, waves_total, dur, dev_s,
+            flush_trace_id if cfg.exemplars else "",
+        )
+        em.observe_stage("assemble", t_dev - t_start)
+        em.observe_stage("device_sync", dev_s)
+        em.recorder.record(
+            path="columnar", layout=cfg.layout, n=n, waves=waves_total,
+            carry=0, widths=[cfg.batch_size] * waves_total,
+            dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
+            trace_id=flush_trace_id,
+        )
+        if em.hotkeys.k > 0:
+            _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, status)
+        return (status, r_limit, remaining, reset_time)
+
     def _execute_waves(
-        self, waves, lane_reqs, now, prefetched, req_resolver=None
+        self, waves, lane_reqs, now, prefetched, req_resolver=None,
+        r_waves=(), r_homes=(),
     ):
         """Run decide over scatter-disjoint waves under the device lock,
         with the store's per-wave sequence when a Store is attached:
@@ -2388,7 +2655,15 @@ class DeviceEngine(EngineBase):
 
         lane_reqs: per-wave {lane: (req_or_index, key_hi, key_lo)}; with
         req_resolver set, the first element is an index resolved lazily
-        (columnar path). Returns (outs, wave_rows_host, events).
+        (columnar path). r_waves/r_homes: GLOBAL replica waves + their
+        per-lane home devices (replica topologies only), decided against
+        the replica tier after the sharded waves. Returns
+        (outs, r_outs, wave_rows_host, events).
+
+        All dispatches run under the topology's collective guard (inside
+        the table lock): on a mesh, concurrent multi-device programs
+        from another engine in the same process would interleave
+        per-device enqueues and deadlock in the collective rendezvous.
 
         On failure: keeps the last valid intermediate state if still
         held; a failed jitted call may have consumed the donated table
@@ -2400,12 +2675,15 @@ class DeviceEngine(EngineBase):
         the batch through another path (double-apply)."""
         store = self.store
         cfg = self.cfg
+        rt = self._rtier
         outs: List[object] = []
+        r_outs: List[object] = []
         wave_rows_host: List[object] = []  # materialized post-decide rows
         served: Dict[Tuple[int, int], Tuple[int, int]] = {}  # key->(w,lane)
         events: List[Tuple[str, Tuple[int, int]]] = []  # ('d'|'i', key)
-        with self._lock:
+        with self._lock, self.topo.dispatch_guard():
             table = self.table
+            rstate = rt.state if rt is not None else None
             try:
                 for w, wb in enumerate(waves):
                     if self._pager is not None:
@@ -2444,14 +2722,21 @@ class DeviceEngine(EngineBase):
                         for lane, entry in lane_reqs[w].items():
                             served[(entry[1], entry[2])] = (w, lane)
                             events.append(("i", (entry[1], entry[2])))
+                for wb, hm in zip(r_waves, r_homes):
+                    rstate, out = rt.decide(rstate, wb, hm, now)
+                    r_outs.append(out)
                 self.table = table
+                if rt is not None:
+                    rt.state = rstate
             except Exception as e:
                 self.table = table
+                if rt is not None:
+                    rt.state = rstate
                 rebuilt = self._recover_table_locked()
-                if outs and not rebuilt:
+                if (outs or r_outs) and not rebuilt:
                     raise TableCommittedError(str(e)) from e
                 raise
-        return outs, wave_rows_host, events
+        return outs, r_outs, wave_rows_host, events
 
     def _drop_displaced_strings(self, events) -> None:
         """Key-dictionary hygiene (store path): a key whose LAST flush
@@ -2569,7 +2854,9 @@ class DeviceEngine(EngineBase):
             for (req, _), place in zip(items, placements):
                 if place is None or place == "carry":
                     continue
-                w, lane, hi, lo = place
+                tag, w, lane, hi, lo = place
+                if tag != "s":
+                    continue  # replica lanes never persist to a Store
                 yield req.hash_key(), w, lane, hi, lo
 
         self._store_write_behind_core(seq(), outs, rows)
@@ -2666,7 +2953,7 @@ class DeviceEngine(EngineBase):
         n = self.cfg.num_groups * self.cfg.ways
         if len(self._key_strings) <= max(2 * n, 4096):
             return
-        with self._lock, _transfer.account(
+        with self._lock, self.topo.dispatch_guard(), _transfer.account(
             self.metrics, "d2h", "census"
         ) as tx:
             used = np.asarray(self.table.used)  # guberlint: allow-raw-table-index -- prune wants the PHYSICAL resident set; demoted keys join via host_live_keys below
@@ -2714,6 +3001,22 @@ class DeviceEngine(EngineBase):
                 self._pager.reset()
             with self._keys_lock:
                 self._key_strings.clear()
+        rt = self._rtier
+        if rt is not None:
+            # Replica tier: same consumed-or-poisoned probe on its
+            # donated state; rebuild empty on damage (counter loss on
+            # failure matches the accepted semantics).
+            try:
+                r_deleted = getattr(
+                    rt.state.pending, "is_deleted", lambda: False
+                )()
+                if not r_deleted:
+                    jax.block_until_ready(rt.state.pending)  # guberlint: allow-host-sync -- error-path replica health probe
+            except Exception:
+                r_deleted = True
+            if r_deleted:
+                rt.state = rt.recreate_state()
+                deleted = True
         return deleted
 
     def _recover_after_failure(self) -> bool:
@@ -2792,7 +3095,7 @@ class DeviceEngine(EngineBase):
         with self._keys_lock:
             self._key_strings.update(new_strings)
 
-        with self._lock:
+        with self._lock, self.topo.dispatch_guard():
             table = self.table
             with _transfer.account(self.metrics, "h2d", "inject") as tx:
                 for ib in asm.waves:
@@ -2820,7 +3123,7 @@ class DeviceEngine(EngineBase):
         table's snapshot of the same keys."""
         if self._pager is not None:
             return self._snapshot_paged()
-        with self._lock:
+        with self._lock, self.topo.dispatch_guard():
             tbl = self.K.to_wide(self.table)  # canonical wide snapshot
             with _transfer.account(self.metrics, "d2h", "snapshot") as tx:
                 host = {f: np.asarray(getattr(tbl, f)) for f in tbl._fields}
@@ -2838,7 +3141,7 @@ class DeviceEngine(EngineBase):
         ps = PK.page_slots
         n_logical = cfg.num_groups * cfg.ways
         host = wide_zeros(PK.num_logical_pages * ps)
-        with self._lock:
+        with self._lock, self.topo.dispatch_guard():
             pager = self._pager
             with _transfer.account(self.metrics, "d2h", "snapshot") as tx:
                 for lp in np.nonzero(pager.page_map >= 0)[0].tolist():
@@ -2884,7 +3187,7 @@ class DeviceEngine(EngineBase):
             }
             tx.add(fields)
         self._snapshot_staging_bytes = tx.bytes
-        with self._lock:
+        with self._lock, self.topo.dispatch_guard():
             self.table = self.K.from_wide(SlotTable(**fields))
         with self._keys_lock:
             self._key_strings = dict(snap.get("key_strings", {}))
@@ -2896,7 +3199,7 @@ class DeviceEngine(EngineBase):
         ps = PK.page_slots
         fields = {f: np.asarray(snap[f]) for f in SlotTable._fields}  # guberlint: allow-host-sync -- snap is the Loader's host-side image, not device data
         n = fields["used"].shape[0]
-        with self._lock:
+        with self._lock, self.topo.dispatch_guard():
             self.table = PK.create()
             self._pager.reset()
             pager = self._pager
@@ -2908,8 +3211,11 @@ class DeviceEngine(EngineBase):
                     page = wide_zeros(ps)
                     for f in SlotTable._fields:
                         page[f][: hi - lo] = fields[f][lo:hi]
-                    if pager.free:
-                        pp = pager.free.pop()
+                    # acquire_frame is the single bind gate: on a mesh
+                    # it draws from the page's own shard pool, so the
+                    # restore preserves per-shard placement invariants.
+                    pp = pager.acquire_frame(lp)
+                    if pp is not None:
                         self.table = PK.write_page(
                             self.table, np.int32(lp), np.int32(pp),
                             SlotTable(**page),
@@ -2921,6 +3227,14 @@ class DeviceEngine(EngineBase):
             self._snapshot_staging_bytes = tx.bytes
         with self._keys_lock:
             self._key_strings = dict(snap.get("key_strings", {}))
+
+
+class DeviceEngine(MeshEngine):
+    """MeshEngine at mesh shape ``(1,)`` — the single-chip engine name
+    that V1Service, the daemon, and the test suites construct. The
+    default topology (SingleChipTopology) IS the pre-unification
+    DeviceEngine binding, so this shell only preserves the public type
+    name; every behavior lives in the core."""
 
 
 def _assemble_column_waves(

@@ -1,7 +1,12 @@
-"""IciEngine: a servable engine over a multi-device mesh.
+"""IciEngine: the unified engine core served over a multi-device mesh.
 
-Where DeviceEngine owns one chip, IciEngine owns a whole
-jax.sharding.Mesh and replaces the host-level peer mesh *inside* the
+IciEngine IS MeshEngine (runtime/engine.py) bound to the mesh topology
+strategy (runtime/topology.py IciMeshTopology): the pump, pipeline ring,
+ticket lifecycle, failure recovery, drain, snapshots, and census /
+admission caching are the single core's — this file adds only what is
+genuinely ici-specific policy: the GLOBAL sync *cadence* (background
+tick thread + overflow/backlog counters) and the replica-targeted
+`inject_globals`. It replaces the host-level peer mesh *inside* the
 process (SURVEY.md §2.3):
 
 - Non-GLOBAL traffic runs through the owner-sharded decide
@@ -14,17 +19,25 @@ process (SURVEY.md §2.3):
   background sync thread runs the collective delta/rebroadcast tick on
   the GlobalSyncWait cadence — the globalManager with psums instead of
   gRPC.
+- The paged table works here exactly as on one chip: the mesh kernel
+  facade keeps the physical frames sharded and the page map replicated,
+  and the Pager runs one frame pool + host-DRAM cold tier PER SHARD
+  (docs/architecture.md "Paged table").
 
 The public surface matches DeviceEngine (check_async/check_bulk/
-check_batch/close/inject_globals), so V1Service and the daemon can use
-either; a daemon configured with global_mode="ici" serves a whole pod as
-one process with no intra-pod RPCs.
+check_batch/close/inject_globals/snapshot/restore), so V1Service and the
+daemon can use either; a daemon configured with global_mode="ici" serves
+a whole pod as one process with no intra-pod RPCs.
 
 Wave rules differ per path: sharded lanes split on slot-group conflicts
 (scatter disjointness per device); replica lanes split on (home, group)
 conflicts (same key on the same replica must serialize, but the same key
 on different replicas is exactly multi-node GLOBAL behavior and may
 share a wave).
+
+guberlint GL013 (engine-core-drift) ratchets this file: a method here
+whose name shadows a MeshEngine core method needs an explicit pragma —
+the dispatch/complete/recovery logic must never re-fork.
 """
 
 from __future__ import annotations
@@ -33,36 +46,14 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Optional
 
 import jax
 import numpy as np
 
-from gubernator_tpu.utils import lockorder
-from gubernator_tpu.api.keys import group_of, key_hash128_batch
-from gubernator_tpu.api.types import Behavior, RateLimitResp
-from gubernator_tpu.ops.encode import EncodeError, encode_one
-from gubernator_tpu.ops.kernels import BYTES_PER_SLOT, get_admission, get_census
-from gubernator_tpu.ops.layout import RequestBatch
-from gubernator_tpu.parallel import ici
-from gubernator_tpu.parallel import mesh as pmesh
-from gubernator_tpu.runtime.engine import (
-    EngineBase,
-    EngineMetrics,
-    TableCommittedError,
-    _FlushTicket,
-    _WaveAssembler,
-    _admission_combine,
-    _admission_tier_dict,
-    _assemble_column_waves,
-    _census_combine,
-    _census_tier_snapshot,
-    _materialize_out,
-    _note_hotkeys_columnar,
-    _select_columns,
-    _stack_wave_outputs,
-    _wave_totals,
-)
+from gubernator_tpu.api.keys import key_hash128_batch
+from gubernator_tpu.runtime.engine import MeshEngine, _WaveAssembler
+from gubernator_tpu.runtime.topology import IciMeshTopology
 from gubernator_tpu.runtime import telemetry as _telemetry
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import tracing
@@ -124,30 +115,33 @@ class IciEngineConfig:
     # (sharded + replica) waves launch in the dispatch stage and sync
     # in the completion stage.
     pipeline_depth: int = 2
-    # Paged-table knobs (GUBER_TABLE_PAGE_*): accepted for config
-    # parity with EngineConfig, but NOT YET IMPLEMENTED for the
-    # shard_map'd ici tiers — the indirection map would have to be
-    # replicated and page moves collective. Setting page_groups > 0
-    # logs a warning and serves flat (docs/architecture.md "Paged
-    # table", staged work).
+    # Paged-table knobs (GUBER_TABLE_PAGE_*) — same semantics as
+    # EngineConfig: page_groups > 0 swaps the sharded tier to the paged
+    # addressing layer (parallel/mesh.py), with the page map replicated
+    # across the mesh, the physical frames owner-sharded, and one
+    # resident-frame pool + host-DRAM cold tier per shard. The replica
+    # tier stays flat (it is already capacity-bounded per device).
     page_groups: int = 0
     page_budget: int = 0
     page_demote_interval_s: float = 2.0
     page_free_target: int = 1
+    # Key-string dictionary (GUBER_KEEP_KEY_STRINGS semantics): needed
+    # for routable Loader/handover snapshots — same default as
+    # EngineConfig. record_columnar_keys stays off (the columnar edge
+    # on this engine predates the dictionary; object-path and inject
+    # traffic keep it complete enough for handover).
+    keep_key_strings: bool = True
+    record_columnar_keys: bool = False
+    # Columnar width buckets stay off: every narrowed width would
+    # cold-compile a second SPMD program per shape on the mesh.
+    fast_buckets: bool = False
 
 
-class IciEngine(EngineBase):
+class IciEngine(MeshEngine):
     # GLOBAL-flagged requests are routed to the replica tier inside the
     # engine; V1Service must not strip the flag (see the GLOBAL bulk
     # submission in server._get_rate_limits)
     routes_global_internally = True
-
-    # Serve-flat fallback warn-once latch: a daemon restart loop (or a
-    # test suite constructing many engines) must not spam the same
-    # capability warning per construction — once per process is the
-    # operator signal; per-engine visibility lives in /debug/engine and
-    # the census "pages" section instead.
-    _paging_warned = False
 
     def __init__(self, config: IciEngineConfig = IciEngineConfig(), now_fn=_clock.now_ms):
         cfg = config
@@ -158,118 +152,12 @@ class IciEngine(EngineBase):
             raise ValueError(
                 "num_slots must divide by replica_ways * device count"
             )
-        if cfg.max_waves < 1:
-            raise ValueError("max_waves must be >= 1")
-        self._paging_requested = int(getattr(cfg, "page_groups", 0) or 0) > 0
-        if self._paging_requested and not IciEngine._paging_warned:
-            IciEngine._paging_warned = True
-            log.warning(
-                "table paging (page_groups=%d) is not yet implemented "
-                "for the ici engine's sharded tiers; serving flat — "
-                "the HBM budget is num_groups * ways per device",
-                cfg.page_groups,
-            )
-        self.cfg = cfg
-        self.now_fn = now_fn
-        self.n_dev = len(devices)
-        self.mesh = pmesh.make_mesh(devices)
-        self.metrics = EngineMetrics()
-
-        # Owner-sharded authoritative path
-        self.table = pmesh.create_sharded_table(
-            self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout,
-            metrics=self.metrics,
-        )
-        self._decide = pmesh.make_sharded_decide(
-            self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout
-        )
-
-        # GLOBAL replica path
-        self.num_rgroups = cfg.num_slots // cfg.replica_ways
-        self.ici_state = ici.create_ici_state(
-            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout,
-            metrics=self.metrics,
-        )
-        self._replica = ici.make_replica_decide(
-            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
-        )
-        self._sync = ici.make_sync_step(
-            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout,
-            max_sync_groups=cfg.max_sync_groups,
-        )
-        # Collision backstop: a second, unbounded sync program selected
-        # every `full_tick_every`-th tick. Only built when the regular
-        # tick is actually capped (an uncapped tick IS the full tick;
-        # a cap >= group count compiles to the uncapped program too).
-        self._sync_full = None
-        if (
-            cfg.max_sync_groups is not None
-            and cfg.max_sync_groups < self.num_rgroups
-            and cfg.full_tick_every > 0
-        ):
-            self._sync_full = ici.make_sync_step(
-                self.mesh, cfg.num_slots, cfg.replica_ways,
-                layout=cfg.layout, max_sync_groups=None,
-            )
-        self._inject_replicas = ici.make_inject_replicas(
-            self.mesh, cfg.num_slots, cfg.replica_ways, layout=cfg.layout
-        )
-
-        # Table observatory (ops/census.py): one non-donating program per
-        # tier — the sharded table scans as-is; the replica tier's leaves
-        # carry a leading device axis, so it uses the stacked variant
-        # (replica 0; post-sync replicas mirror each other).
-        self._census_thresholds = tuple(
-            int(k) for k in cfg.census_thresholds
-        )
-        self._census_sharded = get_census(
-            cfg.layout, cfg.ways,
-            heatmap_width=int(cfg.census_heatmap_width),
-            thresholds=self._census_thresholds,
-        )
-        self._census_replica = get_census(
-            cfg.layout, cfg.replica_ways,
-            heatmap_width=int(cfg.census_heatmap_width),
-            thresholds=self._census_thresholds,
-            stacked=True,
-        )
-        # Admission accounting (ops/admission.py): same two-tier split.
-        self._admission_sharded = get_admission(cfg.layout, cfg.ways)
-        self._admission_replica = get_admission(
-            cfg.layout, cfg.replica_ways, stacked=True
-        )
-
-        # HBM attribution (utils/devicemem.py): static geometry sized
-        # once; EngineBase.device_memory() folds in allocator stats.
-        bps = BYTES_PER_SLOT[cfg.layout]
-        census_b = 8 * (
-            2 * 32
-            + (cfg.ways + 1) + (cfg.replica_ways + 1)
-            + 2 * int(cfg.census_heatmap_width)
-            + 2 * len(self._census_thresholds)
-            + 32
-        )
-        self._mem_subsystems = {
-            "slot_table": cfg.num_groups * cfg.ways * bps,
-            # Every device carries a full GLOBAL replica (table +
-            # pending deltas + tick scalar, ops/ici.py).
-            "ici_replicas": self.n_dev * cfg.num_slots * (bps + 8) + 8 * self.n_dev,
-            "census": census_b,
-            # Two AdmissionOutputs: histogram + scalar rows per tier.
-            "admission": 2 * 8 * (32 + 8),
-            "pipeline_ring": (
-                max(int(cfg.pipeline_depth), 1)
-                * cfg.max_waves * cfg.batch_size * 8 * 8
-            ),
-        }
-        self._snapshot_staging_bytes = 0
-
-        self._lock = lockorder.make_lock("ici_engine.state")
-        self._home_rr = 0
-        self._sync_errors = 0
+        # Sync-cadence counters exist BEFORE the core constructor: the
+        # metrics bridge may scrape a half-built engine during warmup.
         # Overflow observability (VERDICT r3 item 5): keys degraded to
         # per-replica counting right now, and a running total of overflow
         # entries dropped under full-group pressure.
+        self._sync_errors = 0
         self.overflow_keys = 0
         self.overflow_drops = 0
         self.sync_backlog = 0
@@ -278,30 +166,53 @@ class IciEngine(EngineBase):
         self.full_ticks = 0
         self._capped_ticks = 0
 
-        self._warmup()
-        self._init_base("ici-engine")
+        super().__init__(cfg, now_fn, topology=IciMeshTopology(devices))
+
         self._stop_sync = threading.Event()
         self._sync_thread = threading.Thread(
             target=self._sync_loop, daemon=True, name="ici-sync"
         )
         self._sync_thread.start()
 
-    # -- public additions over EngineBase ------------------------------------
+    # -- compat views over the core's topology state --------------------------
+
+    @property
+    def n_dev(self) -> int:
+        return self.topo.n_dev
+
+    @property
+    def mesh(self):
+        return self.topo.mesh
+
+    @property
+    def num_rgroups(self) -> int:
+        return self._rtier.num_rgroups
+
+    @property
+    def ici_state(self):
+        return self._rtier.state
+
+    @ici_state.setter
+    def ici_state(self, state) -> None:
+        self._rtier.state = state
+
+    # -- public additions over the core ---------------------------------------
 
     def sync_now(self) -> None:
         """Run one GLOBAL sync tick immediately (tests/benchmarks; the
         background sync thread's tick body)."""
         now = self.now_fn()
         t0 = time.perf_counter()
-        with self._lock:
+        rt = self._rtier
+        with self._lock, self.topo.dispatch_guard():
             # The tick is warmed in _warmup and must stay compile-free on
             # the 100ms cadence — a cold tick stalls GLOBAL convergence,
             # so it counts against the cold-compile invariant too.
             with _telemetry.serving_scope(self.metrics), tracing.span(
                 "ici.sync_tick", level="DEBUG"
             ) as tick_span:
-                sync = self._sync
-                if self._sync_full is not None:
+                sync = rt.sync
+                if rt.sync_full is not None:
                     self._capped_ticks += 1
                     if self._capped_ticks >= self.cfg.full_tick_every:
                         # Collision backstop: merge the FULL table this
@@ -309,8 +220,8 @@ class IciEngine(EngineBase):
                         # hid from the capped selector.
                         self._capped_ticks = 0
                         self.full_ticks += 1
-                        sync = self._sync_full
-                self.ici_state, diag = sync(self.ici_state, now)
+                        sync = rt.sync_full
+                rt.state, diag = sync(rt.state, now)
                 with _transfer.account(self.metrics, "d2h", "census") as tx:
                     d = np.asarray(diag)
                     tx.add(d)
@@ -334,7 +245,7 @@ class IciEngine(EngineBase):
             trace_id=tracing.trace_id_of(tick_span),
         )
 
-    def inject_globals(self, globals_) -> None:
+    def inject_globals(self, globals_) -> None:  # guberlint: allow-engine-core-drift -- replica-tier semantics: authoritative pushes land on EVERY replica, not the sharded table
         """Apply an authoritative UpdatePeerGlobals push to every replica
         (the cross-pod/DCN leg landing on an ici-mode daemon)."""
         from gubernator_tpu.models.bucket import FIXED_SHIFT
@@ -344,9 +255,10 @@ class IciEngine(EngineBase):
             return
         now = self.now_fn()
         cfg = self.cfg
+        rt = self._rtier
         asm = _WaveAssembler(InjectBatch.zeros, cfg.batch_size)
         hi_a, lo_a, slot_a = key_hash128_batch(
-            [g.key for g in globals_], self.num_rgroups
+            [g.key for g in globals_], rt.num_rgroups
         )
         for i, g in enumerate(globals_):
             slot = int(slot_a[i])
@@ -367,352 +279,20 @@ class IciEngine(EngineBase):
             ib.burst[lane] = g.status.limit if leaky else 0
             ib.active[lane] = True
             asm.commit(w, slot)
-        with self._lock:
-            state = self.ici_state
+        with self._lock, self.topo.dispatch_guard():
+            state = rt.state
             with _transfer.account(self.metrics, "h2d", "inject") as tx:
                 for ib in asm.waves:
-                    state = self._inject_replicas(state, ib, now)
+                    state = rt.inject(state, ib, now)
                     tx.add(ib)
-            self.ici_state = state
+            rt.state = state
 
-    def check_columns(
-        self,
-        cols,
-        now: Optional[int] = None,
-        select: Optional[np.ndarray] = None,
-        hashes: Optional[tuple] = None,
-    ):
-        """Columnar serving for BOTH ici tiers — the multi-chip daemon's
-        fast edge. Non-GLOBAL items feed the owner-sharded SPMD decide
-        (shared wave assembler, one collective call per wave); GLOBAL
-        items feed the per-device replica tier with the same round-robin
-        home assignment as the object path (replica decide handles
-        pending bookkeeping internally; the GLOBAL bit stays SET — this
-        engine routes_global_internally). Waves always run at the full
-        batch width — a narrower width would cold-compile a second SPMD
-        program per shape."""
-        from gubernator_tpu import native as _native
-
-        cfg = self.cfg
-        if cols.n == 0:
-            return None
-        t_start = time.perf_counter()
-        if now is None:
-            now = self.now_fn()
-        if hashes is None:
-            hi, lo, grp = _native.hash128_batch_raw(
-                cols.key_data.tobytes(), cols.key_offsets, cfg.num_groups
-            )
-        else:
-            hi, lo, grp = hashes
-        if select is not None:
-            if len(select) == 0:
-                return None
-            hi, lo, grp = hi[select], lo[select], grp[select]
-            cols = _select_columns(cols, select)
-        n = cols.n
-        g_mask = (np.asarray(cols.behavior) & int(Behavior.GLOBAL)) != 0
-        ng_idx = np.nonzero(~g_mask)[0]
-        g_idx = np.nonzero(g_mask)[0]
-
-        # -- assemble the sharded (non-GLOBAL) waves --
-        s_asm = None
-        if len(ng_idx):
-            s_cols = (
-                cols if len(g_idx) == 0 else _select_columns(cols, ng_idx)
-            )
-            s_asm = _assemble_column_waves(
-                s_cols, hi[ng_idx], lo[ng_idx], grp[ng_idx], now,
-                cfg.batch_size, cfg.max_waves,
-            )
-            if s_asm is None:
-                return None
-
-        # -- assemble the replica (GLOBAL) waves --
-        r_asm, homes_wb = None, None
-        if len(g_idx):
-            r_cols = _select_columns(cols, g_idx)
-            r_lo = lo[g_idx]
-            slot = (r_lo.astype(np.uint64) % np.uint64(self.num_rgroups)
-                    ).astype(np.int64)
-            with self._lock:  # round-robin base, racing the pump thread
-                rr0 = self._home_rr
-                self._home_rr += len(g_idx)
-            homes = (rr0 + np.arange(len(g_idx))) % self.n_dev
-            # Wave conflicts are per (home, slot) PAIR (the object path's
-            # place key): encode the pair as the assembly "group", then
-            # overwrite the batch's group column with the real slot.
-            pair = homes * np.int64(self.num_rgroups) + slot
-            r_asm = _assemble_column_waves(
-                r_cols, hi[g_idx], r_lo, pair, now,
-                cfg.batch_size, cfg.max_waves,
-            )
-            if r_asm is None:
-                return None
-            r_wb, _rw, _rl, r_ix, RW, RB = r_asm
-            r_wb.group[r_ix] = slot.astype(np.int32)
-            homes_wb = np.zeros((RW, RB), dtype=np.int64)
-            homes_wb[r_ix] = homes
-
-        s_outs, r_outs = [], []
-        _telemetry.set_shape_hint(
-            f"{cfg.layout}:ici-columnar:B{cfg.batch_size}"
-        )
-        t_dev = time.perf_counter()
-        with self._lock, _telemetry.serving_scope(self.metrics), tracing.span(
-            "engine.flush", level="DEBUG", path="columnar", items=n,
-            layout=cfg.layout,
-        ) as fspan:
-            table = self.table
-            state = self.ici_state
-            try:
-                if s_asm is not None:
-                    wb = s_asm[0]
-                    for w in range(s_asm[4]):
-                        ws = jax.tree.map(lambda a, w=w: a[w], wb)
-                        table, out = self._decide(table, ws, now)
-                        s_outs.append(out)
-                if r_asm is not None:
-                    r_wb = r_asm[0]
-                    for w in range(r_asm[4]):
-                        ws = jax.tree.map(lambda a, w=w: a[w], r_wb)
-                        state, out = self._replica(
-                            state, ws, homes_wb[w], now
-                        )
-                        r_outs.append(out)
-            except Exception as e:
-                # Keep the last surviving intermediates; if donated
-                # buffers were consumed, rebuild so the engine keeps
-                # serving. Committed waves on SURVIVING tables must NOT
-                # be replayed by a fallback path.
-                self.table = table
-                self.ici_state = state
-                rebuilt = self._recover_tables_locked()
-                if (s_outs or r_outs) and not rebuilt:
-                    raise TableCommittedError(str(e)) from e
-                raise
-            self.table = table
-            self.ici_state = state
-
-        status = np.zeros(n, np.int64)
-        r_limit = np.zeros(n, np.int64)
-        remaining = np.zeros(n, np.int64)
-        reset_time = np.zeros(n, np.int64)
-        waves_total = 0
-        tots = [0, 0, 0, 0]
-        with _transfer.account(self.metrics, "d2h", "serve") as tx:
-            for outs, asm, idx in (
-                (s_outs, s_asm, ng_idx), (r_outs, r_asm, g_idx),
-            ):
-                if asm is None:
-                    continue
-                st, li, re, rt = _stack_wave_outputs(outs)
-                tx.add((st, li, re, rt))
-                ix = asm[3]
-                status[idx] = st[ix]
-                r_limit[idx] = li[ix]
-                remaining[idx] = re[ix]
-                reset_time[idx] = rt[ix]
-                waves_total += asm[4]
-                for j, v in enumerate(_wave_totals(outs)):
-                    tots[j] += v
-        dev_s = time.perf_counter() - t_dev
-        dur = time.perf_counter() - t_start
-        flush_trace_id = tracing.trace_id_of(fspan)
-        em = self.metrics
-        em.observe(tots[0], tots[1], tots[2], tots[3], waves_total, n, dur)
-        em.observe_flush(
-            "columnar", n, waves_total, dur, dev_s,
-            flush_trace_id if cfg.exemplars else "",
-        )
-        em.observe_stage("assemble", t_dev - t_start)
-        em.observe_stage("device_sync", dev_s)
-        em.recorder.record(
-            path="columnar", layout=cfg.layout, n=n, waves=waves_total,
-            carry=0, widths=[cfg.batch_size] * waves_total,
-            dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
-            trace_id=flush_trace_id,
-        )
-        if em.hotkeys.k > 0:
-            _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, status)
-        return (status, r_limit, remaining, reset_time)
-
-    def _recover_tables_locked(self) -> bool:
-        """Called with the lock held after a failed device call: the
-        jitted decide/replica programs donate their table buffers, so a
-        failure may leave self.table / self.ici_state pointing at
-        consumed arrays — every later call would then fail forever.
-        Rebuild whichever was consumed (counter loss on failure matches
-        the accepted cache-loss-on-restart semantics). Returns True when
-        anything was rebuilt (a fallback replay is then safe, not a
-        double-apply)."""
-        cfg = self.cfg
-
-        def consumed(tree) -> bool:
-            try:
-                leaf = jax.tree_util.tree_leaves(tree)[0]
-                if getattr(leaf, "is_deleted", lambda: False)():
-                    return True
-                # Error-path-only health probe: a failed ASYNC dispatch
-                # (pipelined completion) leaves the state reference
-                # pointing at poisoned arrays whose deferred error only
-                # surfaces on sync — catch it here, once, instead of on
-                # every future flush.
-                jax.block_until_ready(leaf)  # guberlint: allow-host-sync -- error-path state health probe
-                return False
-            except Exception:
-                return True
-
-        rebuilt = False
-        if consumed(self.table):
-            self.table = pmesh.create_sharded_table(
-                self.mesh, cfg.num_groups, cfg.ways, layout=cfg.layout,
-                metrics=self.metrics,
-            )
-            rebuilt = True
-        if consumed(self.ici_state):
-            self.ici_state = ici.create_ici_state(
-                self.mesh, cfg.num_slots, cfg.replica_ways,
-                layout=cfg.layout, metrics=self.metrics,
-            )
-            rebuilt = True
-        return rebuilt
-
-    def queue_depth(self) -> int:
-        return self._queue.qsize()
-
-    def live_count(self) -> int:
-        """Occupied slots: sharded table + one replica's worth of the
-        GLOBAL tier. Thin view over the TTL-cached census (GL009: no
-        device reductions on the scrape path)."""
-        return self.table_census()["live"]
-
-    def occupancy_stats(self) -> dict:
-        """Back-compat occupancy dict across BOTH tiers: the sharded
-        authoritative table plus one replica's worth of the GLOBAL tier
-        (replicas mirror each other post-sync). Probe pressure is
-        reported for the sharded tier, where a full group forces an
-        eviction on insert. A thin view over the TTL-cached census —
-        zero scrape-triggered device work (see metrics.engine_sync)."""
-        c = self.table_census()
-        return {
-            "live": c["live"],
-            "slots": c["slots"],
-            "occupancy": c["occupancy"],
-            "full_group_ratio": c["full_group_ratio"],
-        }
-
-    def _census_scan(self) -> dict:
-        """One census pass over both tiers (called by table_census with
-        _census_lock held): dispatch both non-donating programs under
-        the engine lock (async — no host sync while the pump or sync
-        tick could be waiting), materialize after release. The combined
-        view takes structural fields (heatmap, probe pressure) from the
-        sharded tier — the authoritative table a paged cold tier would
-        page — while additive fields (live, waste, cold sets,
-        histograms) sum across tiers."""
-        cfg = self.cfg
-        now = self.now_fn()
-        with self._lock:
-            out_s = self._census_sharded(self.table, now)
-            out_r = self._census_replica(self.ici_state.table, now)
-        bps = BYTES_PER_SLOT[cfg.layout]
-        tiers = {
-            "sharded": _census_tier_snapshot(
-                out_s,
-                now=now,
-                layout=cfg.layout,
-                groups=cfg.num_groups,
-                ways=cfg.ways,
-                bytes_per_slot=bps,
-                thresholds=self._census_thresholds,
-                heatmap_width=int(cfg.census_heatmap_width),
-            ),
-            "replica": _census_tier_snapshot(
-                out_r,
-                now=now,
-                layout=cfg.layout,
-                groups=self.num_rgroups,
-                ways=cfg.replica_ways,
-                bytes_per_slot=bps,
-                thresholds=self._census_thresholds,
-                heatmap_width=int(cfg.census_heatmap_width),
-            ),
-        }
-        snap = _census_combine(tiers, primary="sharded")
-        if self._paging_requested:
-            # Same section the paged DeviceEngine fills from its Pager:
-            # an operator who set GUBER_TABLE_PAGE_* sees WHY there is
-            # no resident/host breakdown instead of a silent absence.
-            snap["pages"] = {"enabled": False, "paging": "unsupported (flat)"}
-        return snap
-
-    def _admission_scan(self) -> dict:
-        """One admission pass over both tiers (called by
-        admission_snapshot with _admission_lock held): dispatch both
-        non-donating programs under the engine lock, materialize after
-        release. A key lives in exactly one tier (GLOBAL keys count in
-        the replica tier, everything else in the sharded table), so the
-        combine's additive sums stay a true fleet count."""
-        now = self.now_fn()
-        with self._lock:
-            out_s = self._admission_sharded(self.table, now)
-            out_r = self._admission_replica(self.ici_state.table, now)
-        with _transfer.account(self.metrics, "d2h", "admission") as tx:
-            tiers = {
-                "sharded": _admission_tier_dict(out_s),
-                "replica": _admission_tier_dict(out_r),
-            }
-            tx.add(out_s)
-            tx.add(out_r)
-        snap = _admission_combine(tiers)
-        snap["now_ms"] = now
-        return snap
-
-    def debug_snapshot(self) -> dict:
-        snap = super().debug_snapshot()
-        if self._paging_requested:
-            snap["paging"] = "unsupported (flat)"
-        return snap
-
-    def close(self) -> None:
+    def close(self) -> None:  # guberlint: allow-engine-core-drift -- adds the sync-thread teardown around the core's close; all drain logic stays super()'s
         self._stop_sync.set()
         super().close()
         self._sync_thread.join(timeout=5)
 
-    # -- warmup / sync loop --------------------------------------------------
-
-    def _warmup(self) -> None:
-        now = self.now_fn()
-        wb = RequestBatch.zeros(self.cfg.batch_size)
-        with _transfer.account(self.metrics, "d2h", "warmup") as tx:
-            self.table, out = self._decide(self.table, wb, now)
-            tx.add(np.asarray(out.status))
-            home = np.zeros(self.cfg.batch_size, dtype=np.int64)
-            self.ici_state, out2 = self._replica(
-                self.ici_state, wb, home, now
-            )
-            tx.add(np.asarray(out2.status))
-            self.ici_state, _diag = self._sync(self.ici_state, now)
-            if self._sync_full is not None:
-                # Warm the backstop program too — its first forced tick
-                # must not pay a cold compile on the 100ms cadence.
-                self.ici_state, _diag = self._sync_full(self.ici_state, now)
-            # Census compiles here for both tiers: the first /metrics or
-            # /debug/table scrape must dispatch warm programs, not
-            # compile.
-            cs = self._census_sharded(self.table, now)
-            cr = self._census_replica(self.ici_state.table, now)
-            tx.add(np.asarray(cs.live))  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
-            tx.add(np.asarray(cr.live))  # guberlint: allow-host-sync -- warmup: compile both census programs before serving
-            # Admission accounting likewise, both tiers.
-            ads = self._admission_sharded(self.table, now)
-            adr = self._admission_replica(self.ici_state.table, now)
-            tx.add(np.asarray(ads.keys))  # guberlint: allow-host-sync -- warmup: compile both admission programs before serving
-            tx.add(np.asarray(adr.keys))  # guberlint: allow-host-sync -- warmup: compile both admission programs before serving
-        # Final fence: __init__ returns with every program compiled and
-        # the replica state resident.
-        jax.block_until_ready(self.ici_state.pending)
+    # -- sync loop -------------------------------------------------------------
 
     def _sync_loop(self) -> None:
         while not self._stop_sync.wait(self.cfg.sync_wait_s):
@@ -728,206 +308,3 @@ class IciEngine(EngineBase):
                         "GLOBAL ICI sync tick failed (%d consecutive)",
                         self._sync_errors,
                     )
-
-    # -- flush processing ----------------------------------------------------
-
-    def _dispatch(self, items):
-        """Pipeline stage 1 (both ici tiers): assemble + encode on host,
-        launch the sharded SPMD waves then the replica waves without a
-        host sync. Returns (carry, ticket) for _complete."""
-        t0 = time.perf_counter()
-        now = self.now_fn()
-        cfg = self.cfg
-        B = cfg.batch_size
-        GLOBAL = int(Behavior.GLOBAL)
-
-        # Hash once; derive each path's index from lo (group/slot are just
-        # lo mod geometry). One-shot tolist: per-item numpy scalar boxing
-        # dominated this loop.
-        keys = [req.hash_key() for req, _ in items]
-        hi_a, lo_a, grp_a = key_hash128_batch(keys, cfg.num_groups)
-        hi_l, lo_l, grp_l = hi_a.tolist(), lo_a.tolist(), grp_a.tolist()
-
-        sharded_asm = _WaveAssembler(RequestBatch.zeros, B)
-        replica_asm = _WaveAssembler(RequestBatch.zeros, B)
-        replica_homes: List[np.ndarray] = []
-        placements: List[Optional[Tuple[str, int, int]]] = []
-
-        carry = []
-        for i, (req, fut) in enumerate(items):
-            hi, lo = hi_l[i], lo_l[i]
-            try:
-                if not (req.behavior & GLOBAL):
-                    grp = grp_l[i]
-                    placed = sharded_asm.place(grp, cfg.max_waves)
-                    if placed is None:
-                        carry.append((req, fut))
-                        placements.append("carry")
-                        continue
-                    wb, w, lane = placed
-                    encode_one(wb, lane, req, now, cfg.num_groups, key=(hi, lo))
-                    sharded_asm.commit(w, grp)
-                    placements.append(("s", w, lane, hi, lo))
-                else:
-                    slot = group_of(lo, self.num_rgroups)
-                    home = self._home_rr % self.n_dev
-                    placed = replica_asm.place((home, slot), cfg.max_waves)
-                    if placed is None:
-                        carry.append((req, fut))
-                        placements.append("carry")
-                        continue
-                    self._home_rr += 1  # only consumed on placement
-                    wb, w, lane = placed
-                    encode_one(wb, lane, req, now, self.num_rgroups, key=(hi, lo))
-                    while len(replica_homes) < len(replica_asm.waves):
-                        replica_homes.append(np.zeros(B, dtype=np.int64))
-                    replica_homes[w][lane] = home
-                    replica_asm.commit(w, (home, slot))
-                    placements.append(("r", w, lane, hi, lo))
-            except EncodeError as e:
-                fut.set_result(RateLimitResp(error=str(e)))
-                placements.append(None)
-                continue
-
-        # Execute: sharded waves then replica waves. On failure keep the
-        # surviving intermediates and rebuild any consumed donated table
-        # (the futures resolve with errors; nothing replays this flush).
-        s_out, r_out = [], []
-        waves_total = len(sharded_asm.waves) + len(replica_asm.waves)
-        seq = self._flush_seq()
-        fspan = self._start_flush_span(
-            items, seq, path="object", layout=cfg.layout,
-            items=len(items), waves=waves_total,
-            batch_width=len(items) - len(carry),
-        )
-        _telemetry.set_shape_hint(f"{cfg.layout}:ici-object:B{B}")
-        t_dev = time.perf_counter()
-        try:
-            with self._lock, _telemetry.serving_scope(
-                self.metrics
-            ), tracing.use_span_ctx(fspan):
-                table = self.table
-                state = self.ici_state
-                try:
-                    for wb in sharded_asm.waves:
-                        table, out = self._decide(table, wb, now)
-                        s_out.append(out)
-                    for wb, hm in zip(replica_asm.waves, replica_homes):
-                        state, out = self._replica(state, wb, hm, now)
-                        r_out.append(out)
-                except Exception:
-                    self.table = table
-                    self.ici_state = state
-                    self._recover_tables_locked()
-                    raise
-                self.table = table
-                self.ici_state = state
-        except Exception as e:
-            tracing.end_span(fspan, error=e)
-            raise
-
-        return carry, _FlushTicket(
-            items=items, placements=placements, outs=s_out, r_outs=r_out,
-            served=len(items) - len(carry), carry_n=len(carry),
-            waves=waves_total, widths=[B] * waves_total,
-            t0=t0, t_dev=t_dev, seq=seq, span=fspan,
-            otel_ctx=tracing.context_of(fspan),
-            trace_id=tracing.trace_id_of(fspan),
-        )
-
-    def _complete(self, t) -> None:
-        """Pipeline stage 2: materialize both tiers' wave outputs, feed
-        telemetry, resolve futures (FIFO dispatch order when
-        pipelined)."""
-        cfg = self.cfg
-        t_c0 = time.perf_counter()
-        host = {
-            "s": [_materialize_out(o) for o in t.outs],
-            "r": [_materialize_out(o) for o in t.r_outs],
-        }
-        t_sync = time.perf_counter()
-        dev_s = t_sync - t.t_dev
-        # Transfer ledger: the serve-path d2h readback (blocking sync).
-        _transfer.record(
-            self.metrics, "d2h", "serve", _transfer.nbytes(host),
-            t_sync - t_c0,
-        )
-        tots = [0, 0, 0, 0]
-        for path in host.values():
-            for h in path:
-                for j in range(4):
-                    tots[j] += h[4 + j]
-        dur = time.perf_counter() - t.t0
-        em = self.metrics
-        trace_id = (t.trace_id or "") if cfg.exemplars else ""
-        em.observe(tots[0], tots[1], tots[2], tots[3], t.waves, t.served, dur)
-        em.observe_flush("object", t.served, t.waves, dur, dev_s, trace_id)
-        em.observe_stage("assemble", t.t_dev - t.t0)
-        em.observe_stage("dispatch", t.t_disp_end - t.t_dev)
-        em.observe_stage("inflight_wait", max(t_c0 - t.t_disp_end, 0.0))
-        em.observe_stage("device_sync", t_sync - t_c0)
-        em.recorder.record(
-            path="object", layout=cfg.layout, n=t.served, waves=t.waves,
-            carry=t.carry_n, widths=t.widths,
-            dur_us=int(dur * 1e6), dev_us=int(dev_s * 1e6),
-            ticket=t.seq, trace_id=t.trace_id or "",
-        )
-
-        stage_base = None
-        if self._stage_md:
-            stage_base = (
-                f"assemble={int((t.t_dev - t.t0) * 1e6)}"
-                f",dispatch={int((t.t_disp_end - t.t_dev) * 1e6)}"
-                f",inflight_wait={int(max(t_c0 - t.t_disp_end, 0.0) * 1e6)}"
-                f",device_sync={int((t_sync - t_c0) * 1e6)}"
-            )
-        hk = em.hotkeys if em.hotkeys.k > 0 else None
-        hk_agg = {}
-        OVER = 1  # api.types.Status.OVER_LIMIT
-        for (req, fut), place in zip(t.items, t.placements):
-            if place is None or place == "carry":
-                continue
-            path, w, lane = place[0], place[1], place[2]
-            st, rem, rst, lim = host[path][w][:4]
-            status = int(st[lane])  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
-            if hk is not None:
-                k = (place[3], place[4])
-                ent = hk_agg.get(k)
-                if ent is None:
-                    hk_agg[k] = [
-                        max(int(req.hits), 0), int(status == OVER),
-                        req.hash_key(),
-                    ]
-                else:
-                    ent[0] += max(int(req.hits), 0)
-                    ent[1] += int(status == OVER)
-            md = None
-            if stage_base is not None:
-                t_enq = getattr(fut, "t_enq", None)
-                md = {
-                    "stage_breakdown_us": (
-                        f"queue={int((t.t0 - t_enq) * 1e6)},{stage_base}"
-                        if t_enq is not None
-                        else stage_base
-                    )
-                }
-            fut.set_result(
-                RateLimitResp(
-                    status=status,
-                    limit=int(lim[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
-                    remaining=int(rem[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
-                    reset_time=int(rst[lane]),  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
-                    **({"metadata": md} if md else {}),
-                )
-            )
-        if hk is not None and hk_agg:
-            hk.update([(k, v[0], v[1], v[2]) for k, v in hk_agg.items()])
-        em.observe_stage("resolve", time.perf_counter() - t_sync)
-        self._observe_overlap(t)
-
-    def _recover_after_failure(self) -> bool:
-        """Completion-stage recovery entry (EngineBase._ticket_failed):
-        rebuild whichever tier's donated state the failed flush consumed
-        or poisoned, at most once."""
-        with self._lock:
-            return self._recover_tables_locked()

@@ -61,11 +61,37 @@ class Pager:
       free:      physical frames not bound to any logical page
       touch:     per-logical-page monotonic touch tick (LRU victims)
       host_tier: lp -> {field: np.ndarray(page_slots,)} wide rows
-    """
 
-    def __init__(self, kernels, metrics=None):
+    n_shards > 1 runs the SAME bookkeeping over a mesh-sharded physical
+    table (parallel/mesh.make_mesh_kernels): the physical frames split
+    into n_shards contiguous per-device pools, a logical page only ever
+    binds a frame in its own shard's pool (its groups' owner device),
+    and victim selection / the background free target apply PER SHARD —
+    so each device's HBM pages its own keys and one shard's pressure
+    never evicts another shard's residents. The host tier is keyed by
+    logical page either way; `shard_of_page` gives the per-shard
+    breakdown for observability."""
+
+    def __init__(self, kernels, metrics=None, *, n_shards: int = 1):
         self.PK = kernels
         self.metrics = metrics
+        if n_shards <= 0:
+            raise ValueError(f"n_shards must be >= 1: {n_shards}")
+        if n_shards > 1:
+            if kernels.num_phys_pages % n_shards:
+                raise ValueError(
+                    f"page budget {kernels.num_phys_pages} must divide by "
+                    f"mesh size {n_shards} (equal per-shard frame pools)"
+                )
+            if kernels.num_logical_pages % n_shards:
+                raise ValueError(
+                    f"logical page count {kernels.num_logical_pages} must "
+                    f"divide by mesh size {n_shards} (pages must not "
+                    "straddle shard boundaries)"
+                )
+        self.n_shards = n_shards
+        self.frames_per_shard = kernels.num_phys_pages // n_shards
+        self.pages_per_shard = -(-kernels.num_logical_pages // n_shards)
         self.page_map = np.full(
             kernels.num_logical_pages, -1, dtype=np.int32
         )
@@ -76,6 +102,16 @@ class Pager:
         self.demotes = 0
         self.promotes = 0
         self.binds = 0
+
+    # ---- shard geometry ----------------------------------------------------
+
+    def shard_of_page(self, lp: int) -> int:
+        """Owner shard of a logical page (0 on a single chip)."""
+        return int(lp) // self.pages_per_shard
+
+    def shard_of_frame(self, pp: int) -> int:
+        """Owner shard of a physical frame (0 on a single chip)."""
+        return int(pp) // self.frames_per_shard
 
     # ---- residency queries -------------------------------------------------
 
@@ -142,19 +178,40 @@ class Pager:
                 table = self._promote_one(table, lp, protect)
         return table
 
+    def acquire_frame(self, lp: int) -> Optional[int]:
+        """Pop a free frame eligible to hold logical page `lp` — any
+        frame on one chip, the page's own shard pool on a mesh. None
+        when the (per-shard) pool is dry. Every bind site (promote AND
+        the engine's paged restore) goes through this one gate so shard
+        placement can never be bypassed."""
+        if self.n_shards == 1:
+            return self.free.pop() if self.free else None
+        shard = self.shard_of_page(lp)
+        for i in range(len(self.free) - 1, -1, -1):
+            if self.shard_of_frame(self.free[i]) == shard:
+                return self.free.pop(i)
+        return None
+
     def _promote_one(self, table, lp: int, protect: Set[int]):
-        if self.free:
-            pp = self.free.pop()
-        else:
-            victim = self._coldest_resident(protect)
+        pp = self.acquire_frame(lp)
+        if pp is None:
+            victim = self._coldest_resident(
+                protect, shard=self.shard_of_page(lp)
+            )
             if victim is None:
+                budget = (
+                    self.frames_per_shard
+                    if self.n_shards > 1
+                    else self.PK.num_phys_pages
+                )
                 raise PageBudgetError(
-                    f"page budget {self.PK.num_phys_pages} cannot hold "
-                    f"{len(protect)} distinct pages touched by one wave; "
-                    "raise GUBER_TABLE_PAGE_BUDGET"
+                    f"page budget {budget}"
+                    + (f" (per shard, x{self.n_shards})" if self.n_shards > 1 else "")
+                    + f" cannot hold {len(protect)} distinct pages touched "
+                    "by one wave; raise GUBER_TABLE_PAGE_BUDGET"
                 )
             table = self.demote(table, victim)
-            pp = self.free.pop()
+            pp = self.acquire_frame(lp)
         rows = self.host_tier.pop(lp, None)
         if rows is None:
             table = self.PK.bind_page(table, np.int32(lp), np.int32(pp))
@@ -191,9 +248,16 @@ class Pager:
         self.demotes += 1
         return table
 
-    def _coldest_resident(self, protect: Set[int]) -> Optional[int]:
+    def _coldest_resident(
+        self, protect: Set[int], shard: Optional[int] = None
+    ) -> Optional[int]:
         resident = np.nonzero(self.page_map >= 0)[0]
-        candidates = [lp for lp in resident.tolist() if lp not in protect]
+        candidates = [
+            lp
+            for lp in resident.tolist()
+            if lp not in protect
+            and (shard is None or self.shard_of_page(lp) == shard)
+        ]
         if not candidates:
             return None
         return min(candidates, key=lambda lp: int(self.touch[lp]))  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
@@ -222,15 +286,22 @@ class Pager:
         return out
 
     def _pick_victim(
-        self, coldness: Optional[Dict[int, float]]
+        self,
+        coldness: Optional[Dict[int, float]],
+        shard: Optional[int] = None,
     ) -> Optional[int]:
         """Demoter victim: census-coldest resident page first, LRU touch
         tick as the tiebreak (and the whole ordering when no census
         coldness is available). The census sees what touch ticks cannot:
         a single probe re-warms a page's tick while the census still
         counts every other slot on it as idle — such a hot-touched but
-        census-cold page should go before a genuinely busy one."""
-        resident = np.nonzero(self.page_map >= 0)[0].tolist()
+        census-cold page should go before a genuinely busy one. With
+        `shard` set, only that shard's residents are candidates."""
+        resident = [
+            lp
+            for lp in np.nonzero(self.page_map >= 0)[0].tolist()
+            if shard is None or self.shard_of_page(lp) == shard
+        ]
         if not resident:
             return None
         cold = coldness or {}
@@ -249,20 +320,35 @@ class Pager:
         touched within that many ensure_resident rounds are spared
         UNLESS the census marks them cold (the census is the stronger
         signal: it counts idle slots, a touch tick only remembers the
-        last probe). Returns the updated table."""
-        while len(self.free) < want_free:
-            victim = self._pick_victim(coldness)
-            if victim is None:
-                break
-            census_cold = bool(coldness) and coldness.get(victim, 0.0) > 0
-            if (
-                not census_cold
-                and min_idle_ticks > 0
-                and self._tick - int(self.touch[victim]) < min_idle_ticks  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
-            ):
-                break  # everything left is too recently touched
-            table = self.demote(table, victim)
+        last probe). On a mesh the target applies PER SHARD: every
+        shard's frame pool is driven to `want_free` free frames from its
+        own residents, so one busy shard cannot starve another's pool.
+        Returns the updated table."""
+        for shard in range(self.n_shards):
+            while self._free_in_shard(shard) < want_free:
+                victim = self._pick_victim(
+                    coldness, shard=shard if self.n_shards > 1 else None
+                )
+                if victim is None:
+                    break
+                census_cold = (
+                    bool(coldness) and coldness.get(victim, 0.0) > 0
+                )
+                if (
+                    not census_cold
+                    and min_idle_ticks > 0
+                    and self._tick - int(self.touch[victim]) < min_idle_ticks  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
+                ):
+                    break  # everything left is too recently touched
+                table = self.demote(table, victim)
         return table
+
+    def _free_in_shard(self, shard: int) -> int:
+        if self.n_shards == 1:
+            return len(self.free)
+        return sum(
+            1 for pp in self.free if self.shard_of_frame(pp) == shard
+        )
 
     def reset(self) -> None:
         """Post-recovery zeroing: the engine rebuilt an empty paged
@@ -291,6 +377,29 @@ class Pager:
             "promotes": self.promotes,
             "binds": self.binds,
         }
+        if self.n_shards > 1:
+            # Per-shard residency/pressure breakdown (docs/monitoring.md
+            # "pages.shards"): each shard pages independently, so a
+            # healthy aggregate can hide one starved pool.
+            shards = []
+            for s in range(self.n_shards):
+                p0, p1 = s * self.pages_per_shard, (s + 1) * self.pages_per_shard
+                res = int((self.page_map[p0:p1] >= 0).sum())
+                host = sum(
+                    1
+                    for lp in self.host_tier
+                    if self.shard_of_page(lp) == s
+                )
+                shards.append(
+                    {
+                        "resident": res,
+                        "free": self._free_in_shard(s),
+                        "host": host,
+                    }
+                )
+            snap["n_shards"] = self.n_shards
+            snap["frames_per_shard"] = self.frames_per_shard
+            snap["shards"] = shards
         if nlp <= 4096:  # bounded debug payload
             snap["page_map"] = self.page_map.tolist()
         return snap

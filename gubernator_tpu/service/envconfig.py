@@ -331,6 +331,14 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             full_tick_every=_env_int(
                 "GUBER_ICI_FULL_TICK_EVERY", base.full_tick_every
             ),
+            # Paged sharded tier: same GUBER_TABLE_PAGE_* knobs as the
+            # single-chip engine (the unified core pages both; the page
+            # map replicates across the mesh, frames shard, and each
+            # shard runs its own pool + host-DRAM cold tier).
+            page_groups=conf.page_groups,
+            page_budget=conf.page_budget,
+            page_demote_interval_s=conf.page_demote_interval_s,
+            page_free_target=conf.page_free_target,
         )
 
     # Static peers: GUBER_STATIC_PEERS=grpc1|http1|dc1,grpc2|http2|dc2

@@ -765,12 +765,14 @@ class V1Service:
         blob["bound"] = bound
         return blob
 
-    def _admission_sync(self) -> None:
+    def _admission_sync(self, _metrics=None) -> None:
         """Scrape-time bridge: publish this node's measured over-admission
         ratio (excess hits / configured limit over active windows, from
         the engine's TTL-cached admission scan). Single writer for
         gubernator_admission_excess_ratio — the auditor's fleet-max lives
-        in a separate gauge (admission_audit_max_excess_ratio)."""
+        in a separate gauge (admission_audit_max_excess_ratio).
+        Metrics.sync() passes the Metrics instance to every callback;
+        this bound method already closes over self.metrics."""
         if not hasattr(self.engine, "admission_snapshot"):
             return
         try:

@@ -1,0 +1,37 @@
+"""GL013 fixture: topology shells re-forking the unified engine core.
+
+Scanned only when passed explicitly (see tools/lint/rules.py
+_FIXTURE_PREFIX); the path maps to gubernator_tpu/runtime/ so the
+shell-file predicate fires.
+"""
+
+
+class ShadowEngine:
+    # VIOLATION: _dispatch is the core's placement/encode stage
+    def _dispatch(self, items, now):
+        return items
+
+    # VIOLATION: _complete is the core's demux/ticket stage
+    def _complete(self, ticket):
+        return ticket
+
+    # VIOLATION: pragma without a reason still fails (requires_reason)
+    def _execute_waves(self, waves):  # guberlint: allow-engine-core-drift
+        return waves
+
+    # ok: reasoned pragma — witnessed-intentional shell delta
+    def close(self):  # guberlint: allow-engine-core-drift -- fixture: teardown wrapper around super().close()
+        pass
+
+    # ok: dunders never fire
+    def __init__(self):
+        pass
+
+    # ok: not a core method name
+    def sync_now(self):
+        pass
+
+
+# ok: module-level function, not a class method
+def _dispatch(items):
+    return items

@@ -100,7 +100,7 @@ def test_engine_forces_full_tick_every_n_and_counts():
     )
     eng = IciEngine(cfg)
     try:
-        assert eng._sync_full is not None
+        assert eng._rtier.sync_full is not None
         assert eng.full_ticks == 0
         for _ in range(3):
             eng.sync_now()
@@ -136,7 +136,7 @@ def test_engine_skips_backstop_when_uncapped():
     )
     eng = IciEngine(cfg)
     try:
-        assert eng._sync_full is None
+        assert eng._rtier.sync_full is None
         eng.sync_now()
         assert eng.full_ticks == 0
     finally:

@@ -295,41 +295,65 @@ def test_replica_capacity_pressure_no_cross_key_credit():
         eng.close()
 
 
-def test_paging_request_serves_flat_with_single_warning(caplog):
-    """GUBER_TABLE_PAGE_GROUPS on the ici engine: serve flat, say so —
-    one process-wide warning, and an explicit `paging: "unsupported
-    (flat)"` marker in /debug/engine and the census pages section
-    (a silent absence would read as "paging is off on purpose")."""
+def test_paging_on_ici_serves_per_shard(caplog):
+    """GUBER_TABLE_PAGE_GROUPS on the ici engine binds the paged mesh
+    kernels (replicated page map, owner-sharded frames, one frame pool
+    per shard): decisions are bit-exact with a flat ici twin, the census
+    pages section reports the per-shard breakdown, and the pre-unification
+    serve-flat warning is GONE."""
     import logging
 
-    IciEngine._paging_warned = False  # isolate from other tests
-    cfg = IciEngineConfig(
-        num_groups=1 << 7, num_slots=1 << 9, batch_size=16,
-        sync_wait_s=3600, page_groups=32,
+    clock = {"now": NOW}
+    n_dev = len(__import__("jax").devices())
+    flat_cfg = IciEngineConfig(
+        num_groups=1 << 9, num_slots=1 << 11, batch_size=64,
+        batch_wait_s=0.002, sync_wait_s=3600,
+    )
+    # 512 groups / 32 per page -> 16 logical pages (2/shard at 8 devices);
+    # budget 16 frames -> every page bindable (demand-paged CHURN parity
+    # is pinned separately in tests/test_mesh_engine.py).
+    paged_cfg = dataclasses.replace(
+        flat_cfg, page_groups=32, page_budget=16,
+        page_demote_interval_s=0,
     )
     with caplog.at_level(logging.WARNING, logger="gubernator_tpu.ici"):
-        eng = IciEngine(cfg, now_fn=lambda: NOW)
-        try:
-            assert eng.debug_snapshot()["paging"] == "unsupported (flat)"
-            census = eng.table_census(max_age_s=0)
-            assert census["pages"] == {
-                "enabled": False, "paging": "unsupported (flat)",
-            }
-        finally:
-            eng.close()
-        # second construction in the same process: the latch holds
-        eng2 = IciEngine(cfg, now_fn=lambda: NOW)
-        eng2.close()
-    warns = [r for r in caplog.records if "not yet implemented" in r.message]
-    assert len(warns) == 1, [r.message for r in warns]
-
-    # without page_groups the markers must be absent entirely
-    flat_cfg = IciEngineConfig(
-        num_groups=1 << 7, num_slots=1 << 9, batch_size=16, sync_wait_s=3600,
-    )
-    eng3 = IciEngine(flat_cfg, now_fn=lambda: NOW)
+        flat = IciEngine(flat_cfg, now_fn=lambda: clock["now"])
+        paged = IciEngine(paged_cfg, now_fn=lambda: clock["now"])
     try:
-        assert "paging" not in eng3.debug_snapshot()
-        assert "pages" not in eng3.table_census(max_age_s=0)
+        import random
+
+        rng = random.Random(23)
+        for _ in range(4):
+            reqs = [
+                mk(
+                    f"pk{rng.randrange(64)}",
+                    hits=rng.choice([0, 1, 2]),
+                    behavior=rng.choice([0, int(Behavior.GLOBAL)]),
+                )
+                for _ in range(rng.randrange(1, 24))
+            ]
+            want = flat.check_batch([dataclasses.replace(r) for r in reqs])
+            got = paged.check_batch([dataclasses.replace(r) for r in reqs])
+            for w, g in zip(want, got):
+                assert (g.status, g.remaining, g.reset_time) == (
+                    w.status, w.remaining, w.reset_time,
+                )
+        census = paged.table_census(max_age_s=0)
+        pages = census["pages"]
+        assert pages["enabled"] is True
+        if n_dev > 1:
+            assert pages["n_shards"] == n_dev
+            assert len(pages["shards"]) == n_dev
+            # every shard's pool is independently live
+            assert all(
+                s["resident"] + s["free"] + s["host"] > 0
+                for s in pages["shards"]
+            )
+        # flat twin carries no pages section at all
+        assert "pages" not in flat.table_census(max_age_s=0)
     finally:
-        eng3.close()
+        flat.close()
+        paged.close()
+    assert not [
+        r for r in caplog.records if "not yet implemented" in r.message
+    ], "serve-flat warning must be gone"

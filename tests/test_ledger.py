@@ -329,3 +329,18 @@ def test_runner_archives_results_to_ledger(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_infer_mode_layout_mesh_keys():
+    """mesh_ab ledger keys (ISSUE 15): the comparison row keys as
+    mesh_ab, per-width cell rows as mesh, and the longest-prefix
+    ordering keeps bench_mesh_ab_n8 from keying as ici or mesh."""
+    from gubernator_tpu.utils import ledger
+
+    assert ledger.infer_mode_layout("bench_mesh_ab") == ("mesh_ab", "")
+    assert ledger.infer_mode_layout("bench_mesh_ab_n8") == ("mesh_ab", "")
+    # job 39's runner-side inference: "mesh" (the scaling cells), with
+    # no layout pinned — comparable rows match on platform alone.
+    assert ledger.infer_mode_layout("39_mesh_scaling") == ("mesh", "")
+    # the pre-existing ici mode must not swallow mesh rows
+    assert ledger.infer_mode_layout("26_ici_sync") == ("ici", "")

@@ -65,7 +65,7 @@ def test_registry_complete():
     codes = {r.code for r in REGISTRY}
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
+        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
     }
 
 
@@ -168,6 +168,13 @@ _CASES = [
         {"'serve_unstamped'", "'serve_unstamped_over'"},
         3,  # 2 unstamped answers + 1 reason-less pragma; error=/stamped/
             # recorded/reasoned-pragma sites don't fire
+    ),
+    (
+        "GL013",
+        fixture("runtime", "gl013_core_drift.py"),
+        {"'ShadowEngine._dispatch'", "'ShadowEngine._complete'"},
+        3,  # 2 shadows + 1 reason-less pragma; reasoned-pragma close,
+            # dunders, non-core names, module-level defs don't fire
     ),
 ]
 

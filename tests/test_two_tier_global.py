@@ -16,14 +16,16 @@ from gubernator_tpu.service import pb
 from gubernator_tpu.service.config import BehaviorConfig, DaemonConfig
 from gubernator_tpu.service.daemon import Daemon
 
-# Quarantined: the two-pod ici cluster intermittently hangs at spawn
-# (collective init under 8 virtual devices), which used to eat the
-# whole tier-1 870s budget. slow keeps it out of tier-1, flaky lets CI
-# run the quarantine lane explicitly (-m flaky), and the deadline
-# watchdog turns any residual hang into a bounded failure.
+# Back in tier-1: the intermittent spawn hang was two engines in one
+# process interleaving their multi-device collective enqueues onto the
+# same 8 virtual devices (cross-program rendezvous deadlock). Every
+# dispatch now runs under the process-wide collective guard
+# (parallel/mesh.collective_guard, taken inside the engine table lock),
+# which serializes whole programs and makes the interleaving
+# impossible. The deadline watchdog stays as a regression tripwire —
+# a reintroduced unguarded dispatch fails bounded instead of eating
+# the tier-1 budget.
 pytestmark = [
-    pytest.mark.slow,
-    pytest.mark.flaky,
     pytest.mark.deadline(300),
 ]
 

@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL012.
+"""guberlint rule set GL000-GL013.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -1059,6 +1059,95 @@ class GL012DecisionProvenance(Rule):
                     f"provenance:{fn}",
                 )
             )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL013 — engine-core-drift: topology shells must not re-fork the core.
+
+# Files allowed to SUBCLASS / parameterize MeshEngine: a method defined
+# here whose name shadows a core method re-forks logic the unification
+# collapsed (the pre-PR-15 state was ~800 duplicated LoC whose halves
+# drifted independently).
+_CORE_SHELL_FILES = (
+    "gubernator_tpu/runtime/ici_engine.py",
+    "gubernator_tpu/runtime/topology.py",
+    # fixture twin — only ever scanned when passed explicitly
+    # (tests/lint_fixtures/; the default roots never include tests/)
+    "gubernator_tpu/runtime/gl013_core_drift.py",
+)
+_CORE_FILE = "gubernator_tpu/runtime/engine.py"
+_CORE_CLASSES = ("EngineBase", "MeshEngine")
+
+_core_methods_cache: Optional[Set[str]] = None
+
+
+def engine_core_methods() -> Set[str]:
+    """Method names of the unified engine core (EngineBase + MeshEngine
+    in runtime/engine.py), dunders excluded. Parsed from disk so the
+    rule works on partial scans (fixtures); cached per process."""
+    global _core_methods_cache
+    if _core_methods_cache is None:
+        with open(
+            os.path.join(REPO_ROOT, _CORE_FILE), encoding="utf-8"
+        ) as f:
+            tree = ast.parse(f.read())
+        names: Set[str] = set()
+        for node in tree.body:
+            if (
+                isinstance(node, ast.ClassDef)
+                and node.name in _CORE_CLASSES
+            ):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and not item.name.startswith("__"):
+                        names.add(item.name)
+        _core_methods_cache = names
+    return _core_methods_cache
+
+
+class GL013EngineCoreDrift(Rule):
+    code = "GL013"
+    name = "engine-core-drift"
+    description = (
+        "a method defined in a topology shell (runtime/ici_engine.py, "
+        "runtime/topology.py) whose name shadows a MeshEngine core "
+        "method (runtime/engine.py) re-forks dispatch/complete/recovery "
+        "logic the engine unification collapsed — move the delta into "
+        "the core or the strategy object (see runtime/topology.py "
+        "docstring), or carry an allow-engine-core-drift pragma with a "
+        "reason"
+    )
+    requires_reason = True
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if scan_path(mod.relpath) not in _CORE_SHELL_FILES:
+            return []
+        core = engine_core_methods()
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if not isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if item.name.startswith("__") or item.name not in core:
+                    continue
+                out.append(
+                    self.finding(
+                        mod.relpath,
+                        item.lineno,
+                        f"'{node.name}.{item.name}' shadows the unified "
+                        f"engine core's '{item.name}' "
+                        f"(runtime/engine.py) — fold the delta into the "
+                        f"core or the topology strategy instead of "
+                        f"re-forking it",
+                        f"core-drift:{node.name}.{item.name}",
+                    )
+                )
         return out
 
 
