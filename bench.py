@@ -1032,7 +1032,8 @@ def main() -> None:
     parser.add_argument(
         "--mode", default="kernel",
         choices=("kernel", "engine", "engine_ab", "server", "global",
-                 "kernel10m", "latency", "ici", "edge", "ab", "mesh_ab"),
+                 "kernel10m", "latency", "ici", "edge", "ab", "mesh_ab",
+                 "kernel_ab"),
         help="kernel: device decide throughput @1M keys (headline); "
         "engine: end-to-end host+device serving path; "
         "engine_ab: serial (depth 1) vs pipelined (depth 2) engine A/B, "
@@ -1047,7 +1048,10 @@ def main() -> None:
         "ab: --layout vs fused decide-throughput A/B at the 2M- and "
         "16M-slot geometries, comparison rows ledgered; "
         "mesh_ab: single-chip vs mesh unified-core A/B (fresh process "
-        "per cell), comparison row ledgered",
+        "per cell), comparison row ledgered; "
+        "kernel_ab: GUBER_KERNEL pallas-vs-xla decide backend A/B at "
+        "identical geometry/layout (fresh process per cell), "
+        "comparison row ledgered",
     )
     parser.add_argument(
         "--layout", default=None,
@@ -1151,6 +1155,9 @@ def main() -> None:
         return
     if args.mode == "mesh_ab":
         emit(bench_mesh_ab())
+        return
+    if args.mode == "kernel_ab":
+        emit(bench_kernel_ab(layout=args.layout))
         return
     emit(bench_kernel(args.mode, args.layout))
 
@@ -1395,6 +1402,112 @@ def bench_ab(
             "vs_baseline": round(ratio, 3),
         }
         ledger.append(row, job=f"bench_ab_{mode}", mode="ab", layout=cand)
+        print("RESULT " + json.dumps(row), flush=True)
+        if headline is None:
+            headline = row
+    return headline or {}
+
+
+def _bench_kernel_fresh_backend(mode: str, layout: str, backend: str) -> dict:
+    """bench_kernel under GUBER_KERNEL=<backend> in a FRESH interpreter.
+    The backend is resolved at kernel-registry build time, so it MUST be
+    injected via the child's environment before the child imports
+    anything — and the same process-isolation argument as
+    _bench_kernel_fresh applies (cells must not share allocator or jit
+    warmth). Falls back to an in-process run with the env var set (the
+    TPU-relay posture: the device is held by this process)."""
+    import subprocess
+    import sys
+
+    script = (
+        "import json\n"
+        "import bench\n"
+        f"r = bench.bench_kernel({mode!r}, {layout!r})\n"
+        "print('RESULT ' + json.dumps(r))\n"
+    )
+    env = dict(os.environ, GUBER_KERNEL=backend)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("RESULT "):
+                return json.loads(line[len("RESULT "):])
+        print(f"[bench] fresh-process {backend}/{mode}/{layout} gave no "
+              f"RESULT (rc={proc.returncode}); falling back in-process",
+              flush=True)
+    except Exception as e:
+        print(f"[bench] fresh-process {backend}/{mode}/{layout} failed "
+              f"({e!r}); falling back in-process", flush=True)
+    prior = os.environ.get("GUBER_KERNEL")
+    os.environ["GUBER_KERNEL"] = backend
+    try:
+        return bench_kernel(mode, layout)
+    finally:
+        if prior is None:
+            os.environ.pop("GUBER_KERNEL", None)
+        else:
+            os.environ["GUBER_KERNEL"] = prior
+
+
+def bench_kernel_ab(sizes=("kernel",), layout: str = "fused") -> dict:
+    """Pallas-vs-XLA decide backend A/B at identical geometry and
+    layout: the same seeded Zipf trace through GUBER_KERNEL=xla and
+    GUBER_KERNEL=pallas cells — each in a fresh process on CPU (the
+    backend binds at registry-build time, and cells must not share
+    warmth) — with one raw row per cell and one comparison row
+    (value = pallas/xla throughput ratio) ledgered per geometry. On a
+    TPU runner the pallas cells exercise the mosaic lowering; on CPU
+    they run the reference lowering (the same fused program XLA-lowered),
+    which is the honest non-TPU serving path, not interpret mode.
+    Returns the headline (first-geometry) comparison row."""
+    import jax
+
+    from gubernator_tpu.utils import ledger
+
+    platform = jax.devices()[0].platform
+    headline = None
+    for mode in sizes:
+        pair = {}
+        for backend in ("xla", "pallas"):
+            if platform == "cpu":
+                r = _bench_kernel_fresh_backend(mode, layout, backend)
+            else:
+                # A TPU is exclusively held by THIS process (bench_ab).
+                prior = os.environ.get("GUBER_KERNEL")
+                os.environ["GUBER_KERNEL"] = backend
+                try:
+                    r = bench_kernel(mode, layout)
+                finally:
+                    if prior is None:
+                        os.environ.pop("GUBER_KERNEL", None)
+                    else:
+                        os.environ["GUBER_KERNEL"] = prior
+            ledger.append(
+                r, job=f"bench_kernel_ab_{mode}_{backend}",
+                mode=mode, layout=layout,
+            )
+            print("RESULT " + json.dumps(r), flush=True)
+            pair[backend] = float(r["value"])
+        ratio = pair["pallas"] / max(pair["xla"], 1.0)
+        label = "16M" if mode == "kernel10m" else "2M"
+        row = {
+            "metric": (
+                f"pallas/xla decide backend A/B (kernel_ab, {layout}) "
+                f"@{label}-slot table ({mode}, {platform}); "
+                f"xla={pair['xla']:.0f} pallas={pair['pallas']:.0f} "
+                f"decisions/s"
+            ),
+            "value": round(ratio, 3),
+            "unit": "x",
+            "vs_baseline": round(ratio, 3),
+        }
+        ledger.append(
+            row, job=f"bench_kernel_ab_{mode}", mode="kernel_ab",
+            layout=layout,
+        )
         print("RESULT " + json.dumps(row), flush=True)
         if headline is None:
             headline = row

@@ -33,6 +33,8 @@ LAYOUTS = ("wide", "packed", "fused", "narrow")
 # for the field-by-field accounting).
 BYTES_PER_SLOT = {"wide": 83, "packed": 72, "fused": 80, "narrow": 72}
 
+import os
+
 from gubernator_tpu.ops.decide import (
     decide as _wd,
     decide_scan as _wds,
@@ -41,6 +43,27 @@ from gubernator_tpu.ops.decide import (
 )
 from gubernator_tpu.ops.inject import inject as _wi
 from gubernator_tpu.ops.layout import SlotTable
+
+# Decide-program backends (GUBER_KERNEL). "xla" is the grown fleet of
+# per-layout XLA programs; "pallas" routes the narrow/fused decide hot
+# path through the hand-written one-HBM-pass kernel
+# (ops/pallas_decide.py) with the XLA path kept as the fallback and the
+# bit-exactness oracle. Layouts pallas does not lower (wide/packed — the
+# diagnostic layouts) and all non-decide entry points stay on XLA.
+KERNEL_BACKENDS = ("xla", "pallas")
+
+
+def kernel_backend() -> str:
+    """Decide-program backend, read from GUBER_KERNEL at registry-build
+    time (engine/topology startup — NOT per decide call), so a built
+    `Kernels` facade is pinned to one backend and the warmed programs
+    are exactly the served programs."""
+    v = os.environ.get("GUBER_KERNEL", "xla").strip().lower() or "xla"
+    if v not in KERNEL_BACKENDS:
+        raise ValueError(
+            f"GUBER_KERNEL={v!r}: expected one of {KERNEL_BACKENDS}"
+        )
+    return v
 
 
 class Kernels(NamedTuple):
@@ -155,16 +178,38 @@ def _narrow():
     )
 
 
+def _pallas(layout: str, base: Kernels) -> Kernels:
+    """Reroute the decide hot path of `base` through the fused Pallas
+    program; every other entry point (inject, probes, snapshots) keeps
+    the XLA impls — they are not wave-rate paths."""
+    from gubernator_tpu.ops import pallas_decide as _pd
+
+    return base._replace(
+        decide=lambda table, batch, now, ways, with_store=False: (
+            _pd.decide_flat(table, batch, now, layout=layout, ways=ways)
+        ),
+        decide_scan=lambda table, batches, nows, ways, with_store=False: (
+            _pd.decide_scan_flat(
+                table, batches, nows, layout=layout, ways=ways
+            )
+        ),
+    )
+
+
 def get_kernels(layout: str) -> Kernels:
     if layout == "wide":
         return _WIDE
     if layout == "packed":
         return _packed()
     if layout == "fused":
-        return _fused()
-    if layout == "narrow":
-        return _narrow()
-    raise ValueError(f"unknown table layout: {layout!r}")
+        base = _fused()
+    elif layout == "narrow":
+        base = _narrow()
+    else:
+        raise ValueError(f"unknown table layout: {layout!r}")
+    if kernel_backend() == "pallas":
+        return _pallas(layout, base)
+    return base
 
 
 class RawKernels(NamedTuple):
@@ -269,7 +314,7 @@ def get_raw_kernels(layout: str) -> RawKernels:
     if layout == "fused":
         from gubernator_tpu.ops import fused as _f
 
-        return RawKernels(
+        raw = RawKernels(
             layout="fused",
             create=_f.FusedTable.create,
             decide=lambda t, b, now, ways: _f._decide_fused_impl(
@@ -281,10 +326,10 @@ def get_raw_kernels(layout: str) -> RawKernels:
             to_wide=_f.unpack_table,
             from_wide=_f.pack_table,
         )
-    if layout == "narrow":
+    elif layout == "narrow":
         from gubernator_tpu.ops import narrow as _n
 
-        return RawKernels(
+        raw = RawKernels(
             layout="narrow",
             create=_n.NarrowTable.create,
             decide=lambda t, b, now, ways: _n._decide_narrow_impl(
@@ -296,4 +341,18 @@ def get_raw_kernels(layout: str) -> RawKernels:
             to_wide=_n.unpack_table,
             from_wide=_n.pack_table,
         )
-    raise ValueError(f"unknown table layout: {layout!r}")
+    else:
+        raise ValueError(f"unknown table layout: {layout!r}")
+    if kernel_backend() == "pallas":
+        # The mesh tier composes RawKernels.decide inside shard_map
+        # (parallel/mesh.py local_decide), so routing the raw decide here
+        # is what makes IciMeshTopology dispatch the Pallas program PER
+        # SHARD: each shard's slice traces its own pallas_call.
+        from gubernator_tpu.ops import pallas_decide as _pd
+
+        raw = raw._replace(
+            decide=lambda t, b, now, ways: _pd.raw_decide_flat(
+                t, b, now, layout=layout, ways=ways
+            )
+        )
+    return raw

@@ -56,6 +56,7 @@ from gubernator_tpu.ops.kernels import (
     BYTES_PER_SLOT,
     get_kernels,
     get_raw_kernels,
+    kernel_backend,
 )
 from gubernator_tpu.ops.layout import SlotTable
 
@@ -166,24 +167,44 @@ def make_paged_kernels(
         phys = jnp.where(pp >= 0, pp * gpp + g % gpp, sentinel)
         return phys.astype(group.dtype)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def _decide(pt, batch, now):
-        b = batch._replace(group=_xlate(pt.page_map, batch.group))
-        data, out = raw.decide(pt.data, b, now, ways)
-        return PagedTable(data, pt.page_map), out
+    if kernel_backend() == "pallas" and layout in ("narrow", "fused"):
+        # Pallas backend: the page-map lookup happens INSIDE the decide
+        # kernel (a scalar SMEM read folded into each lane's DMA offset),
+        # so the standalone `_xlate` gather disappears from the decide
+        # hot path. Every other kernel (inject/probe/page ops — not
+        # wave-rate) keeps the translate-then-XLA path above.
+        from gubernator_tpu.ops import pallas_decide as _pd
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def _decide_scan(pt, batches, nows):
-        pm = pt.page_map
+        def _decide(pt, batch, now):
+            return _pd.decide_paged(
+                pt, batch, now, layout=layout, ways=ways, gpp=gpp
+            )
 
-        def step(data, xs):
-            b, now = xs
-            b = b._replace(group=_xlate(pm, b.group))
-            data, out = raw.decide(data, b, now, ways)
-            return data, out
+        def _decide_scan(pt, batches, nows):
+            return _pd.decide_scan_paged(
+                pt, batches, nows, layout=layout, ways=ways, gpp=gpp
+            )
 
-        data, outs = jax.lax.scan(step, pt.data, (batches, nows))
-        return PagedTable(data, pm), outs
+    else:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _decide(pt, batch, now):
+            b = batch._replace(group=_xlate(pt.page_map, batch.group))
+            data, out = raw.decide(pt.data, b, now, ways)
+            return PagedTable(data, pt.page_map), out
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _decide_scan(pt, batches, nows):
+            pm = pt.page_map
+
+            def step(data, xs):
+                b, now = xs
+                b = b._replace(group=_xlate(pm, b.group))
+                data, out = raw.decide(data, b, now, ways)
+                return data, out
+
+            data, outs = jax.lax.scan(step, pt.data, (batches, nows))
+            return PagedTable(data, pm), outs
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _inject(pt, items, now):

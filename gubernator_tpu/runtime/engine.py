@@ -845,6 +845,8 @@ class EngineBase:
             "batch_size": cfg.batch_size,
             "max_waves": cfg.max_waves,
             "pipeline_depth": self._pipe_depth,
+            "kernel_backend": getattr(self, "kernel_backend", "xla"),
+            "pallas_block": getattr(self, "pallas_block", 0),
             "inflight": getattr(self, "_inflight", 0),
             "queue_depth": self.queue_depth(),
             "counters": counters,
@@ -1324,6 +1326,22 @@ class MeshEngine(EngineBase):
         # host-DRAM cold tier (one frame pool + cold tier PER SHARD on
         # a mesh) — while flat binds the full-size table directly.
         self.K, self._pager = self.topo.build_kernels(config, self.metrics)
+        # Decide backend provenance (GUBER_KERNEL, resolved by the
+        # topology's registry build) + the Pallas lane tile. Tuning runs
+        # HERE — before _warmup compiles the decide program — so the
+        # tile the trials pick is the tile the warmed (and therefore
+        # served) executable is built with; the serving path never
+        # retunes (pinned by tests/test_pallas_engine.py).
+        self.kernel_backend = getattr(self.topo, "kernel_backend", "xla")
+        self.pallas_block = 0
+        if self.kernel_backend == "pallas":
+            from gubernator_tpu.runtime import kerneltune
+
+            self.pallas_block = kerneltune.ensure_tuned(
+                config.layout,
+                config.batch_size,
+                paged=int(getattr(config, "page_groups", 0) or 0) > 0,
+            )
         with (
             jax.default_device(dev) if dev is not None
             else _nullcontext()

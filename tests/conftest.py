@@ -63,6 +63,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "pallas: exercises the Pallas mosaic lowering on real TPU "
+        "hardware (block-shape sweeps); skips cleanly on CPU where "
+        "tier-1 covers the interpret/reference lowerings instead",
+    )
+    config.addinivalue_line(
+        "markers",
         "deadline(seconds): hard per-test SIGALRM watchdog covering "
         "setup+call+teardown — a hang fails with TimeoutError instead "
         "of eating the suite budget (no pytest-timeout in this env)",

@@ -672,3 +672,354 @@ def test_kernel_eviction_lru(layout):
     # b was evicted: fresh bucket
     s, lim, rem, _ = kern.decide_one(mk("b"), NOW + 5)
     assert rem == 9
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused decide (ops/pallas_decide.py): the one-HBM-pass kernel
+# must be a bit-exact twin of the XLA decide path it replaces — same
+# outputs, same table mutations — across both pallas layouts, flat AND
+# paged (including scrambled page maps, sentinel non-resident lanes,
+# and scatter-drop), and its fused admission/census side-output must
+# match the standalone scans. On CPU these run the interpret and
+# reference lowerings; the mosaic path shares _wave_compute with both.
+# ---------------------------------------------------------------------------
+
+import os  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from gubernator_tpu.ops import pallas_decide as _pd  # noqa: E402
+from gubernator_tpu.ops.census import census_oracle  # noqa: E402
+from gubernator_tpu.ops.layout import RequestBatch  # noqa: E402
+from gubernator_tpu.ops.paged import make_paged_kernels  # noqa: E402
+
+# GL014 kernel-parity registry: every decide* entry point wired through
+# ops/kernels.py / ops/paged.py must name its oracle-comparison test
+# here. guberlint parses this dict from disk and fails the build when a
+# new entry point lands without a parity case (or maps to a test that
+# does not exist in this file).
+KERNEL_PARITY_CASES = {
+    # wide + per-layout XLA impls: oracle fuzz over every registry layout
+    "decide": "test_kernel_fuzz",
+    "decide_scan": "test_kernel_fuzz",
+    "decide_packed": "test_kernel_fuzz",
+    "decide_scan_packed": "test_kernel_fuzz",
+    "decide_fused": "test_kernel_fuzz",
+    "decide_scan_fused": "test_kernel_fuzz",
+    "decide_narrow": "test_kernel_fuzz",
+    "decide_scan_narrow": "test_kernel_fuzz",
+    # pallas flat facades: differential vs the XLA kernels above
+    "decide_flat": "test_pallas_flat_bitexact",
+    "decide_scan_flat": "test_pallas_scan_bitexact",
+    # pallas paged facades: in-kernel page translation vs translate+XLA
+    "decide_paged": "test_pallas_paged_bitexact",
+    "decide_scan_paged": "test_pallas_paged_scan_bitexact",
+}
+
+PALLAS_LAYOUTS = list(_pd.PALLAS_LAYOUTS)
+# reference = plain-XLA fused program (the non-TPU serving lowering);
+# interpret = pl.pallas_call(interpret=True), the real kernel body.
+PALLAS_MODES = ("reference", "interpret")
+_PB = 64  # lanes per fuzz wave
+_PGPP, _NPP = 32, 8  # 512 logical groups -> 16 pages, 8 resident
+
+_PALLAS_OUT_FIELDS = (
+    "status", "limit", "remaining", "reset_time", "slot", "freed",
+    "hits", "misses", "over_limit", "evicted_hi", "evicted_lo",
+    "unexpired_evictions",
+)
+
+
+def _pallas_reqs(rng, now, num_groups=NUM_GROUPS):
+    """One fuzz wave as a raw RequestBatch (the assembler's output
+    shape), with the distinct-active-groups invariant enforced."""
+    b = _PB
+    ki = rng.integers(0, 200, size=b)
+    hi = np.asarray(
+        [(int(k) * 2654435761) % (1 << 62) for k in ki], dtype=np.int64
+    )
+    lo = np.asarray(
+        [(int(k) * 1140071481932319848) % (1 << 62) for k in ki],
+        dtype=np.int64,
+    )
+    batch = RequestBatch(
+        key_hi=jnp.asarray(hi, jnp.int64),
+        key_lo=jnp.asarray(lo, jnp.int64),
+        group=jnp.asarray((ki % num_groups).astype(np.int32)),
+        algo=jnp.asarray(rng.choice([0, 1], size=b).astype(np.int8)),
+        behavior=jnp.asarray(
+            rng.choice(
+                [0, int(Behavior.RESET_REMAINING),
+                 int(Behavior.DRAIN_OVER_LIMIT)],
+                size=b,
+            ).astype(np.int32)
+        ),
+        hits=jnp.asarray(rng.integers(1, 5, size=b), jnp.int64),
+        limit=jnp.asarray(rng.integers(1, 100, size=b), jnp.int64),
+        duration=jnp.asarray(rng.integers(1000, 60000, size=b), jnp.int64),
+        rate_num=jnp.asarray(rng.integers(1, 100, size=b), jnp.int64),
+        eff_duration=jnp.asarray(
+            rng.integers(1000, 60000, size=b), jnp.int64
+        ),
+        greg_expire=jnp.asarray(np.full(b, now + 60000), jnp.int64),
+        burst=jnp.asarray(rng.integers(1, 100, size=b), jnp.int64),
+        created_at=jnp.asarray(np.full(b, now), jnp.int64),
+        active=jnp.asarray(rng.random(b) < 0.9),
+    )
+    return _dedupe_groups(batch)
+
+
+def _dedupe_groups(batch):
+    """Deactivate duplicate-group lanes (assembler invariant: one
+    active lane per group per wave)."""
+    seen = set()
+    act = np.asarray(batch.active).copy()
+    for i, g in enumerate(np.asarray(batch.group)):
+        if act[i]:
+            if int(g) in seen:
+                act[i] = False
+            else:
+                seen.add(int(g))
+    return batch._replace(active=jnp.asarray(act))
+
+
+def _assert_outs_match(ox, op, tag, fields=_PALLAS_OUT_FIELDS):
+    for f in fields:
+        av, bv = np.asarray(getattr(ox, f)), np.asarray(getattr(op, f))
+        assert np.array_equal(av, bv), (
+            f"{tag}: field {f} diverged\nxla={av}\npallas={bv}"
+        )
+
+
+def _assert_tables_match(tx, tp, tag):
+    for lx, lp in zip(jax.tree.leaves(tx), jax.tree.leaves(tp)):
+        assert np.array_equal(np.asarray(lx), np.asarray(lp)), (
+            f"{tag}: table leaf diverged"
+        )
+
+
+def _set_pallas_mode(monkeypatch, mode):
+    monkeypatch.setenv(
+        "GUBER_PALLAS_INTERPRET", "1" if mode == "interpret" else "0"
+    )
+
+
+@pytest.mark.parametrize("mode", PALLAS_MODES)
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_flat_bitexact(layout, mode, monkeypatch):
+    """decide_flat vs the XLA decide kernel: outputs AND every table
+    leaf bit-equal across a multi-wave fuzz sequence."""
+    _set_pallas_mode(monkeypatch, mode)
+    monkeypatch.setenv("GUBER_KERNEL", "xla")
+    K = get_kernels(layout)
+    rng = np.random.default_rng(7)
+    tx = K.create(NUM_GROUPS, WAYS)
+    tp = K.create(NUM_GROUPS, WAYS)
+    for step in range(4):
+        t = NOW + step * 500
+        b = _pallas_reqs(rng, t)
+        tx, ox = K.decide(tx, b, jnp.int64(t), WAYS)
+        tp, op = _pd.decide_flat(tp, b, jnp.int64(t), layout=layout, ways=WAYS)
+        _assert_outs_match(ox, op, f"{layout}/{mode}/step{step}")
+        _assert_tables_match(tx, tp, f"{layout}/{mode}/step{step}")
+
+
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_registry_routing(layout, monkeypatch):
+    """GUBER_KERNEL=pallas swaps decide/decide_scan in the registry —
+    and the swapped facade still matches the XLA twin (the serving path
+    the engine actually builds)."""
+    monkeypatch.setenv("GUBER_PALLAS_INTERPRET", "0")
+    monkeypatch.setenv("GUBER_KERNEL", "xla")
+    Kx = get_kernels(layout)
+    monkeypatch.setenv("GUBER_KERNEL", "pallas")
+    Kp = get_kernels(layout)
+    assert Kx.decide is not Kp.decide
+    rng = np.random.default_rng(11)
+    tx, tp = Kx.create(NUM_GROUPS, WAYS), Kp.create(NUM_GROUPS, WAYS)
+    for step in range(3):
+        t = NOW + step * 500
+        b = _pallas_reqs(rng, t)
+        tx, ox = Kx.decide(tx, b, jnp.int64(t), WAYS)
+        tp, op = Kp.decide(tp, b, jnp.int64(t), WAYS)
+        _assert_outs_match(ox, op, f"routing/{layout}/step{step}")
+        _assert_tables_match(tx, tp, f"routing/{layout}/step{step}")
+
+
+@pytest.mark.parametrize("mode", PALLAS_MODES)
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_scan_bitexact(layout, mode, monkeypatch):
+    """decide_scan_flat vs the XLA decide_scan: stacked multi-wave
+    parity (outputs per step + final table)."""
+    _set_pallas_mode(monkeypatch, mode)
+    monkeypatch.setenv("GUBER_KERNEL", "xla")
+    K = get_kernels(layout)
+    rng = np.random.default_rng(13)
+    steps = 3
+    waves = [_pallas_reqs(rng, NOW + i * 500) for i in range(steps)]
+    batches = jax.tree.map(lambda *xs: jnp.stack(xs), *waves)
+    nows = jnp.asarray([NOW + i * 500 for i in range(steps)], jnp.int64)
+    tx, ox = K.decide_scan(K.create(NUM_GROUPS, WAYS), batches, nows, WAYS)
+    tp, op = _pd.decide_scan_flat(
+        K.create(NUM_GROUPS, WAYS), batches, nows, layout=layout, ways=WAYS
+    )
+    _assert_outs_match(ox, op, f"scan/{layout}/{mode}")
+    _assert_tables_match(tx, tp, f"scan/{layout}/{mode}")
+
+
+def _paged_pair(layout, monkeypatch, scramble=(3, 1, 7, 0, 5, 2, 6, 4)):
+    """XLA and pallas paged kernel sets over identically-bound tables:
+    logical pages 0..7 scrambled across physical frames."""
+    monkeypatch.setenv("GUBER_KERNEL", "xla")
+    PKx = make_paged_kernels(layout, NUM_GROUPS, WAYS, _PGPP, _NPP)
+    monkeypatch.setenv("GUBER_KERNEL", "pallas")
+    PKp = make_paged_kernels(layout, NUM_GROUPS, WAYS, _PGPP, _NPP)
+    ptx, ptp = PKx.create(), PKp.create()
+    for lp, pp in enumerate(scramble):
+        ptx = PKx.bind_page(ptx, lp, pp)
+        ptp = PKp.bind_page(ptp, lp, pp)
+    return PKx, PKp, ptx, ptp
+
+
+@pytest.mark.parametrize("mode", PALLAS_MODES)
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_paged_bitexact(layout, mode, monkeypatch):
+    """decide_paged (in-kernel page_map translation) vs the XLA
+    translate-then-decide path, scrambled page map, all lanes resident."""
+    _set_pallas_mode(monkeypatch, mode)
+    PKx, PKp, ptx, ptp = _paged_pair(layout, monkeypatch)
+    rng = np.random.default_rng(17)
+    for step in range(4):
+        t = NOW + step * 500
+        b = _pallas_reqs(rng, t)  # keys mod 200 -> all groups resident
+        ptx, ox = PKx.decide(ptx, b, jnp.int64(t), WAYS)
+        ptp, op = PKp.decide(ptp, b, jnp.int64(t), WAYS)
+        _assert_outs_match(ox, op, f"paged/{layout}/{mode}/step{step}")
+        _assert_tables_match(ptx, ptp, f"paged/{layout}/{mode}/step{step}")
+
+
+@pytest.mark.parametrize("mode", PALLAS_MODES)
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_paged_scan_bitexact(layout, mode, monkeypatch):
+    """decide_scan_paged vs the XLA paged scan over stacked waves."""
+    _set_pallas_mode(monkeypatch, mode)
+    PKx, PKp, ptx, ptp = _paged_pair(layout, monkeypatch)
+    rng = np.random.default_rng(19)
+    steps = 3
+    waves = [_pallas_reqs(rng, NOW + i * 500) for i in range(steps)]
+    batches = jax.tree.map(lambda *xs: jnp.stack(xs), *waves)
+    nows = jnp.asarray([NOW + i * 500 for i in range(steps)], jnp.int64)
+    ptx, ox = PKx.decide_scan(ptx, batches, nows, WAYS)
+    ptp, op = PKp.decide_scan(ptp, batches, nows, WAYS)
+    _assert_outs_match(ox, op, f"paged-scan/{layout}/{mode}")
+    _assert_tables_match(ptx, ptp, f"paged-scan/{layout}/{mode}")
+
+
+@pytest.mark.parametrize("mode", PALLAS_MODES)
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_paged_sentinel_scatter_drop(layout, mode, monkeypatch):
+    """Lanes whose group lives on a NON-resident page must drop their
+    scatter entirely: sentinel slot >= n, page_map untouched, every
+    table leaf inert, and the response fields the server surfaces
+    (fresh-bucket semantics) still bit-match the XLA paged path."""
+    _set_pallas_mode(monkeypatch, mode)
+    PKx, PKp, ptx, ptp = _paged_pair(layout, monkeypatch)
+    rng = np.random.default_rng(23)
+    b = _pallas_reqs(rng, NOW)
+    # shift every even lane onto pages 8..15 (non-resident)
+    grp = np.asarray(b.group)
+    resident_groups = _NPP * _PGPP  # 256
+    shifted = np.where(
+        np.arange(_PB) % 2 == 0,
+        grp % resident_groups + resident_groups,
+        grp % resident_groups,
+    ).astype(np.int32)
+    b = _dedupe_groups(b._replace(group=jnp.asarray(shifted)))
+    t = jnp.int64(NOW + 99_000)
+    ptx2, ox = PKx.decide(ptx, b, t, WAYS)
+    ptp2, op = PKp.decide(ptp, b, t, WAYS)
+    act = np.asarray(b.active)
+    nonres = act & (np.asarray(b.group) >= resident_groups)
+    assert nonres.sum() > 0, "fuzz must hit non-resident pages"
+    n = _NPP * _PGPP * WAYS
+    assert (np.asarray(op.slot)[nonres] >= n).all(), "sentinel slot < n"
+    # response fields are garbage-independent on sentinel lanes (the
+    # kernel zeroes the probe rows -> deterministic fresh-bucket reply);
+    # evicted_hi/lo and slot are the documented sentinel divergence.
+    _assert_outs_match(
+        ox, op, f"sentinel/{layout}/{mode}",
+        fields=("status", "limit", "remaining", "reset_time", "freed"),
+    )
+    # resident lanes wrote; non-resident frames stayed inert — compare
+    # only the frames no resident lane touched, via the XLA twin.
+    _assert_tables_match(ptx2, ptp2, f"sentinel/{layout}/{mode}")
+    # a wave of ONLY non-resident lanes must leave the table untouched
+    # (snapshot first: the decide facades donate the table buffers)
+    snap = [np.asarray(x).copy() for x in jax.tree.leaves(ptp2)]
+    only_nonres = b._replace(
+        active=jnp.asarray(act & (np.asarray(b.group) >= resident_groups))
+    )
+    ptp3, _ = PKp.decide(ptp2, only_nonres, t + 1, WAYS)
+    for before, after in zip(
+        snap,
+        [np.asarray(x) for x in jax.tree.leaves(ptp3)],
+    ):
+        assert np.array_equal(before, after), (
+            f"sentinel/{layout}/{mode}: non-resident wave mutated table"
+        )
+
+
+@pytest.mark.parametrize("mode", PALLAS_MODES)
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_wavescan_matches_scans(layout, mode, monkeypatch):
+    """The fused admission/census side-output must equal the standalone
+    scans run over exactly the rows the wave wrote."""
+    _set_pallas_mode(monkeypatch, mode)
+    monkeypatch.setenv("GUBER_KERNEL", "xla")
+    K = get_kernels(layout)
+    rng = np.random.default_rng(29)
+    tp = K.create(NUM_GROUPS, WAYS)
+    for step in range(3):
+        t = NOW + step * 500
+        b = _pallas_reqs(rng, t)
+        tp, out, scan = _pd.decide_flat_with_scan(
+            tp, b, jnp.int64(t), layout=layout, ways=WAYS
+        )
+        rows = K.gather_rows(tp, out.slot)
+        adm = admission_oracle(rows, t)
+        cen = census_oracle(rows, t, ways=1)
+        tag = f"wavescan/{layout}/{mode}/step{step}"
+        assert int(scan.adm_keys) == int(adm["keys"]), tag
+        assert int(scan.adm_admitted) == int(adm["admitted_sum"]), tag
+        assert int(scan.adm_limit) == int(adm["limit_sum"]), tag
+        assert int(scan.census_live) == int(cen["live"]), tag
+        assert int(scan.census_waste) == int(cen["waste"]), tag
+
+
+@pytest.mark.pallas
+@pytest.mark.parametrize("layout", PALLAS_LAYOUTS)
+def test_pallas_mosaic_block_shapes(layout, monkeypatch):
+    """TPU-only: the mosaic lowering must stay bit-exact with the
+    reference program across the autotuner's candidate lane tiles.
+    Skips cleanly off-TPU (the mosaic compiler needs real hardware)."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("mosaic lowering requires a TPU backend")
+    monkeypatch.setenv("GUBER_KERNEL", "xla")
+    K = get_kernels(layout)
+    rng = np.random.default_rng(31)
+    b = _pallas_reqs(rng, NOW)
+    for block in (128, 256, 512):
+        monkeypatch.setenv("GUBER_PALLAS_INTERPRET", "0")
+        monkeypatch.setenv("GUBER_PALLAS_BLOCK", str(block))
+        tm, om = _pd.decide_flat(
+            K.create(NUM_GROUPS, WAYS), b, jnp.int64(NOW),
+            layout=layout, ways=WAYS,
+        )
+        monkeypatch.setenv("GUBER_PALLAS_INTERPRET", "1")
+        ti, oi = _pd.decide_flat(
+            K.create(NUM_GROUPS, WAYS), b, jnp.int64(NOW),
+            layout=layout, ways=WAYS,
+        )
+        _assert_outs_match(om, oi, f"mosaic/{layout}/b{block}")
+        _assert_tables_match(tm, ti, f"mosaic/{layout}/b{block}")

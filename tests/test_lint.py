@@ -66,6 +66,7 @@ def test_registry_complete():
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
         "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
+        "GL014",
     }
 
 
@@ -176,6 +177,15 @@ _CASES = [
         3,  # 2 shadows + 1 reason-less pragma; reasoned-pragma close,
             # dunders, non-core names, module-level defs don't fire
     ),
+    (
+        "GL014",
+        fixture("ops", "gl014_kernel_parity.py"),
+        {"'decide_turbo'", "'decide_scan_turbo'",
+         "requires a non-empty reason"},
+        3,  # 2 uncovered entry points + 1 reason-less pragma; names
+            # covered by the real parity map (decide, decide_flat) and
+            # the reasoned-pragma reference stay quiet
+    ),
 ]
 
 
@@ -281,3 +291,21 @@ def test_linter_is_stdlib_only():
     )
     assert p.returncode == 0, p.stdout + p.stderr
     assert "scanned-ok" in p.stdout
+
+
+def test_gl014_repo_baseline_zero_and_map_valid():
+    # The shipping registry surface must be FULLY covered — GL014's
+    # repo baseline is pinned at zero (unlike the grandfathered rules),
+    # and every parity-map entry must point at a real test function.
+    res = run_lint(
+        paths=["gubernator_tpu/ops/kernels.py", "gubernator_tpu/ops/paged.py"],
+        rule_codes=["GL014"],
+    )
+    assert [f.render() for f in res.new] == []
+
+    from tools.lint.rules import kernel_parity_cases
+
+    cases, funcs = kernel_parity_cases()
+    assert cases, "KERNEL_PARITY_CASES must exist in tests/test_kernel_fuzz.py"
+    dangling = {k: v for k, v in cases.items() if v not in funcs}
+    assert dangling == {}

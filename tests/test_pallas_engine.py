@@ -1,0 +1,203 @@
+"""GUBER_KERNEL=pallas engine invariants.
+
+The backend swap must not reopen the cold-compile hole the warmup
+work closed: an engine built with the Pallas decide path warms the
+SAME program it serves (backend resolved at registry-build time), so
+serving waves, scrape paths (occupancy_stats), and the debug snapshot
+all dispatch warm — cold_compiles stays 0 under load. The block-size
+autotuner runs strictly before warmup, persists its choice beside the
+compile cache, and an engine restart re-registers the persisted choice
+with ZERO new trials (and zero serving-scope compiles, pinned via the
+retrace ring).
+"""
+
+import json
+import os
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq
+from gubernator_tpu.ops import pallas_decide
+from gubernator_tpu.runtime import kerneltune, telemetry
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+
+NOW = 1_753_700_000_000
+
+
+@pytest.fixture
+def fresh_tune_state(monkeypatch):
+    """Reset the process-global tune registries so each test models a
+    fresh process ('engine restart' = clearing these again mid-test)."""
+    monkeypatch.setattr(pallas_decide, "_block_choice", {})
+    monkeypatch.setattr(kerneltune, "_stats", {})
+    monkeypatch.setattr(kerneltune, "_tune_cache_hits", 0)
+    yield
+
+
+def _restart(monkeypatch):
+    """Simulate a process restart for the tuner: in-process block
+    registrations vanish; the persisted JSON (and the jit caches, which
+    stand in for the persistent compile cache here) survive."""
+    monkeypatch.setattr(pallas_decide, "_block_choice", {})
+    monkeypatch.setattr(kerneltune, "_stats", {})
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+@pytest.mark.parametrize("layout", ["fused", "narrow"])
+def test_pallas_engine_serving_and_scrapes_never_compile(
+    layout, fresh_tune_state, monkeypatch, tmp_path
+):
+    """Warmed pallas engine: batch waves, duplicate-key waves,
+    NO_BATCHING flushes, occupancy_stats scrapes, and the debug
+    snapshot must all run without a single cold compile."""
+    monkeypatch.setenv("GUBER_KERNEL", "pallas")
+    monkeypatch.setenv("GUBER_PALLAS_TUNE", "0")  # default block, no trials
+    monkeypatch.setenv(
+        "GUBER_PALLAS_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    eng = DeviceEngine(
+        EngineConfig(
+            num_groups=1 << 10, batch_size=64, layout=layout,
+            batch_wait_s=0.002,
+        ),
+        now_fn=lambda: NOW,
+    )
+    try:
+        assert eng.kernel_backend == "pallas"
+        assert eng.pallas_block > 0
+        eng.check_batch([mk(f"w{i}") for i in range(50)])
+        eng.check_batch([mk("dup"), mk("dup"), mk("dup")])
+        eng.check_batch([mk("nb", behavior=Behavior.NO_BATCHING)])
+        stats = eng.occupancy_stats()
+        assert stats["live"] >= 1
+        snap = eng.debug_snapshot()
+        assert snap["counters"]["cold_compiles"] == 0
+        # /debug/engine must name the serving backend + lane tile
+        assert snap["kernel_backend"] == "pallas"
+        assert snap["pallas_block"] == eng.pallas_block > 0
+        eng.check_batch([mk(f"x{i}") for i in range(30)])
+        assert eng.metrics.cold_compiles == 0
+    finally:
+        eng.close()
+
+
+def test_pallas_tune_persists_across_restart(
+    fresh_tune_state, monkeypatch, tmp_path
+):
+    """First tune runs timed trials and persists; a 'restarted' engine
+    re-registers the persisted choice with zero new trials — and every
+    trial compile is attributed warmup-scope, never serving."""
+    cache = tmp_path / "tune.json"
+    monkeypatch.setenv("GUBER_PALLAS_TUNE_CACHE", str(cache))
+    monkeypatch.setenv("GUBER_PALLAS_TUNE", "1")
+    monkeypatch.delenv("GUBER_PALLAS_BLOCK", raising=False)
+
+    # batch 256 -> candidates {128, 256}: real trials run
+    block = kerneltune.ensure_tuned("fused", 256)
+    assert block in (128, 256)
+    key = kerneltune.device_key("fused", False)
+    st = kerneltune.tuning_stats()
+    assert st["choices"][key]["source"] == "tuned"
+    assert len(st["choices"][key]["trials"]) == 2
+    persisted = json.loads(cache.read_text())["choices"]
+    assert persisted[key]["block"] == block
+
+    # trial compiles rode the tune shape hint, outside any serving scope
+    attribution = telemetry.compile_attribution()
+    tune_entries = [
+        e for e in attribution["recent"]
+        if str(e.get("shape", "")).startswith("pallas-tune:")
+    ]
+    assert all(not e["serving"] for e in tune_entries)
+
+    # restart: persisted choice wins, no trials re-run
+    _restart(monkeypatch)
+    assert pallas_decide.registered_block("fused", False) is None
+    block2 = kerneltune.ensure_tuned("fused", 256)
+    assert block2 == block
+    st2 = kerneltune.tuning_stats()
+    assert st2["choices"][key]["source"] == "persisted"
+    assert st2["tune_cache_hits"] == 1
+    # and the block is registered in-process again (what jit sees)
+    assert pallas_decide.registered_block("fused", False) == block
+
+    # third call short-circuits on the in-process registration
+    hits_before = kerneltune.tuning_stats()["tune_cache_hits"]
+    assert kerneltune.ensure_tuned("fused", 256) == block
+    assert kerneltune.tuning_stats()["tune_cache_hits"] == hits_before
+
+
+def test_pallas_tune_unknown_device_falls_back_unpersisted(
+    fresh_tune_state, monkeypatch, tmp_path
+):
+    """Tuning disabled (the unknown-device posture) must fall back to
+    the safe default WITHOUT poisoning the persisted cache."""
+    cache = tmp_path / "tune.json"
+    monkeypatch.setenv("GUBER_PALLAS_TUNE_CACHE", str(cache))
+    monkeypatch.setenv("GUBER_PALLAS_TUNE", "0")
+    block = kerneltune.ensure_tuned("narrow", 1024)
+    assert block == pallas_decide.DEFAULT_BLOCK
+    key = kerneltune.device_key("narrow", False)
+    assert kerneltune.tuning_stats()["choices"][key]["source"] == "default"
+    assert not cache.exists()
+    # a narrow batch clamps the default to the batch's pow2 ceiling
+    _restart(monkeypatch)
+    assert kerneltune.ensure_tuned("narrow", 16) == 16
+
+    # non-pallas layouts never tune or register anything
+    _restart(monkeypatch)
+    assert kerneltune.ensure_tuned("wide", 1024) == pallas_decide.DEFAULT_BLOCK
+    assert pallas_decide.registered_block("wide", False) is None
+
+
+def test_pallas_engine_restart_serves_warm_from_persisted_choice(
+    fresh_tune_state, monkeypatch, tmp_path
+):
+    """End-to-end restart: engine A tunes + persists; engine B (fresh
+    tune registries, same process caches) must come up on the persisted
+    block, run zero trials, and serve with zero cold compiles AND zero
+    serving-scope compiles in the retrace ring."""
+    cache = tmp_path / "tune.json"
+    monkeypatch.setenv("GUBER_KERNEL", "pallas")
+    monkeypatch.setenv("GUBER_PALLAS_TUNE_CACHE", str(cache))
+    monkeypatch.setenv("GUBER_PALLAS_TUNE", "1")
+    cfg = dict(
+        num_groups=1 << 10, batch_size=256, layout="fused",
+        batch_wait_s=0.002,
+    )
+    eng = DeviceEngine(EngineConfig(**cfg), now_fn=lambda: NOW)
+    try:
+        eng.check_batch([mk(f"a{i}") for i in range(40)])
+        assert eng.metrics.cold_compiles == 0
+        chosen = eng.pallas_block
+    finally:
+        eng.close()
+    assert json.loads(cache.read_text())["choices"]
+
+    _restart(monkeypatch)
+    ring_before = len(telemetry.compile_attribution()["recent"])
+    eng2 = DeviceEngine(EngineConfig(**cfg), now_fn=lambda: NOW)
+    try:
+        assert eng2.pallas_block == chosen
+        key = kerneltune.device_key("fused", False)
+        assert (
+            kerneltune.tuning_stats()["choices"][key]["source"]
+            == "persisted"
+        )
+        eng2.check_batch([mk(f"b{i}") for i in range(40)])
+        eng2.occupancy_stats()
+        assert eng2.metrics.cold_compiles == 0
+        # nothing that compiled since the restart ran inside a serving
+        # scope — the retrace ring is the ground truth the /debug
+        # surface shows
+        recent = telemetry.compile_attribution()["recent"][ring_before:]
+        assert [e for e in recent if e["serving"]] == []
+    finally:
+        eng2.close()

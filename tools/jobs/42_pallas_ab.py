@@ -1,0 +1,21 @@
+# TIMEOUT: 3600
+# Pallas-vs-XLA decide backend A/B (ISSUE 16): the same seeded Zipf
+# trace through GUBER_KERNEL=xla and GUBER_KERNEL=pallas cells at
+# identical geometry/layout, for both pallas-eligible layouts. On the
+# TPU runner the pallas cells run the mosaic lowering (the fused
+# one-HBM-pass kernel this job exists to measure); each cell's raw row
+# and the pallas/xla ratio row are ledgered as they land, and the
+# runner's auto-gate appends the GATE verdict for the freshest row
+# (utils/ledger.gate — a pallas throughput regression fails the job's
+# verdict on the next run).
+import sys, json
+sys.path.insert(0, "/root/repo")
+for _m in [k for k in list(sys.modules) if k == "bench" or k.startswith("gubernator_tpu")]:
+    del sys.modules[_m]
+import bench
+
+r = None
+for layout in ("fused", "narrow"):
+    row = bench.bench_kernel_ab(sizes=("kernel",), layout=layout)
+    r = r or row
+print("RESULT " + json.dumps(r))

@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL013.
+"""guberlint rule set GL000-GL014.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -1146,6 +1146,128 @@ class GL013EngineCoreDrift(Rule):
                         f"core or the topology strategy instead of "
                         f"re-forking it",
                         f"core-drift:{node.name}.{item.name}",
+                    )
+                )
+        return out
+
+
+# Files that register decide entry points into the kernel registry
+# surface (GL014): the layout registry itself and the paged facade
+# that composes over it.
+_KERNEL_REGISTRY_FILES = (
+    "gubernator_tpu/ops/kernels.py",
+    "gubernator_tpu/ops/paged.py",
+    # fixture twin — only ever scanned when passed explicitly
+    "gubernator_tpu/ops/gl014_kernel_parity.py",
+)
+_PARITY_TEST_FILE = "tests/test_kernel_fuzz.py"
+_PARITY_MAP_NAME = "KERNEL_PARITY_CASES"
+_DECIDE_NAME_RE = re.compile(r"^_?decide\w*$")
+
+_parity_cases_cache: Optional[Tuple[Dict[str, str], Set[str]]] = None
+
+
+def _normalize_decide_name(name: str) -> str:
+    """Registry spelling -> parity-map key: `_decide_narrow_impl` and
+    `decide_narrow` are the same entry point."""
+    name = name.lstrip("_")
+    if name.endswith("_impl"):
+        name = name[: -len("_impl")]
+    return name
+
+
+def kernel_parity_cases() -> Tuple[Dict[str, str], Set[str]]:
+    """(KERNEL_PARITY_CASES map, defined test-function names) parsed
+    from tests/test_kernel_fuzz.py on disk — from disk so the rule
+    works on partial scans (fixtures); cached per process."""
+    global _parity_cases_cache
+    if _parity_cases_cache is None:
+        cases: Dict[str, str] = {}
+        funcs: Set[str] = set()
+        path = os.path.join(REPO_ROOT, _PARITY_TEST_FILE)
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            tree = ast.Module(body=[], type_ignores=[])
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.add(node.name)
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == _PARITY_MAP_NAME
+                and isinstance(node.value, ast.Dict)
+            ):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                        v, ast.Constant
+                    ):
+                        cases[str(k.value)] = str(v.value)
+        _parity_cases_cache = (cases, funcs)
+    return _parity_cases_cache
+
+
+class GL014KernelParity(Rule):
+    code = "GL014"
+    name = "kernel-parity"
+    requires_reason = True
+    description = (
+        "every decide* entry point the kernel registry surface "
+        "(ops/kernels.py, ops/paged.py) wires up must be claimed by an "
+        "oracle-comparison case in tests/test_kernel_fuzz.py's "
+        "KERNEL_PARITY_CASES map (key = normalized entry-point name, "
+        "value = the covering test function) — a decide variant without "
+        "a differential test is an unfuzzed fork of the policy "
+        "arithmetic"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if scan_path(mod.relpath) not in _KERNEL_REGISTRY_FILES:
+            return []
+        cases, funcs = kernel_parity_cases()
+        # Entry points this module wires: attribute reads off layout /
+        # backend modules plus from-imports of decide impls. Keyword
+        # names (decide=..., the facade FIELD) are not entry points.
+        referenced: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and _DECIDE_NAME_RE.match(
+                node.attr
+            ):
+                key = _normalize_decide_name(node.attr)
+                referenced.setdefault(key, node.lineno)
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if _DECIDE_NAME_RE.match(alias.name):
+                        key = _normalize_decide_name(alias.name)
+                        referenced.setdefault(key, node.lineno)
+        out = []
+        for key in sorted(referenced):
+            line = referenced[key]
+            if key not in cases:
+                out.append(
+                    self.finding(
+                        mod.relpath,
+                        line,
+                        f"decide entry point '{key}' has no "
+                        f"KERNEL_PARITY_CASES entry in "
+                        f"{_PARITY_TEST_FILE} — add an oracle-"
+                        f"comparison case (or an allow-kernel-parity "
+                        f"pragma)",
+                        f"parity:{key}",
+                    )
+                )
+            elif cases[key] not in funcs:
+                out.append(
+                    self.finding(
+                        mod.relpath,
+                        line,
+                        f"KERNEL_PARITY_CASES['{key}'] names "
+                        f"'{cases[key]}', which is not a test function "
+                        f"in {_PARITY_TEST_FILE} — the parity claim is "
+                        f"dangling",
+                        f"parity-dangling:{key}",
                     )
                 )
         return out
