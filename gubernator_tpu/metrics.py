@@ -601,6 +601,15 @@ def engine_histograms() -> dict:
             "fully hidden behind device execution).",
             scale=1 / 256, n_buckets=10,
         ),
+        "collective_tick": Log2Histogram(
+            "gubernator_collective_tick_duration",
+            "Per-flush collective tick wall time in seconds on "
+            "multi-device topologies: device execution + host "
+            "materialization of the sharded decide, whose psum merge "
+            "rendezvouses every shard — one slow shard stretches every "
+            "tick (docs/monitoring.md \"SLOs & burn rates\").",
+            scale=us, n_buckets=24,
+        ),
         "ici_tick_duration": Log2Histogram(
             "gubernator_ici_tick_duration",
             "ICI GLOBAL sync tick wall time in seconds (collective "
@@ -1279,6 +1288,62 @@ class Metrics:
         )
         self.register_renderable(self.admission_excess_hits)
 
+        # SLO observatory (docs/monitoring.md "SLOs & burn rates",
+        # service/slo.py): multi-window burn rates per SLO spec, error
+        # budget remaining over each spec's budget window, and the
+        # alert state machine (0 ok | 1 slow_burn | 2 fast_burn |
+        # 3 exhausted). All set by the _slo_sync scrape bridge from the
+        # observatory's host-side rings — zero device work.
+        self.slo_burn_rate = Gauge(
+            "gubernator_slo_burn_rate",
+            "Error-budget burn rate per SLO and evaluation window: "
+            "bad-event fraction over the window divided by the SLO's "
+            "error budget (1 - objective). 1.0 = burning exactly at "
+            "budget; the fast-burn alert fires around 14.4x.",
+            ["slo", "window"],
+            registry=r,
+        )
+        self.slo_error_budget_remaining = Gauge(
+            "gubernator_slo_error_budget_remaining",
+            "Fraction of the SLO's error budget left over its budget "
+            "window (1.0 = untouched, 0 = exhausted, clamped at 0).",
+            ["slo"],
+            registry=r,
+        )
+        self.slo_alert_state = Gauge(
+            "gubernator_slo_alert_state",
+            "SLO alert state machine: 0 ok, 1 slow_burn (both "
+            "slow-burn windows over threshold), 2 fast_burn (both "
+            "fast-burn windows over), 3 exhausted (budget fully "
+            "burned).",
+            ["slo"],
+            registry=r,
+        )
+        # Self-watchdog (runtime/watchdog.py): per-loop stall flags,
+        # set by the _slo_sync bridge from the watchdog's heartbeat
+        # table. A serving loop's stall also burns the availability
+        # SLO — this gauge is the per-loop attribution.
+        self.thread_stalled = Gauge(
+            "gubernator_thread_stalled",
+            "1 when the named long-lived loop's heartbeat is older "
+            "than its stall deadline (GUBER_WATCHDOG_STALL_MS + the "
+            "loop's declared period), else 0.",
+            ["loop"],
+            registry=r,
+        )
+        # Shard-skew attribution (mesh topologies): max/mean imbalance
+        # across per-shard decisions / occupancy / resident frames —
+        # 1.0 is perfectly balanced; feeds the shard-balance SLO and
+        # the future PodSliceTopology placement work (ROADMAP item 1).
+        self.shard_imbalance_ratio = Gauge(
+            "gubernator_shard_imbalance_ratio",
+            "Worst max/mean imbalance across shards of the mesh "
+            "(decisions served, census occupancy, resident page "
+            "frames); 1.0 = balanced, absent on single-device "
+            "topologies.",
+            registry=r,
+        )
+
         self._syncs = []
 
     # -- registration --------------------------------------------------------
@@ -1451,6 +1516,14 @@ def engine_sync(engine):
             m.engine_full_group_ratio.set(stats["full_group_ratio"])
         else:
             m.cache_size.set(engine.live_count())
+        if hasattr(engine, "shard_stats"):
+            # Shard-skew attribution (mesh topologies only): host
+            # counters + the ALREADY-CACHED census — shard_stats never
+            # scans, so this stays zero-device-work even when the
+            # census cache is cold (it just omits occupancy then).
+            ss = engine.shard_stats()
+            if ss is not None and ss.get("imbalance_ratio") is not None:
+                m.shard_imbalance_ratio.set(ss["imbalance_ratio"])
         if hasattr(engine, "overflow_keys"):  # ici-mode engines only
             m.global_overflow_keys.set(engine.overflow_keys)
             m.global_overflow_drops.set(engine.overflow_drops)

@@ -69,6 +69,9 @@ class ConsistencyAuditor:
         self._counts: Dict[str, int] = {"lag": 0, "lost": 0, "conflict": 0}
         self._lease_last: Dict[str, int] = {}
         self._admission_last: dict = {}
+        # Self-watchdog heartbeat seam, injected by the daemon (None
+        # keeps the auditor usable standalone in tests).
+        self.watchdog = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -90,6 +93,9 @@ class ConsistencyAuditor:
     async def _loop(self) -> None:
         while True:
             await asyncio.sleep(self.interval_s)
+            wd = self.watchdog
+            if wd is not None:
+                wd.beat("auditor", period_s=self.interval_s)
             try:
                 await self.audit_once()
             except asyncio.CancelledError:

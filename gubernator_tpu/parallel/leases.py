@@ -149,6 +149,9 @@ class LeaseManager:
         self.expired_hits = 0
         self.credited_hits = 0  # info: actual credits applied
         self.revocations = 0
+        # Self-watchdog heartbeat seam, injected by the daemon (None
+        # keeps the manager usable standalone in tests).
+        self.watchdog = None
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -168,6 +171,9 @@ class LeaseManager:
     async def _loop(self) -> None:
         while True:
             await asyncio.sleep(self.sweep_interval_s)
+            wd = self.watchdog
+            if wd is not None:
+                wd.beat("lease-sweep", period_s=self.sweep_interval_s)
             try:
                 self.sweep()
             except Exception:

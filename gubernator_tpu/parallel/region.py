@@ -5,7 +5,9 @@ per-region rings. MULTI_REGION replication across those rings — a
 declared-but-unimplemented behavior in the reference (its multi-region
 test is an empty TODO, functional_test.go:1578-1586) — IS implemented
 here: see parallel/region_sync.py (rendezvous-hashed home region,
-async DCN hit-delta + authoritative broadcast legs).
+async DCN hit-delta + authoritative broadcast legs). The routing is
+pinned by tests/test_multiregion.py's RegionPicker unit suite — the
+tests the reference never wrote.
 """
 
 from __future__ import annotations

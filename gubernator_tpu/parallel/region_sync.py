@@ -30,6 +30,12 @@ implements it, composing with the existing two-tier GLOBAL design:
 Delta-then-overwrite is double-count-free: a region's local hits are
 provisional until the home region's broadcast (which already includes
 the pushed deltas) overwrites them.
+
+tests/test_multiregion.py pins both layers — cross-DC convergence e2e,
+plus unit coverage of the queue/flush internals (noop gating, hit
+aggregation, DRAIN forcing + strip-on-retry, requeue on unreachable
+home, home-churn delta→broadcast conversion, the hits=0 authoritative
+re-read) — the tests the reference's empty TODO never wrote.
 """
 
 from __future__ import annotations

@@ -279,15 +279,22 @@ class EngineMetrics:
             self.batch_duration_sum += dur
 
     def observe_flush(self, path: str, n: int, waves: int, dur: float,
-                      dev: float, trace_id: str = "") -> None:
+                      dev: float, trace_id: str = "",
+                      collective: bool = False) -> None:
         """One flush's distribution samples (per FLUSH, not per
         request). A non-empty trace_id attaches an OpenMetrics exemplar
         to the latency buckets this flush lands in, so a p99 spike in
-        Grafana clicks through to the exact trace."""
+        Grafana clicks through to the exact trace. `collective` (mesh
+        topologies) additionally lands the device time in the
+        collective-tick histogram: on a sharded decide the psum merge
+        rendezvouses every shard, so this distribution is the
+        shard-skew amplifier the SLO layer watches."""
         self.flush_duration.labels(path).observe(dur, trace_id)
         self.device_sync.labels(path).observe(dev, trace_id)
         self.batch_width.labels(path).observe(n)
         self.flush_waves.observe(waves)
+        if collective:
+            self.collective_tick.observe(dev)
 
 
 class _Slot:
@@ -451,10 +458,26 @@ class EngineBase:
         self._admission_lock = lockorder.make_lock("engine.admission")
         self._admission_cache: Optional[dict] = None
         self._admission_ts = 0.0
+        # Shard-skew attribution (multi-device topologies only):
+        # cumulative per-shard decided-lane counts, host numpy, updated
+        # by the pump at wave granularity (docs/monitoring.md "SLOs &
+        # burn rates"). The future PodSliceTopology placement work will
+        # be judged against this skew signal (ROADMAP item 1).
+        self._shard_lock = lockorder.make_lock("engine.shards")
+        self._shard_decisions = (
+            np.zeros(self.topo.n_dev, dtype=np.int64)
+            if self.topo.n_dev > 1
+            else None
+        )
         # Cumulative pump time spent in _dispatch (host encode + launch);
         # pump-thread-only writer, read by the completion stage for the
         # host/device overlap ratio.
         self._host_busy = 0.0
+        # Liveness (runtime/watchdog.py): the daemon injects its
+        # Watchdog after construction; until then beats are no-ops.
+        # The pump and completion threads are SERVING loops — their
+        # stall burns the availability SLO, not just a lamp.
+        self.watchdog = None
         depth = max(int(getattr(self.cfg, "pipeline_depth", 1) or 1), 1)
         self._pipe_depth = depth
         self._pipe_q: Optional["queue.SimpleQueue"] = None
@@ -568,7 +591,17 @@ class EngineBase:
         later ones dispatched against the recovered table) — the loop
         itself never dies while the engine runs."""
         while True:
-            t = self._pipe_q.get()
+            # Bounded get so the idle loop still heartbeats: a blocking
+            # get() would look wedged to the watchdog whenever no
+            # tickets flow, and a REAL wedge (stuck device sync inside
+            # _complete_ticket) would be indistinguishable from idle.
+            wd = self.watchdog
+            if wd is not None:
+                wd.beat("engine-complete", serving=True)
+            try:
+                t = self._pipe_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
             if t is _STOP:
                 return
             try:
@@ -914,6 +947,114 @@ class EngineBase:
             self._admission_ts = time.monotonic()
             return snap
 
+    def cached_census(self) -> Optional[dict]:
+        """The census snapshot ONLY if already cached — never scans.
+        The SLO sampler reads SLIs at a fixed cadence and must do zero
+        device work (GL009/cold_compiles==0 pinned): table_census(ttl)
+        dispatches a device program when the cache is stale, which a
+        background sampler must never trigger on its own clock. Returns
+        None until some scrape/debug hit has populated the cache."""
+        with self._census_lock:
+            return self._census_cache
+
+    def cached_admission(self) -> Optional[dict]:
+        """The admission snapshot ONLY if already cached — never scans.
+        Same zero-device-work contract as cached_census()."""
+        with self._admission_lock:
+            return self._admission_cache
+
+    # -- shard-skew attribution (docs/monitoring.md "SLOs & burn rates") -----
+
+    def _note_shard_decisions(self, waves) -> None:
+        """Fold each wave's active lanes onto their owning shard.
+        Groups map to shards contiguously (parallel/mesh.py
+        _mask_to_local: shard = group // groups_per_shard), so a host
+        bincount reproduces the device-side ownership split exactly.
+        Pure numpy over already-host wave batches — no device work."""
+        n_dev = self.topo.n_dev
+        groups = (
+            self.K.num_phys_pages * self.K.groups_per_page
+            if self._pager is not None
+            else self.cfg.num_groups
+        )
+        groups_per = max(groups // n_dev, 1)
+        counts = np.zeros(n_dev, dtype=np.int64)
+        for wb in waves:
+            act = np.asarray(wb.active)  # guberlint: allow-host-sync -- wave batches carry host-built columns, never device tensors
+            grp = np.asarray(wb.group)[act]  # guberlint: allow-host-sync -- wave batches carry host-built columns, never device tensors
+            if grp.size:
+                counts += np.bincount(
+                    np.minimum(grp // groups_per, n_dev - 1),
+                    minlength=n_dev,
+                )
+        with self._shard_lock:
+            self._shard_decisions += counts
+
+    def shard_stats(self) -> Optional[dict]:
+        """Per-shard skew attribution for the mesh path: decisions (the
+        ownership split of served lanes), occupancy (census heatmap
+        folded onto shard boundaries — regions and shards are both
+        contiguous over groups), page-churn / frame-pool pressure (the
+        pager's per-shard rows), and the derived max/mean imbalance
+        ratio that feeds the shard-balance SLO. None on single-device
+        topologies. Zero device work: reads the cumulative host
+        counters and the ALREADY-CACHED census only."""
+        n_dev = self.topo.n_dev
+        if n_dev <= 1 or self._shard_decisions is None:
+            return None
+        with self._shard_lock:
+            decisions = self._shard_decisions.tolist()
+
+        def imbalance(vals) -> Optional[float]:
+            total = sum(vals)
+            if total <= 0:
+                return None
+            mean = total / float(len(vals))
+            return round(max(vals) / mean, 4)
+
+        out: dict = {
+            "n_shards": n_dev,
+            "decisions": decisions,
+            "decision_imbalance": imbalance(decisions),
+        }
+        census = self.cached_census()
+        if census is not None:
+            tier = census.get("tiers", {}).get(
+                self.topo.primary_tier, census
+            )
+            heat = tier.get("heatmap") or []
+            gpr = int(tier.get("heatmap_groups_per_region", 1) or 1)
+            groups = int(tier.get("groups", 0) or 0)
+            if heat and groups:
+                per = max(groups // n_dev, 1)
+                occ = [0] * n_dev
+                for r, live in enumerate(heat):
+                    s = min((r * gpr) // per, n_dev - 1)
+                    occ[s] += int(live)
+                out["occupancy"] = occ
+                out["occupancy_imbalance"] = imbalance(occ)
+            pages = census.get("pages")
+            if pages and pages.get("shards"):
+                out["pages"] = pages["shards"]
+                resident = [
+                    int(s.get("resident", 0)) for s in pages["shards"]
+                ]
+                out["resident_imbalance"] = imbalance(resident)
+        # Headline gauge: the worst imbalance across dimensions — max/
+        # mean == 1.0 is perfectly balanced; the SLO spec alerts on
+        # sustained excess.
+        dims = [
+            v
+            for v in (
+                out.get("decision_imbalance"),
+                out.get("occupancy_imbalance"),
+                out.get("resident_imbalance"),
+            )
+            if v is not None
+        ]
+        out["imbalance_ratio"] = max(dims) if dims else None
+        return out
+
     def _census_churn(self, snap: dict) -> dict:
         """Churn ledger: interval deltas of the flush bookkeeping the
         engine already keeps, turned into rates at census cadence.
@@ -959,6 +1100,12 @@ class EngineBase:
         NB = int(Behavior.NO_BATCHING)
         carry: List[Tuple[RateLimitReq, object]] = []
         while self._running:
+            wd = self.watchdog
+            if wd is not None:
+                # Serving heartbeat: a pump stuck behind the pipeline
+                # semaphore (wedged completion thread) stops beating
+                # here and burns the availability SLO.
+                wd.beat("engine-pump", serving=True)
             if not carry:
                 try:
                     item = self._queue.get(timeout=0.1)
@@ -1450,6 +1597,11 @@ class MeshEngine(EngineBase):
         by the most recent wave round."""
         interval = max(float(self.cfg.page_demote_interval_s), 0.05)
         while not self._demote_stop.wait(interval):
+            wd = self.watchdog
+            if wd is not None:
+                # period_s widens the stall deadline to cover the
+                # configured sleep — a 60s demote cadence is not a wedge.
+                wd.beat("page-demoter", period_s=interval)
             try:
                 pager = self._pager
                 want = int(getattr(self.cfg, "page_free_target", 1) or 0)
@@ -2213,7 +2365,10 @@ class MeshEngine(EngineBase):
         em = self.metrics
         trace_id = (t.trace_id or "") if cfg.exemplars else ""
         em.observe(tot[0], tot[1], tot[2], tot[3], t.waves, t.served, dur)
-        em.observe_flush("object", t.served, t.waves, dur, dev_s, trace_id)
+        em.observe_flush(
+            "object", t.served, t.waves, dur, dev_s, trace_id,
+            collective=self.topo.n_dev > 1,
+        )
         em.observe_stage("assemble", t.t_dev - t.t0)
         em.observe_stage("dispatch", t.t_disp_end - t.t_dev)
         em.observe_stage("inflight_wait", max(t_c0 - t.t_disp_end, 0.0))
@@ -2512,6 +2667,7 @@ class MeshEngine(EngineBase):
         em.observe_flush(
             "columnar", n, W, dur, dev_s,
             flush_trace_id if cfg.exemplars else "",
+            collective=self.topo.n_dev > 1,
         )
         em.observe_stage("assemble", t_dev - t_start)
         em.observe_stage("device_sync", dev_s)
@@ -2647,6 +2803,7 @@ class MeshEngine(EngineBase):
         em.observe_flush(
             "columnar", n, waves_total, dur, dev_s,
             flush_trace_id if cfg.exemplars else "",
+            collective=self.topo.n_dev > 1,
         )
         em.observe_stage("assemble", t_dev - t_start)
         em.observe_stage("device_sync", dev_s)
@@ -2699,6 +2856,12 @@ class MeshEngine(EngineBase):
         wave_rows_host: List[object] = []  # materialized post-decide rows
         served: Dict[Tuple[int, int], Tuple[int, int]] = {}  # key->(w,lane)
         events: List[Tuple[str, Tuple[int, int]]] = []  # ('d'|'i', key)
+        if self.topo.n_dev > 1:
+            # Shard-skew attribution (docs/monitoring.md "SLOs & burn
+            # rates"): host-side bincount over the waves' group arrays
+            # BEFORE device dispatch — this is the one choke point both
+            # the object and columnar paths flow through.
+            self._note_shard_decisions(waves)
         with self._lock, self.topo.dispatch_guard():
             table = self.table
             rstate = rt.state if rt is not None else None

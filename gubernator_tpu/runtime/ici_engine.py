@@ -296,6 +296,9 @@ class IciEngine(MeshEngine):
 
     def _sync_loop(self) -> None:
         while not self._stop_sync.wait(self.cfg.sync_wait_s):
+            wd = self.watchdog
+            if wd is not None:
+                wd.beat("ici-sync", period_s=self.cfg.sync_wait_s)
             try:
                 self.sync_now()
                 self._sync_errors = 0

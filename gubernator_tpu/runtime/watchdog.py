@@ -1,0 +1,144 @@
+"""Self-watchdog: named heartbeats for every long-lived daemon loop.
+
+A gubernator daemon runs seven-plus background loops (engine pump,
+pipelined-completion thread, ici sync cadence, consistency auditor,
+page demoter, lease sweep, profiler sampler, SLO sampler). Every one
+of them fails SILENTLY: a wedged completion thread just stops draining
+`_pipe_q`, the pump blocks on the pipeline semaphore, and from the
+outside the daemon looks healthy — gRPC still accepts, /healthz still
+200s — while every decision times out. PR 10's breaker catches a
+*peer* in that state; nothing caught the local daemon.
+
+The watchdog inverts liveness detection: each loop calls
+`wd.beat(name, ...)` once per iteration, and a monitor thread flags
+any heartbeat older than its deadline into `stalled`. Consumers:
+
+  - `gubernator_thread_stalled{loop}` gauge (metrics.py scrape bridge
+    reads `snapshot()` — the watchdog itself never touches metrics so
+    it stays importable everywhere);
+  - /debug/slo carries the full per-loop heartbeat table;
+  - `serving_stalled()` — True when a loop marked `serving=True` (the
+    pump / completion pair that sits on the decision path) is stalled;
+    service/slo.py feeds it into the availability SLI, so a wedged
+    serving loop BURNS the availability error budget rather than
+    merely lighting a lamp nobody watches.
+
+Heartbeats are plain dict stores (GIL-atomic), safe from threads and
+asyncio tasks alike, ~100ns per beat — cheap enough for the pump's
+per-batch loop. Loops with a long natural cadence (the demoter can
+legitimately sleep 60s between passes) pass `period_s` so their
+deadline is `stall + period`, not the raw stall bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Watchdog:
+    """Monitor thread + heartbeat table. start()/stop() lifecycle is
+    owned by the daemon; loops only ever call beat()."""
+
+    def __init__(self, stall_ms: float = 5000.0):
+        self.stall_s = max(float(stall_ms), 1.0) / 1000.0
+        # name -> (last_beat_monotonic, serving, period_s). Replaced
+        # wholesale on every beat; readers snapshot via dict(...) so
+        # iteration never races a writer.
+        self._beats: dict[str, tuple[float, bool, float]] = {}
+        self._stalled: dict[str, bool] = {}
+        self._stall_events: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- producer side ------------------------------------------------------
+
+    def beat(
+        self, name: str, *, serving: bool = False, period_s: float = 0.0
+    ) -> None:
+        """Record one loop iteration. First beat auto-registers the
+        loop — no separate registration step, so a loop that never
+        starts simply never appears (its absence shows in /debug/slo
+        as a missing row, not a false 'healthy')."""
+        self._beats[name] = (time.monotonic(), serving, float(period_s))
+
+    def unregister(self, name: str) -> None:
+        """Drop a loop that shut down cleanly so its final heartbeat
+        doesn't age into a false stall."""
+        self._beats.pop(name, None)
+        self._stalled.pop(name, None)
+
+    # -- monitor side -------------------------------------------------------
+
+    def _deadline_s(self, period_s: float) -> float:
+        # A loop beating every period_s sits at age <= period_s in
+        # steady state; stall_s on top is the wedge margin.
+        return self.stall_s + max(period_s, 0.0)
+
+    def check(self, now: float | None = None) -> dict[str, bool]:
+        """One evaluation pass; also called directly by tests so stall
+        detection needs no sleeping."""
+        now = time.monotonic() if now is None else now
+        for name, (ts, _serving, period_s) in dict(self._beats).items():
+            stalled = (now - ts) > self._deadline_s(period_s)
+            if stalled and not self._stalled.get(name, False):
+                self._stall_events[name] = self._stall_events.get(name, 0) + 1
+            self._stalled[name] = stalled
+        # beats removed by unregister leave no stalled residue
+        for name in list(self._stalled):
+            if name not in self._beats:
+                del self._stalled[name]
+        return dict(self._stalled)
+
+    def _loop(self) -> None:
+        poll = min(max(self.stall_s / 4.0, 0.01), 1.0)
+        while not self._stop.wait(poll):
+            self.beat("watchdog-monitor", period_s=poll)
+            self.check()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="gubernator-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -- consumers ----------------------------------------------------------
+
+    def stalled_loops(self) -> list[str]:
+        return sorted(n for n, s in self._stalled.items() if s)
+
+    def serving_stalled(self) -> bool:
+        """True when any serving-path loop is stalled — the hook the
+        availability SLO burns on."""
+        beats = dict(self._beats)
+        return any(
+            self._stalled.get(n, False) and beats.get(n, (0, False, 0))[1]
+            for n in self._stalled
+        )
+
+    def snapshot(self) -> dict:
+        """JSON-shaped per-loop heartbeat table for /debug/slo."""
+        now = time.monotonic()
+        loops = {}
+        for name, (ts, serving, period_s) in sorted(dict(self._beats).items()):
+            loops[name] = {
+                "age_ms": round((now - ts) * 1000.0, 1),
+                "deadline_ms": round(self._deadline_s(period_s) * 1000.0, 1),
+                "serving": serving,
+                "stalled": bool(self._stalled.get(name, False)),
+                "stall_events": int(self._stall_events.get(name, 0)),
+            }
+        return {
+            "stall_ms": round(self.stall_s * 1000.0, 1),
+            "serving_stalled": self.serving_stalled(),
+            "loops": loops,
+        }

@@ -347,6 +347,17 @@ class DaemonConfig:
     page_demote_interval_s: float = 2.0
     page_free_target: int = 1
 
+    # SLO observatory + self-watchdog (docs/monitoring.md "SLOs & burn
+    # rates"): GUBER_SLO_SAMPLE_INTERVAL paces the background SLI
+    # sampler that feeds the time-series rings (0 = observatory off);
+    # GUBER_SLO_SPECS overrides/extends the built-in SLO spec set
+    # (JSON list, see service/slo.py); GUBER_WATCHDOG_STALL_MS is the
+    # heartbeat-age bound past which a background loop is flagged
+    # stalled (0 = watchdog off).
+    slo_sample_interval_s: float = 5.0
+    slo_specs: str = ""
+    watchdog_stall_ms: float = 5000.0
+
     # Continuous profiling (docs/monitoring.md "Device resources"):
     # GUBER_PROFILE_INTERVAL > 0 starts a background sampler that takes
     # a GUBER_PROFILE_SECONDS-long jax.profiler capture each interval,

@@ -284,6 +284,46 @@ class Daemon:
             self.svc.profiler = self._profiler
             self._profiler.start()
 
+        # Self-watchdog + SLO observatory (docs/monitoring.md "SLOs &
+        # burn rates"): every long-lived loop (engine pump, completion
+        # thread, ICI sync, auditor, demoter, lease sweep, profiler,
+        # SLO sampler) heartbeats the watchdog; the observatory samples
+        # already-cached SLIs into bounded rings and evaluates
+        # multi-window burn rates. GUBER_SLO_SAMPLE_INTERVAL=0 turns
+        # both off (the watchdog without a sampler would flag stalls
+        # nobody exports).
+        self._watchdog = None
+        self._slo = None
+        if conf.slo_sample_interval_s > 0:
+            from gubernator_tpu.runtime.watchdog import Watchdog
+            from gubernator_tpu.service.slo import (
+                SloObservatory,
+                parse_slo_specs,
+            )
+
+            self._watchdog = Watchdog(stall_ms=conf.watchdog_stall_ms)
+            # Injected attribute, checked per-iteration by the engine
+            # loops — the engine threads started before the daemon
+            # built the watchdog, and None keeps the engine usable
+            # standalone (tests, tools) with zero overhead.
+            self.engine.watchdog = self._watchdog
+            self.svc.watchdog = self._watchdog
+            if self._auditor is not None:
+                self._auditor.watchdog = self._watchdog
+            if self._lease_mgr is not None:
+                self._lease_mgr.watchdog = self._watchdog
+            if self._profiler is not None:
+                self._profiler.watchdog = self._watchdog
+            self._slo = SloObservatory(
+                self.svc,
+                interval_s=conf.slo_sample_interval_s,
+                specs=parse_slo_specs(conf.slo_specs),
+                watchdog=self._watchdog,
+            )
+            self.svc.slo = self._slo
+            self._watchdog.start()
+            self._slo.start()
+
         # Discovery pool pushes membership through set_peers
         # (reference daemon.go:208-243). Unknown/unavailable backends fail
         # fast rather than silently serving as a cluster of one.
@@ -406,6 +446,12 @@ class Daemon:
             await self._auditor.close()
         if getattr(self, "_profiler", None) is not None:
             self._profiler.stop()
+        # SLO sampler + watchdog before the loops they observe: a loop
+        # stopping during drain must not be flagged as a stall.
+        if getattr(self, "_slo", None) is not None:
+            self._slo.stop()
+        if getattr(self, "_watchdog", None) is not None:
+            self._watchdog.stop()
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
         # preStop settle (the k8s preStop-sleep analog): calls already on

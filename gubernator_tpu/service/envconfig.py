@@ -230,6 +230,14 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env("GUBER_TABLE_PAGE_DEMOTE_INTERVAL"), 2.0
         ),
         page_free_target=_env_int("GUBER_TABLE_PAGE_FREE_TARGET", 1),
+        # SLO observatory + self-watchdog (docs/monitoring.md "SLOs &
+        # burn rates"): SLI sampler cadence (0 = off), SLO spec
+        # override JSON, heartbeat stall bound (0 = watchdog off).
+        slo_sample_interval_s=parse_duration_s(
+            _env("GUBER_SLO_SAMPLE_INTERVAL"), 5.0
+        ),
+        slo_specs=_env("GUBER_SLO_SPECS"),
+        watchdog_stall_ms=_env_float("GUBER_WATCHDOG_STALL_MS", 5000.0),
         # Continuous profiling (docs/monitoring.md "Device resources"):
         # sampler cadence (0 = off), per-capture trace length, and how
         # many trace dirs the rotation keeps.
@@ -244,6 +252,25 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             f"'GUBER_PROFILE_KEEP={conf.profile_keep}' is invalid; the "
             "rotation must keep at least 1 trace"
         )
+    if conf.slo_sample_interval_s < 0:
+        raise ValueError(
+            f"'GUBER_SLO_SAMPLE_INTERVAL={conf.slo_sample_interval_s}' is "
+            "invalid; must be >= 0 (0 disables the SLO observatory)"
+        )
+    if conf.watchdog_stall_ms < 0:
+        raise ValueError(
+            f"'GUBER_WATCHDOG_STALL_MS={conf.watchdog_stall_ms}' is "
+            "invalid; must be >= 0 (0 disables the watchdog)"
+        )
+    if conf.slo_specs:
+        # Fail a malformed GUBER_SLO_SPECS at config time, not at first
+        # observatory tick (spec shape errors included).
+        from gubernator_tpu.service.slo import parse_slo_specs
+
+        try:
+            parse_slo_specs(conf.slo_specs)
+        except ValueError as e:
+            raise ValueError(f"'GUBER_SLO_SPECS' is invalid: {e}") from None
     if conf.admission_ring < 1:
         raise ValueError(
             f"'GUBER_ADMISSION_RING={conf.admission_ring}' is invalid; "

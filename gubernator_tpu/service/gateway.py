@@ -15,6 +15,9 @@ Device-tier debug surface (docs/monitoring.md; no reference analog):
   temp dir (one capture at a time process-wide; 503 when busy or when
   the profiler is unavailable). Works on CPU too — the XLA profiler is
   backend-agnostic.
+- GET /debug/slo — the SLO observatory: per-SLO multi-window burn
+  rates, alert states, remaining error budgets, and the self-watchdog's
+  per-loop heartbeat table (docs/monitoring.md "SLOs & burn rates").
 
 Both are served by the main gateway AND the status listener
 (daemon.go:305-333 analog), so an mTLS deployment can reach them
@@ -139,6 +142,20 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         )
         return web.json_response(snap)
 
+    async def debug_slo(request: web.Request) -> web.Response:
+        """SLO observatory (docs/monitoring.md "SLOs & burn rates"):
+        per-SLO multi-window burn rates, alert states (ok / slow_burn /
+        fast_burn / exhausted), remaining error budgets, the sampled
+        SLI time-series summaries, and the self-watchdog's per-loop
+        heartbeat table. Pure host-side ring arithmetic over values the
+        background sampler already cached — scraping this endpoint does
+        zero device work; the ring reads take per-ring locks, so
+        executor. {"enabled": false} when the observatory isn't wired."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.slo_debug_info
+        )
+        return web.json_response(snap)
+
     async def debug_cluster(request: web.Request) -> web.Response:
         """Cluster-wide debug view (docs/monitoring.md "Consistency"):
         this node's local_debug_info plus a breaker-gated, shared-deadline
@@ -183,6 +200,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/leases", debug_leases)
     app.router.add_get("/debug/admission", debug_admission)
+    app.router.add_get("/debug/slo", debug_slo)
     app.router.add_get("/debug/cluster", debug_cluster)
 
 

@@ -131,6 +131,9 @@ class ContinuousProfiler:
         self.last = None  # most recent capture() result
         self._stop = threading.Event()
         self._thread = None
+        # Self-watchdog heartbeat seam, injected by the daemon (None
+        # keeps the sampler usable standalone in tests).
+        self.watchdog = None
 
     def start(self) -> bool:
         if self.interval_s <= 0 or self._thread is not None:
@@ -151,6 +154,13 @@ class ContinuousProfiler:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
+            wd = self.watchdog
+            if wd is not None:
+                # A capture blocks for up to `seconds`; fold it into the
+                # deadline so a slow trace isn't flagged as a stall.
+                wd.beat(
+                    "profiler", period_s=self.interval_s + self.seconds
+                )
             # Non-blocking: an in-flight /debug/profile capture wins and
             # this cycle is skipped, never queued behind it.
             if not PROFILE_GUARD.acquire(blocking=False):

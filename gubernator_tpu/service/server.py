@@ -116,6 +116,12 @@ class V1Service:
         # TTL-cached admission scan.
         self.recorder = DecisionRecorder(self.metrics, ring_size=admission_ring)
         self.metrics.add_sync(self._admission_sync)
+        # SLO observatory + self-watchdog seams (docs/monitoring.md
+        # "SLOs & burn rates"), wired by the daemon. The sync bridge is
+        # registered unconditionally and no-ops until wired.
+        self.slo = None  # SloObservatory
+        self.watchdog = None  # Watchdog
+        self.metrics.add_sync(self._slo_sync)
 
     # ---- V1.GetRateLimits (reference gubernator.go:183-309) ----------------
 
@@ -710,6 +716,12 @@ class V1Service:
             # census — /debug/cluster aggregates fleet-wide outstanding
             # slices (the over-admission bound) with no wire bump.
             info["leases"] = self.lease_mgr.summary()
+        if self.slo is not None:
+            # Compact SLO blob (per-SLO alert state + remaining error
+            # budget, no ring dumps) rides DebugInfo so /debug/cluster
+            # shows the fleet-wide budget view (docs/monitoring.md
+            # "SLOs & burn rates").
+            info["slo"] = self.slo.fleet_info()
         if keys:
             from gubernator_tpu.store.store import snapshots_from_engine
 
@@ -764,6 +776,27 @@ class V1Service:
         bound["total_hits"] = sum(bound.values())
         blob["bound"] = bound
         return blob
+
+    def slo_debug_info(self) -> dict:
+        """/debug/slo payload (docs/monitoring.md "SLOs & burn rates"):
+        per-SLO burn rates over every evaluation window, alert states,
+        remaining error budgets, the sampled SLI ring summaries, and
+        the watchdog's per-loop heartbeat table. Pure ring arithmetic
+        over already-sampled values — zero device work (GL009)."""
+        if self.slo is None:
+            return {"enabled": False}
+        return {"enabled": True, **self.slo.debug_info()}
+
+    def _slo_sync(self, _metrics=None) -> None:
+        """Scrape-time bridge for the SLO families (burn rate, budget
+        remaining, alert state) and gubernator_thread_stalled. No-op
+        until the daemon wires the observatory."""
+        if self.slo is None:
+            return
+        try:
+            self.slo.metrics_sync(self.metrics)
+        except Exception:  # guberlint: allow-swallow -- scrape bridge: a failed evaluation must not poison /metrics
+            return
 
     def _admission_sync(self, _metrics=None) -> None:
         """Scrape-time bridge: publish this node's measured over-admission

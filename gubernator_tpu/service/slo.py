@@ -1,0 +1,557 @@
+"""Fleet SLO observatory: declarative specs + multi-window burn rates.
+
+PRs 2/7/9/10/11/14 built a wide observation vector of point-in-time
+SLIs — enforcement-error ratio, propagation lag, flush/device-sync
+histograms, breaker states, census churn, lease outstanding — but
+nothing tracked them over TIME: no error budgets, no burn rates, no
+notion of "this has been bad for 5 minutes AND the last hour". This
+module closes that gap with the standard multi-window multi-burn-rate
+construction (Google SRE workbook ch. 5):
+
+  - a background sampler pushes each SLI into a bounded time-series
+    ring (utils/timeseries.py) every GUBER_SLO_SAMPLE_INTERVAL,
+    reading ONLY already-cached snapshots and host counters — a
+    sampler tick does zero device work (GL009; the engine's
+    cached_census()/cached_admission() accessors exist for exactly
+    this), so the observatory is free at any cadence;
+  - each SloSpec maps its ring to a bad-event fraction (comparator +
+    threshold against the raw SLI value) and an OBJECTIVE; burn rate
+    over a window = bad fraction / (1 - objective), so 1.0 means
+    "burning exactly at budget";
+  - the alert state machine fires `fast_burn` when BOTH fast windows
+    (default 5m and 1h) exceed the fast factor (14.4 — budget gone in
+    ~10h at that pace), `slow_burn` when both slow windows (30m / 6h)
+    exceed 6.0, and `exhausted` when the budget window's remaining
+    budget hits 0. Two windows per alert is what makes this page-able:
+    the short window gives fast detection, the long window keeps a
+    single bad scrape from paging anyone.
+
+Everything exports three ways: the gubernator_slo_* metric families
+(scrape bridge), the /debug/slo route on both listeners (gateway), and
+a compact blob riding DebugInfo so /debug/cluster shows the fleet-wide
+error-budget view. The self-watchdog (runtime/watchdog.py) feeds the
+availability SLI: a stalled SERVING loop (pump / completion thread)
+zeroes `serving_ok`, so a wedged daemon burns its availability budget
+instead of silently flatlining.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from gubernator_tpu.utils.timeseries import RingSet
+
+log = logging.getLogger(__name__)
+
+# Alert states, least to most severe; exported as the numeric value of
+# gubernator_slo_alert_state.
+STATES = ("ok", "slow_burn", "fast_burn", "exhausted")
+
+_COMPARATORS = ("gt", "ge", "lt", "le")
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One declarative SLO: which SLI ring, what counts as a bad
+    sample, the objective, and the evaluation windows. Frozen so specs
+    can be shared between the observatory, /debug/slo, and tests."""
+
+    id: str
+    sli: str  # ring name in the observatory's RingSet
+    objective: float  # e.g. 0.999 -> error budget 0.001
+    threshold: float = 0.0
+    comparator: str = "gt"  # sample is BAD when <value> <cmp> <threshold>
+    fast_windows: tuple = (300.0, 3600.0)  # 5m / 1h
+    slow_windows: tuple = (1800.0, 21600.0)  # 30m / 6h
+    fast_factor: float = 14.4
+    slow_factor: float = 6.0
+    budget_window_s: float = 21600.0  # budget accounted over 6h
+    description: str = ""
+
+    def validate(self) -> None:
+        if not self.id or not self.sli:
+            raise ValueError("SLO spec needs non-empty 'id' and 'sli'")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"SLO {self.id!r}: objective must be in (0, 1), got "
+                f"{self.objective}"
+            )
+        if self.comparator not in _COMPARATORS:
+            raise ValueError(
+                f"SLO {self.id!r}: comparator must be one of "
+                f"{_COMPARATORS}, got {self.comparator!r}"
+            )
+        for name, pair in (
+            ("fast_windows", self.fast_windows),
+            ("slow_windows", self.slow_windows),
+        ):
+            if len(pair) != 2 or not all(
+                isinstance(w, (int, float)) and w > 0 for w in pair
+            ):
+                raise ValueError(
+                    f"SLO {self.id!r}: {name} must be two positive "
+                    f"durations, got {pair!r}"
+                )
+        if self.budget_window_s <= 0:
+            raise ValueError(
+                f"SLO {self.id!r}: budget_window_s must be > 0"
+            )
+
+    def is_bad(self, value: float) -> bool:
+        if self.comparator == "gt":
+            return value > self.threshold
+        if self.comparator == "ge":
+            return value >= self.threshold
+        if self.comparator == "lt":
+            return value < self.threshold
+        return value <= self.threshold
+
+
+def default_specs() -> tuple:
+    """The built-in SLO catalog. Every id here must have a matching row
+    in docs/monitoring.md's alert table (guberlint GL015 pins both
+    directions)."""
+    return (
+        SloSpec(
+            id="availability",
+            sli="serving_ok",
+            objective=0.999,
+            threshold=1.0,
+            comparator="lt",
+            description="Serving loops alive: the watchdog saw the pump "
+            "and completion heartbeats within their stall deadline.",
+        ),
+        SloSpec(
+            id="admission-accuracy",
+            sli="admission_debt_ratio",
+            objective=0.999,
+            threshold=0.1,
+            comparator="gt",
+            description="Unreconciled admission debt — lease outstanding "
+            "+ GLOBAL in-flight hits, the published over-admission bound "
+            "(/debug/admission `bound`) — stays under 10% of the "
+            "capacity admitted this window. A partitioned owner strands "
+            "debt at the edges and burns this SLO until the heal drains "
+            "it.",
+        ),
+        SloSpec(
+            id="enforcement-fidelity",
+            sli="false_over_limit_keys",
+            objective=0.999,
+            threshold=0.0,
+            comparator="gt",
+            description="No sampled key is refused at a current replica "
+            "while the owner still has budget (auditor false-OVER_LIMIT).",
+        ),
+        SloSpec(
+            id="flush-latency",
+            sli="flush_p99_s",
+            objective=0.99,
+            threshold=0.1,
+            comparator="gt",
+            description="Engine flush p99 stays under 100ms.",
+        ),
+        SloSpec(
+            id="propagation-freshness",
+            sli="propagation_lag_p99_s",
+            objective=0.99,
+            threshold=5.0,
+            comparator="gt",
+            description="GLOBAL propagation lag p99 stays under 5s "
+            "(origin stamp to replica apply).",
+        ),
+        SloSpec(
+            id="shard-balance",
+            sli="shard_imbalance_ratio",
+            objective=0.99,
+            threshold=1.5,
+            comparator="gt",
+            description="Mesh shard skew (max/mean across decisions, "
+            "occupancy, resident frames) stays under 1.5x.",
+        ),
+    )
+
+
+_SPEC_FIELDS = {f.name for f in SloSpec.__dataclass_fields__.values()}
+
+
+def parse_slo_specs(text: str) -> tuple:
+    """GUBER_SLO_SPECS: a JSON list of spec dicts. An entry whose id
+    matches a built-in OVERRIDES it field-by-field (unset fields keep
+    the built-in's values — so shrinking just the windows for a soak
+    doesn't mean restating the whole spec); a new id appends. Raises
+    ValueError on malformed JSON or spec shape (envconfig fails the
+    daemon at config time, not at first tick)."""
+    if not text or not text.strip():
+        return default_specs()
+    try:
+        raw = json.loads(text)
+    except ValueError as e:
+        raise ValueError(f"not valid JSON ({e})") from None
+    if not isinstance(raw, list):
+        raise ValueError("must be a JSON LIST of spec objects")
+    base = {s.id: s for s in default_specs()}
+    order = list(base)
+    for i, entry in enumerate(raw):
+        if not isinstance(entry, dict) or "id" not in entry:
+            raise ValueError(f"entry {i} must be an object with an 'id'")
+        unknown = set(entry) - _SPEC_FIELDS
+        if unknown:
+            raise ValueError(
+                f"entry {entry['id']!r} has unknown fields {sorted(unknown)}"
+            )
+        for k in ("fast_windows", "slow_windows"):
+            if k in entry:
+                entry[k] = tuple(float(w) for w in entry[k])
+        sid = entry["id"]
+        if sid in base:
+            merged = {**base[sid].__dict__, **entry}
+            base[sid] = SloSpec(**merged)
+        else:
+            if "sli" not in entry or "objective" not in entry:
+                raise ValueError(
+                    f"new SLO {sid!r} needs at least 'sli' and 'objective'"
+                )
+            base[sid] = SloSpec(**entry)
+            order.append(sid)
+    specs = tuple(base[sid] for sid in order)
+    for s in specs:
+        s.validate()
+    return specs
+
+
+def _window_label(seconds: float) -> str:
+    """Stable human window label for the burn-rate gauge ('5m', '1h');
+    falls back to '<n>s' for non-round overrides."""
+    s = float(seconds)
+    if s % 3600 == 0:
+        return f"{int(s // 3600)}h"
+    if s % 60 == 0:
+        return f"{int(s // 60)}m"
+    return f"{s:g}s"
+
+
+class SloObservatory:
+    """Sampler thread + burn-rate evaluator for one daemon.
+
+    The sampler reads ONLY cached snapshots and host-side counters
+    (the sources list below documents each one's zero-device-work
+    justification); evaluation is pure ring arithmetic. Both are safe
+    from any thread at any cadence."""
+
+    def __init__(
+        self,
+        svc,
+        interval_s: float = 5.0,
+        specs: Optional[tuple] = None,
+        watchdog=None,
+    ):
+        self.svc = svc
+        self.interval_s = max(float(interval_s), 0.1)
+        self.specs = tuple(specs) if specs is not None else default_specs()
+        self.watchdog = watchdog
+        # Ring capacity: cover the largest window any spec evaluates at
+        # this cadence, bounded so a 1ms soak interval can't balloon.
+        horizon = max(
+            [self.interval_s]
+            + [
+                max(max(s.fast_windows), max(s.slow_windows),
+                    s.budget_window_s)
+                for s in self.specs
+            ]
+        )
+        cap = int(min(max(math.ceil(horizon / self.interval_s) + 8, 720),
+                      8640))
+        self.rings = RingSet(cap)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ticks = 0
+
+    # -- sampling (zero device work) ----------------------------------------
+
+    def sample_once(self, now: Optional[float] = None) -> None:
+        """One sampling pass. Sources, and why each does no device
+        work: cached_admission()/cached_census() return the TTL cache
+        or None (never scan); histogram summaries and breaker/lease/
+        auditor summaries are host dict walks; the pager's move
+        counters and the watchdog table are plain attributes. An SLI
+        whose source is absent this tick simply pushes nothing — its
+        windows read as empty, which the evaluator reports as
+        data-less rather than healthy."""
+        now = time.monotonic() if now is None else now
+        svc = self.svc
+        push = self.rings.push
+
+        # Availability: the watchdog's view of the serving loops. This
+        # is the SLI a wedged completion thread burns.
+        wd = self.watchdog
+        if wd is not None:
+            push("serving_ok", 0.0 if wd.serving_stalled() else 1.0, now)
+
+        eng = getattr(svc, "engine", None)
+        limit_hits = None
+        if eng is not None:
+            if hasattr(eng, "cached_admission"):
+                adm = eng.cached_admission()
+                if adm is not None:
+                    push(
+                        "admission_excess_ratio",
+                        float(adm.get("excess_ratio", 0.0)),
+                        now,
+                    )
+                    limit_hits = float(adm.get("limit_hits", 0) or 0)
+            em = getattr(eng, "metrics", None)
+            if em is not None:
+                fd = getattr(em, "flush_duration", None)
+                if fd is not None:
+                    push("flush_p99_s", float(fd.summary()["p99"]), now)
+                ds = getattr(em, "device_sync", None)
+                if ds is not None:
+                    push(
+                        "device_sync_p99_s",
+                        float(ds.summary()["p99"]),
+                        now,
+                    )
+            if hasattr(eng, "shard_stats"):
+                ss = eng.shard_stats()
+                if ss is not None and ss.get("imbalance_ratio") is not None:
+                    push(
+                        "shard_imbalance_ratio",
+                        float(ss["imbalance_ratio"]),
+                        now,
+                    )
+            pager = getattr(eng, "_pager", None)
+            if pager is not None:
+                # Cumulative move counters; rate() turns them into the
+                # paging-churn series /debug/slo reports.
+                push("page_demotes", float(pager.demotes), now)
+                push("page_promotes", float(pager.promotes), now)
+
+        m = getattr(svc, "metrics", None)
+        if m is not None and hasattr(m, "global_propagation_lag"):
+            lag = m.global_propagation_lag.summary()
+            if lag.get("count"):
+                push(
+                    "propagation_lag_p99_s", float(lag["p99"]), now
+                )
+
+        auditor = getattr(svc, "auditor", None)
+        if auditor is not None:
+            s = auditor.summary()
+            adm = s.get("admission") or {}
+            if "false_over_limit_keys" in adm:
+                push(
+                    "false_over_limit_keys",
+                    float(adm["false_over_limit_keys"]),
+                    now,
+                )
+            push(
+                "divergence_total",
+                float(sum((s.get("divergence") or {}).values())),
+                now,
+            )
+            push(
+                "max_staleness_ms", float(s.get("max_staleness_ms", 0)), now
+            )
+
+        lm = getattr(svc, "lease_mgr", None)
+        if lm is not None:
+            push(
+                "lease_outstanding_hits", float(lm.outstanding_hits()), now
+            )
+
+        # Admission debt: the node's published over-admission bound
+        # (lease outstanding + GLOBAL in-flight hits, /debug/admission
+        # `bound`) as a fraction of the capacity the TTL-cached
+        # admission scan saw admitted this window. This is the
+        # admission-accuracy SLI: a partitioned owner strands the
+        # GLOBAL hit queue at the edges, the ratio pins near 1, and
+        # the SLO fast-burns until the heal drains the debt.
+        gm = getattr(svc, "global_mgr", None)
+        debt = 0.0
+        have_debt = False
+        if lm is not None:
+            debt += float(lm.outstanding_hits())
+            have_debt = True
+        if gm is not None and hasattr(gm, "inflight_hits"):
+            debt += float(gm.inflight_hits())
+            have_debt = True
+        if have_debt and limit_hits:
+            push("admission_debt_ratio", debt / limit_hits, now)
+
+        fwd = getattr(svc, "forwarder", None)
+        if fwd is not None and hasattr(fwd, "breaker_summary"):
+            summary = fwd.breaker_summary()
+            if summary:
+                open_n = sum(1 for st in summary.values() if st != "closed")
+                push(
+                    "breaker_open_fraction", open_n / len(summary), now
+                )
+
+        self._ticks += 1
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate_spec(
+        self, spec: SloSpec, now: Optional[float] = None
+    ) -> dict:
+        """Burn rates + alert state for one spec, from its ring."""
+        now = time.monotonic() if now is None else now
+        ring = self.rings.get(spec.sli)
+        budget = 1.0 - spec.objective
+
+        def burn(window_s: float) -> Optional[float]:
+            if ring is None:
+                return None
+            frac = ring.bad_fraction(spec.is_bad, window_s, now)
+            return None if frac is None else frac / budget
+
+        windows = {}
+        for w in (*spec.fast_windows, *spec.slow_windows,
+                  spec.budget_window_s):
+            lbl = _window_label(w)
+            if lbl not in windows:
+                b = burn(w)
+                windows[lbl] = None if b is None else round(b, 4)
+
+        budget_burn = burn(spec.budget_window_s)
+        remaining = (
+            None
+            if budget_burn is None
+            else round(max(1.0 - budget_burn, 0.0), 4)
+        )
+
+        def pair_fires(pair, factor) -> bool:
+            burns = [burn(w) for w in pair]
+            return all(b is not None and b > factor for b in burns)
+
+        if remaining is not None and remaining <= 0.0:
+            state = "exhausted"
+        elif pair_fires(spec.fast_windows, spec.fast_factor):
+            state = "fast_burn"
+        elif pair_fires(spec.slow_windows, spec.slow_factor):
+            state = "slow_burn"
+        else:
+            state = "ok"
+        return {
+            "id": spec.id,
+            "sli": spec.sli,
+            "objective": spec.objective,
+            "state": state,
+            "state_value": STATES.index(state),
+            "burn_rates": windows,
+            "error_budget_remaining": remaining,
+            "samples": 0 if ring is None else len(ring),
+            "last": None if ring is None else (
+                None if ring.last() is None else round(ring.last()[1], 6)
+            ),
+        }
+
+    def evaluate(self, now: Optional[float] = None) -> list:
+        now = time.monotonic() if now is None else now
+        return [self.evaluate_spec(s, now) for s in self.specs]
+
+    # -- exports -------------------------------------------------------------
+
+    def debug_info(self) -> dict:
+        """/debug/slo payload; the compact `fleet` block also rides
+        DebugInfo so /debug/cluster aggregates error budgets."""
+        evals = self.evaluate()
+        out = {
+            "v": 1,
+            "sample_interval_s": self.interval_s,
+            "ticks": self._ticks,
+            "slos": evals,
+            "slis": self.rings.snapshot(window_s=300.0),
+        }
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.snapshot()
+        remaining = [
+            (e["error_budget_remaining"], e["id"])
+            for e in evals
+            if e["error_budget_remaining"] is not None
+        ]
+        worst = min(remaining) if remaining else None
+        out["budget"] = {
+            "min_remaining": None if worst is None else worst[0],
+            "worst_slo": None if worst is None else worst[1],
+            "alerting": sorted(
+                e["id"] for e in evals if e["state"] != "ok"
+            ),
+        }
+        return out
+
+    def fleet_info(self) -> dict:
+        """The DebugInfo rider: per-SLO state + budget, no ring dumps
+        (wire weight the fleet view doesn't need)."""
+        evals = self.evaluate()
+        info = {
+            "slos": {
+                e["id"]: {
+                    "state": e["state"],
+                    "error_budget_remaining": e["error_budget_remaining"],
+                }
+                for e in evals
+            },
+        }
+        if self.watchdog is not None:
+            info["serving_stalled"] = self.watchdog.serving_stalled()
+            info["stalled_loops"] = self.watchdog.stalled_loops()
+        return info
+
+    def metrics_sync(self, m) -> None:
+        """Scrape bridge (Metrics.add_sync): publish burn rates, budget
+        remaining, alert state, and the watchdog's per-loop stall flags.
+        Pure ring/dict arithmetic — zero device work on scrape."""
+        for e in self.evaluate():
+            for lbl, b in e["burn_rates"].items():
+                if b is not None:
+                    m.slo_burn_rate.labels(e["id"], lbl).set(b)
+            if e["error_budget_remaining"] is not None:
+                m.slo_error_budget_remaining.labels(e["id"]).set(
+                    e["error_budget_remaining"]
+                )
+            m.slo_alert_state.labels(e["id"]).set(e["state_value"])
+        wd = self.watchdog
+        if wd is not None:
+            snap = wd.snapshot()
+            for name, row in snap["loops"].items():
+                m.thread_stalled.labels(name).set(
+                    1 if row["stalled"] else 0
+                )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.watchdog is not None:
+                self.watchdog.beat("slo-sampler", period_s=self.interval_s)
+            try:
+                self.sample_once()
+            except Exception:
+                # A broken source must not kill the sampler — the SLI
+                # it feeds goes data-less, which /debug/slo surfaces.
+                log.exception("SLO sampling pass failed")
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="gubernator-slo-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        if self.watchdog is not None:
+            self.watchdog.unregister("slo-sampler")

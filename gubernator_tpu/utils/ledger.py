@@ -253,7 +253,7 @@ _MODE_FROM_JOB = re.compile(
     # order matters: longest-prefix first (mesh_ab before mesh, ici
     # after mesh so bench_mesh_ab_n8 never keys as ici)
     r"(kernel10m|kernel_ab|kernel|engine_ab|engine|server|global|latency"
-    r"|edge|mesh_ab|mesh|ici|paged_table|lease_soak|admission_soak)"
+    r"|edge|mesh_ab|mesh|ici|paged_table|lease_soak|admission_soak|slo_soak)"
 )
 _LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide|narrow)")
 

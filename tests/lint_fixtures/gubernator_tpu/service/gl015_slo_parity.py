@@ -1,0 +1,35 @@
+"""GL015 fixture: an SLO catalog constructing specs that have no row
+in docs/monitoring.md's "### SLO catalog" table.
+
+Scanned only when passed explicitly; the path maps to
+gubernator_tpu/service/gl015_slo_parity.py, which is listed in
+_SLO_CATALOG_FILES so the catalog-surface predicate fires. The doc
+table is the REAL docs/monitoring.md one, so documented ids
+(availability, admission-accuracy, ...) must stay quiet here while
+invented specs fire. Ghost-row findings (doc id with no code spec)
+are deliberately NOT exercised here — they only fire against the real
+service/slo.py.
+"""
+
+
+def SloSpec(**kw):
+    return kw
+
+
+def default_specs():
+    return [
+        # VIOLATION: no "### SLO catalog" row documents this spec
+        SloSpec(id="turbo-freshness", objective=0.99),
+        # VIOLATION: pragma without a reason still fails (requires_reason)
+        SloSpec(id="hyper-balance", objective=0.9),  # guberlint: allow-slo-catalog-parity
+        # ok: documented rows in the real catalog table
+        SloSpec(id="availability", objective=0.999),
+        SloSpec(id="admission-accuracy", objective=0.999),
+    ]
+
+
+# ok: reasoned pragma — witnessed-intentional undocumented spec
+def experimental_specs():
+    return [
+        SloSpec(id="probe-only-lag", objective=0.5),  # guberlint: allow-slo-catalog-parity -- fixture: internal canary spec, never pages
+    ]

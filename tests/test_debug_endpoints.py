@@ -182,6 +182,91 @@ def test_debug_cluster_carries_device_blob(daemon):
     assert "transfers" in local["device"]
 
 
+def test_debug_slo_served_on_both_listeners(daemon):
+    # force a sampling pass so the rings hold data regardless of the
+    # (5s default) sampler cadence vs test speed
+    daemon.svc.slo.sample_once()
+    for addr in (daemon.http_address, daemon.status_address):
+        r = requests.get(f"http://{addr}/debug/slo", timeout=10)
+        assert r.status_code == 200
+        blob = r.json()
+        assert blob["enabled"] is True
+        assert blob["v"] == 1
+        assert blob["sample_interval_s"] == 5.0
+        ids = [e["id"] for e in blob["slos"]]
+        assert ids == [
+            "availability",
+            "admission-accuracy",
+            "enforcement-fidelity",
+            "flush-latency",
+            "propagation-freshness",
+            "shard-balance",
+        ]
+        for e in blob["slos"]:
+            assert e["state"] in ("ok", "slow_burn", "fast_burn",
+                                  "exhausted")
+            assert set(e["burn_rates"])  # every window labelled
+        by_id = {e["id"]: e for e in blob["slos"]}
+        # serving loops beat and the sampler just ran: availability is
+        # provably healthy, not merely data-less
+        avail = by_id["availability"]
+        assert avail["state"] == "ok"
+        assert avail["error_budget_remaining"] == 1.0
+        assert blob["slis"]["serving_ok"]["last"] == 1.0
+        assert "flush_p99_s" in blob["slis"]
+        loops = blob["watchdog"]["loops"]
+        assert {"engine-pump", "engine-complete", "slo-sampler"} <= set(
+            loops
+        )
+        assert not any(row["stalled"] for row in loops.values())
+        assert blob["budget"]["alerting"] == []
+        assert blob["budget"]["min_remaining"] == 1.0
+
+
+def test_debug_cluster_carries_slo_blob(daemon):
+    daemon.svc.slo.sample_once()
+    r = requests.get(
+        f"http://{daemon.http_address}/debug/cluster", timeout=10
+    )
+    local = r.json()["local"]
+    slo = local["slo"]
+    assert slo["slos"]["availability"]["state"] == "ok"
+    assert slo["serving_stalled"] is False
+    assert slo["stalled_loops"] == []
+    # compact rider: no ring dumps on the fleet path
+    assert "slis" not in slo
+
+
+def test_slo_metrics_families_exported(daemon):
+    daemon.svc.slo.sample_once()
+    text = requests.get(
+        f"http://{daemon.http_address}/metrics", timeout=10
+    ).text
+    assert 'gubernator_slo_alert_state{slo="availability"} 0' in text
+    assert 'gubernator_slo_error_budget_remaining{slo="availability"} 1' in (
+        text
+    )
+    assert 'gubernator_slo_burn_rate{slo="availability",window="5m"}' in text
+    assert 'gubernator_thread_stalled{loop="engine-pump"} 0' in text
+
+
+def test_slo_scrape_does_zero_device_work(daemon):
+    """The whole observatory path — sampler pass, /debug/slo, /metrics
+    scrape — must never compile or dispatch device work (GL009)."""
+    for _ in range(3):
+        daemon.svc.slo.sample_once()
+        requests.get(
+            f"http://{daemon.http_address}/debug/slo", timeout=10
+        ).raise_for_status()
+        requests.get(
+            f"http://{daemon.http_address}/metrics", timeout=10
+        ).raise_for_status()
+    snap = requests.get(
+        f"http://{daemon.http_address}/debug/engine", timeout=10
+    ).json()
+    assert snap["counters"]["cold_compiles"] == 0
+
+
 def test_debug_profile_rejects_junk_seconds(daemon):
     r = requests.get(
         f"http://{daemon.http_address}/debug/profile",

@@ -66,7 +66,7 @@ def test_registry_complete():
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
         "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
-        "GL014",
+        "GL014", "GL015",
     }
 
 
@@ -185,6 +185,14 @@ _CASES = [
         3,  # 2 uncovered entry points + 1 reason-less pragma; names
             # covered by the real parity map (decide, decide_flat) and
             # the reasoned-pragma reference stay quiet
+    ),
+    (
+        "GL015",
+        fixture("service", "gl015_slo_parity.py"),
+        {"'turbo-freshness'", "requires a non-empty reason"},
+        2,  # 1 undocumented spec + 1 reason-less pragma; ids with real
+            # "### SLO catalog" rows and the reasoned-pragma spec stay
+            # quiet (ghost rows only fire against the real slo.py)
     ),
 ]
 
@@ -309,3 +317,22 @@ def test_gl014_repo_baseline_zero_and_map_valid():
     assert cases, "KERNEL_PARITY_CASES must exist in tests/test_kernel_fuzz.py"
     dangling = {k: v for k, v in cases.items() if v not in funcs}
     assert dangling == {}
+
+
+def test_gl015_repo_baseline_zero_and_doc_table_valid():
+    # The shipping SLO catalog must be FULLY documented and the doc
+    # table must list no ghosts — GL015's repo baseline is pinned at
+    # zero in BOTH directions.
+    res = run_lint(
+        paths=["gubernator_tpu/service/slo.py"], rule_codes=["GL015"]
+    )
+    assert [f.render() for f in res.new] == []
+
+    from tools.lint.rules import slo_doc_ids
+
+    ids = slo_doc_ids()
+    assert ids, 'docs/monitoring.md must carry a "### SLO catalog" table'
+    # the doc parse and the live catalog agree exactly
+    from gubernator_tpu.service.slo import default_specs
+
+    assert ids == {s.id for s in default_specs()}

@@ -1,0 +1,357 @@
+"""SLO burn-rate engine (service/slo.py): spec parsing/overrides, the
+multi-window multi-burn-rate state machine against hand-computed
+fractions, budget exhaustion, the metrics bridge, and the sampler's
+zero-device-work sourcing (cached snapshots only)."""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.service.slo import (
+    STATES,
+    SloObservatory,
+    SloSpec,
+    default_specs,
+    parse_slo_specs,
+    _window_label,
+)
+from gubernator_tpu.runtime.watchdog import Watchdog
+
+
+def _spec(**kw):
+    base = dict(
+        id="t",
+        sli="x",
+        objective=0.999,
+        threshold=0.5,
+        comparator="gt",
+        fast_windows=(5.0, 10.0),
+        slow_windows=(10.0, 20.0),
+        budget_window_s=20.0,
+    )
+    base.update(kw)
+    return SloSpec(**base)
+
+
+def _obs(spec):
+    return SloObservatory(SimpleNamespace(), interval_s=1.0, specs=(spec,))
+
+
+NOW = 10_000.0
+
+
+def _push(obs, values, dt=1.0):
+    """Newest sample lands exactly at NOW."""
+    t0 = NOW - (len(values) - 1) * dt
+    for i, v in enumerate(values):
+        obs.rings.push("x", v, t0 + i * dt)
+
+
+class TestSpecs:
+    def test_default_catalog_ids(self):
+        ids = [s.id for s in default_specs()]
+        assert ids == [
+            "availability",
+            "admission-accuracy",
+            "enforcement-fidelity",
+            "flush-latency",
+            "propagation-freshness",
+            "shard-balance",
+        ]
+        for s in default_specs():
+            s.validate()
+
+    def test_parse_empty_returns_defaults(self):
+        assert [s.id for s in parse_slo_specs("")] == [
+            s.id for s in default_specs()
+        ]
+
+    def test_parse_override_merges_fields(self):
+        txt = json.dumps(
+            [{"id": "flush-latency", "threshold": 0.25,
+              "fast_windows": [2, 4]}]
+        )
+        by = {s.id: s for s in parse_slo_specs(txt)}
+        s = by["flush-latency"]
+        assert s.threshold == 0.25
+        assert s.fast_windows == (2.0, 4.0)
+        # unset fields keep the built-in values
+        assert s.objective == 0.99
+        assert s.sli == "flush_p99_s"
+
+    def test_parse_appends_new_id(self):
+        txt = json.dumps(
+            [{"id": "custom", "sli": "my_sli", "objective": 0.9}]
+        )
+        specs = parse_slo_specs(txt)
+        assert specs[-1].id == "custom"
+        assert len(specs) == len(default_specs()) + 1
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "not json",
+            '{"id": "x"}',  # not a list
+            '[{"sli": "x"}]',  # no id
+            '[{"id": "new-one"}]',  # new id missing sli/objective
+            '[{"id": "availability", "bogus_field": 1}]',
+            '[{"id": "availability", "objective": 1.5}]',
+            '[{"id": "availability", "comparator": "!="}]',
+            '[{"id": "availability", "fast_windows": [5]}]',
+            '[{"id": "availability", "budget_window_s": 0}]',
+        ],
+    )
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_slo_specs(bad)
+
+    def test_comparators(self):
+        assert _spec(comparator="gt", threshold=1.0).is_bad(1.5)
+        assert not _spec(comparator="gt", threshold=1.0).is_bad(1.0)
+        assert _spec(comparator="ge", threshold=1.0).is_bad(1.0)
+        assert _spec(comparator="lt", threshold=1.0).is_bad(0.5)
+        assert _spec(comparator="le", threshold=1.0).is_bad(1.0)
+
+    def test_window_labels(self):
+        assert _window_label(300) == "5m"
+        assert _window_label(3600) == "1h"
+        assert _window_label(21600) == "6h"
+        assert _window_label(2.5) == "2.5s"
+
+
+class TestBurnRates:
+    def test_burn_rate_hand_computed(self):
+        # samples at ts NOW-9..NOW, the two oldest bad; budget = 0.001.
+        spec = _spec()
+        obs = _obs(spec)
+        _push(obs, [1.0, 1.0] + [0.0] * 8)
+        e = obs.evaluate_spec(spec, now=NOW)
+        # 5s window keeps ts > NOW-5 => last 5 samples, all good => 0;
+        # 10s window keeps all 10 => 2 bad => 0.2 / 0.001 = 200.
+        assert e["burn_rates"]["5s"] == 0.0
+        assert e["burn_rates"]["10s"] == pytest.approx(200.0, rel=1e-3)
+        assert e["burn_rates"]["20s"] == pytest.approx(200.0, rel=1e-3)
+
+    def test_state_ok_when_any_window_below_factor(self):
+        # Bad only long ago: long window burns, short window clean —
+        # the two-window AND must hold the alert back.
+        spec = _spec()
+        obs = _obs(spec)
+        _push(obs, [1.0] * 5 + [0.0] * 6)
+        e = obs.evaluate_spec(spec, now=NOW)
+        assert e["burn_rates"]["5s"] == 0.0
+        assert e["state"] in ("ok", "exhausted")  # not fast/slow burn
+
+    def test_exhausted_outranks_fast_burn(self):
+        spec = _spec(objective=0.99, budget_window_s=1000.0)
+        obs = _obs(spec)
+        _push(obs, [1.0] * 10)
+        e = obs.evaluate_spec(spec, now=NOW)
+        # burn = 1.0/0.01 = 100 > 14.4 on both fast windows, but the
+        # budget window sees only all-bad samples => remaining 0 =>
+        # exhausted outranks fast_burn.
+        assert e["state"] == "exhausted"
+
+    def test_fast_burn_outranks_slow_burn(self):
+        # Budget window long & mostly clean so remaining stays > 0:
+        # 1900 clean samples ending at NOW-100, then a 10-sample
+        # all-bad burst ending at NOW.
+        spec = _spec(objective=0.99, budget_window_s=2000.0)
+        obs = _obs(spec)
+        t0 = NOW - 1999.0
+        for i in range(1900):
+            obs.rings.push("x", 0.0, t0 + i)
+        _push(obs, [1.0] * 10)
+        e = obs.evaluate_spec(spec, now=NOW)
+        # fast pair (5s, 10s) both see only bad => burn 100 > 14.4;
+        # budget: 10 bad of 1910 => frac ~0.0052 => burn ~0.52.
+        assert e["state"] == "fast_burn"
+        assert e["state_value"] == STATES.index("fast_burn")
+        assert e["error_budget_remaining"] == pytest.approx(
+            1.0 - (10 / 1910) / 0.01, abs=0.01
+        )
+
+    def test_slow_burn_without_fast(self):
+        # Tuned so the slow pair burns in (6, 14.4] but the 5s fast
+        # window is clean: bad samples at NOW-15 and NOW-9 only, plus
+        # 1980 clean older samples keeping the budget burn << 1.
+        spec = _spec(objective=0.99, budget_window_s=2000.0)
+        obs = _obs(spec)
+        t0 = NOW - 1999.0
+        for i in range(1980):
+            obs.rings.push("x", 0.0, t0 + i)
+        recent = [1.0 if i in (4, 10) else 0.0 for i in range(20)]
+        _push(obs, recent)  # ts NOW-19..NOW; bad at NOW-15, NOW-9
+        e = obs.evaluate_spec(spec, now=NOW)
+        # 5s: clean => 0 (fast AND fails); 10s: 1 bad of 10 => burn 10;
+        # 20s: 2 bad of 20 => burn 10; both slow > 6 => slow_burn.
+        assert e["burn_rates"]["5s"] == 0.0
+        assert e["burn_rates"]["10s"] == pytest.approx(10.0, rel=1e-3)
+        assert e["burn_rates"]["20s"] == pytest.approx(10.0, rel=1e-3)
+        assert e["state"] == "slow_burn"
+        # 2 bad of 2000 over the budget window => burn 0.1 => 0.9 left
+        assert e["error_budget_remaining"] == pytest.approx(0.9)
+
+    def test_no_data_is_ok_not_firing(self):
+        spec = _spec()
+        e = _obs(spec).evaluate_spec(spec, now=NOW)
+        assert e["state"] == "ok"
+        assert e["error_budget_remaining"] is None
+        assert all(v is None for v in e["burn_rates"].values())
+        assert e["samples"] == 0
+
+    def test_budget_remaining_clamped(self):
+        spec = _spec(objective=0.999)
+        obs = _obs(spec)
+        _push(obs, [1.0] * 10)
+        e = obs.evaluate_spec(spec, now=NOW)
+        assert e["error_budget_remaining"] == 0.0
+        assert e["state"] == "exhausted"
+
+
+class TestExports:
+    def test_debug_info_shape(self):
+        spec = _spec()
+        wd = Watchdog(stall_ms=50.0)
+        obs = SloObservatory(
+            SimpleNamespace(), interval_s=1.0, specs=(spec,), watchdog=wd
+        )
+        obs.rings.push("x", 0.0)
+        blob = obs.debug_info()
+        assert blob["v"] == 1
+        assert [e["id"] for e in blob["slos"]] == ["t"]
+        assert "x" in blob["slis"]
+        assert "loops" in blob["watchdog"]
+        assert set(blob["budget"]) == {
+            "min_remaining", "worst_slo", "alerting"
+        }
+        json.dumps(blob)  # JSON-able end to end
+
+    def test_fleet_info_compact(self):
+        spec = _spec()
+        obs = _obs(spec)
+        info = obs.fleet_info()
+        assert info["slos"]["t"]["state"] == "ok"
+        assert "slis" not in info  # no ring dumps on the wire
+
+    def test_metrics_sync_families(self):
+        spec = _spec(objective=0.99, budget_window_s=20.0)
+        wd = Watchdog(stall_ms=50.0)
+        obs = SloObservatory(
+            SimpleNamespace(), interval_s=1.0, specs=(spec,), watchdog=wd
+        )
+        wd.beat("engine-pump", serving=True)
+        for _ in range(10):
+            obs.rings.push("x", 1.0)
+        m = Metrics()
+        obs.metrics_sync(m)
+        fams = {
+            s.name: s for s in m.registry.collect()
+        }
+        burn = fams["gubernator_slo_burn_rate"].samples
+        assert any(s.labels["slo"] == "t" for s in burn)
+        state = fams["gubernator_slo_alert_state"].samples
+        assert state[0].value == STATES.index("exhausted")
+        rem = fams["gubernator_slo_error_budget_remaining"].samples
+        assert rem[0].value == 0.0
+        stalled = fams["gubernator_thread_stalled"].samples
+        assert {s.labels["loop"] for s in stalled} == {"engine-pump"}
+        assert stalled[0].value == 0
+
+
+class TestSamplerSources:
+    """sample_once reads ONLY cached accessors — a None cache pushes
+    nothing, and a populated cache lands in the right ring."""
+
+    def test_cached_admission_none_pushes_nothing(self):
+        eng = SimpleNamespace(
+            cached_admission=lambda: None, metrics=None, _pager=None
+        )
+        obs = SloObservatory(SimpleNamespace(engine=eng), interval_s=1.0)
+        obs.sample_once(now=NOW)
+        assert obs.rings.get("admission_excess_ratio") is None
+
+    def test_cached_admission_sampled(self):
+        eng = SimpleNamespace(
+            cached_admission=lambda: {"excess_ratio": 0.25},
+            metrics=None,
+            _pager=None,
+        )
+        obs = SloObservatory(SimpleNamespace(engine=eng), interval_s=1.0)
+        obs.sample_once(now=NOW)
+        assert obs.rings.get("admission_excess_ratio").last()[1] == 0.25
+
+    def test_admission_debt_ratio_sampled(self):
+        # debt = lease outstanding + GLOBAL in-flight, over the cached
+        # scan's limit_hits: (30 + 50) / 400 = 0.2
+        eng = SimpleNamespace(
+            cached_admission=lambda: {
+                "excess_ratio": 0.0, "limit_hits": 400
+            },
+            metrics=None,
+            _pager=None,
+        )
+        svc = SimpleNamespace(
+            engine=eng,
+            lease_mgr=SimpleNamespace(outstanding_hits=lambda: 30),
+            global_mgr=SimpleNamespace(inflight_hits=lambda: 50),
+        )
+        obs = SloObservatory(svc, interval_s=1.0)
+        obs.sample_once(now=NOW)
+        assert obs.rings.get("admission_debt_ratio").last()[1] == (
+            pytest.approx(0.2)
+        )
+
+    def test_admission_debt_needs_warm_denominator(self):
+        # no cached admission scan => no limit_hits => the debt ratio
+        # is unreportable, NOT zero: push nothing, window reads empty
+        svc = SimpleNamespace(
+            engine=SimpleNamespace(
+                cached_admission=lambda: None, metrics=None, _pager=None
+            ),
+            global_mgr=SimpleNamespace(inflight_hits=lambda: 50),
+        )
+        obs = SloObservatory(svc, interval_s=1.0)
+        obs.sample_once(now=NOW)
+        assert obs.rings.get("admission_debt_ratio") is None
+
+    def test_watchdog_feeds_serving_ok(self):
+        wd = Watchdog(stall_ms=10.0)
+        wd.beat("engine-pump", serving=True, period_s=0.0)
+        obs = SloObservatory(
+            SimpleNamespace(), interval_s=1.0, watchdog=wd
+        )
+        obs.sample_once()
+        assert obs.rings.get("serving_ok").last()[1] == 1.0
+        # stall it: no beat for > deadline
+        import time
+
+        time.sleep(0.05)
+        wd.check()
+        obs.sample_once()
+        assert obs.rings.get("serving_ok").last()[1] == 0.0
+
+    def test_sampler_source_failure_isolated(self):
+        def boom():
+            raise RuntimeError("cache on fire")
+
+        eng = SimpleNamespace(
+            cached_admission=boom, metrics=None, _pager=None
+        )
+        obs = SloObservatory(SimpleNamespace(engine=eng), interval_s=1.0)
+        with pytest.raises(RuntimeError):
+            obs.sample_once(now=NOW)
+        # The loop wrapper isolates source failures: run the sampler
+        # thread against the broken source and prove it survives.
+        import time
+
+        obs.interval_s = 0.01
+        obs.start()
+        try:
+            time.sleep(0.1)
+            assert obs._thread is not None and obs._thread.is_alive()
+            assert obs._ticks == 0  # every pass failed, none crashed it
+        finally:
+            obs.stop()

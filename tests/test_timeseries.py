@@ -1,0 +1,191 @@
+"""Bounded time-series rings (utils/timeseries.py): every windowed
+reduction pinned against a numpy oracle, including ring wraparound
+(capacity eviction must drop exactly the oldest samples) and the
+empty-window edges the burn-rate engine depends on (None, never 0 —
+absence of data must not read as health)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.utils.timeseries import Ring, RingSet
+
+
+def _fill(ring, values, t0=1000.0, dt=1.0):
+    for i, v in enumerate(values):
+        ring.push(v, ts=t0 + i * dt)
+    return t0 + (len(values) - 1) * dt  # ts of the newest sample
+
+
+class TestRingBasics:
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            Ring(0)
+
+    def test_samples_oldest_first(self):
+        r = Ring(8)
+        _fill(r, [3.0, 1.0, 2.0])
+        assert [v for _, v in r.samples()] == [3.0, 1.0, 2.0]
+        assert len(r) == 3
+
+    def test_wraparound_keeps_newest(self):
+        r = Ring(4)
+        _fill(r, list(range(10)))  # ts 1000..1009
+        assert len(r) == 4
+        assert [v for _, v in r.samples()] == [6.0, 7.0, 8.0, 9.0]
+        assert [t for t, _ in r.samples()] == [1006.0, 1007.0, 1008.0, 1009.0]
+        assert r.last() == (1009.0, 9.0)
+
+    def test_last_empty(self):
+        assert Ring(4).last() is None
+
+    def test_window_filters_by_time(self):
+        r = Ring(16)
+        now = _fill(r, [1.0] * 10)  # ts 1000..1009
+        # window_s=3 => ts > now-3 = 1006 => 1007, 1008, 1009
+        assert len(r.window(3.0, now=now)) == 3
+        assert r.window(0.5, now=now + 100) == []
+
+
+class TestReductionsVsNumpy:
+    VALUES = [5.0, 1.0, 4.0, 4.0, 2.0, 8.0, 0.5, 7.0]
+
+    def test_mean_matches_numpy(self):
+        r = Ring(32)
+        now = _fill(r, self.VALUES)
+        got = r.mean(100.0, now=now)
+        assert got == pytest.approx(np.mean(self.VALUES))
+
+    @pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.9, 0.99, 1.0])
+    def test_quantile_matches_numpy(self, q):
+        r = Ring(32)
+        now = _fill(r, self.VALUES)
+        got = r.quantile(q, 100.0, now=now)
+        assert got == pytest.approx(np.quantile(self.VALUES, q))
+
+    def test_quantile_matches_numpy_after_wraparound(self):
+        r = Ring(4)
+        now = _fill(r, self.VALUES)
+        kept = self.VALUES[-4:]
+        for q in (0.0, 0.5, 0.99):
+            assert r.quantile(q, 100.0, now=now) == pytest.approx(
+                np.quantile(kept, q)
+            )
+
+    def test_quantile_windowed_subset(self):
+        r = Ring(32)
+        now = _fill(r, self.VALUES)  # dt=1 => window 2.5 keeps last 3
+        sub = self.VALUES[-3:]
+        assert r.quantile(0.5, 2.5, now=now) == pytest.approx(
+            np.quantile(sub, 0.5)
+        )
+
+    def test_quantile_bad_q(self):
+        r = Ring(4)
+        _fill(r, [1.0])
+        with pytest.raises(ValueError):
+            r.quantile(1.5, 10.0, now=2000.0)
+
+    def test_rate_counter_delta(self):
+        r = Ring(16)
+        # counter going 0,10,30 at 1s apart => (30-0)/2 per second
+        now = _fill(r, [0.0, 10.0, 30.0])
+        assert r.rate(100.0, now=now) == pytest.approx(15.0)
+
+    def test_rate_counter_reset_clamps(self):
+        r = Ring(16)
+        now = _fill(r, [100.0, 5.0])  # restart mid-ring
+        assert r.rate(100.0, now=now) == 0.0
+
+    def test_bad_fraction(self):
+        r = Ring(16)
+        now = _fill(r, self.VALUES)
+        want = np.mean([v > 4.0 for v in self.VALUES])
+        assert r.bad_fraction(lambda v: v > 4.0, 100.0, now=now) == (
+            pytest.approx(want)
+        )
+
+
+class TestEmptyWindowEdges:
+    """None on no-data, never 0: the burn-rate engine reads None as
+    'unproven', and a 0 here would mask a dead sampler as health."""
+
+    @pytest.mark.parametrize(
+        "reduce",
+        [
+            lambda r: r.mean(10.0, now=5000.0),
+            lambda r: r.quantile(0.5, 10.0, now=5000.0),
+            lambda r: r.rate(10.0, now=5000.0),
+            lambda r: r.bad_fraction(lambda v: True, 10.0, now=5000.0),
+        ],
+    )
+    def test_empty_ring(self, reduce):
+        assert reduce(Ring(8)) is None
+
+    @pytest.mark.parametrize(
+        "reduce",
+        [
+            lambda r: r.mean(1.0, now=9000.0),
+            lambda r: r.quantile(0.5, 1.0, now=9000.0),
+            lambda r: r.bad_fraction(lambda v: True, 1.0, now=9000.0),
+        ],
+    )
+    def test_stale_samples_outside_window(self, reduce):
+        r = Ring(8)
+        _fill(r, [1.0, 2.0, 3.0])  # ts ~1000, window 'now' is 9000
+        assert reduce(r) is None
+
+    def test_rate_single_sample_is_none(self):
+        r = Ring(8)
+        now = _fill(r, [5.0])
+        assert r.rate(100.0, now=now) is None
+
+
+class TestRingSet:
+    def test_lazy_rings_and_snapshot(self):
+        rs = RingSet(8)
+        assert rs.get("x") is None
+        rs.push("x", 1.0, ts=1000.0)
+        rs.push("x", 3.0, ts=1001.0)
+        rs.push("y", 7.0, ts=1001.0)
+        assert rs.names() == ["x", "y"]
+        assert rs.ring("x") is rs.get("x")
+        snap = rs.snapshot()
+        assert snap["x"] == {"n": 2, "last": 3.0}
+        assert snap["y"]["last"] == 7.0
+
+    def test_snapshot_windowed_mean(self):
+        rs = RingSet(8)
+        rs.push("x", 2.0, ts=1000.0)
+        rs.push("x", 4.0, ts=1000.5)
+        snap = rs.snapshot(window_s=10.0)
+        # pushed with explicit old timestamps; relative to monotonic
+        # 'now' these are ancient, so the windowed mean reads None.
+        assert "mean" in snap["x"]
+
+    def test_concurrent_push_and_reduce(self):
+        """One writer + one reducer hammering the same ring must never
+        raise or corrupt the count (the sampler/scrape split)."""
+        r = Ring(64)
+        stop = threading.Event()
+        errs = []
+
+        def reducer():
+            while not stop.is_set():
+                try:
+                    r.mean(1e9)
+                    r.quantile(0.5, 1e9)
+                    r.samples()
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+
+        t = threading.Thread(target=reducer)
+        t.start()
+        for i in range(5000):
+            r.push(float(i))
+        stop.set()
+        t.join()
+        assert not errs
+        assert len(r) == 64

@@ -1,0 +1,248 @@
+"""Self-watchdog (runtime/watchdog.py): heartbeat registration, stall
+detection within the deadline bound, recovery, stall-event counting —
+then the two real wedges the ISSUE pins: a completion thread stuck in
+its completion stage and a background demoter stuck mid-census, each
+flagged by name within the stall bound and cleared on recovery, with
+the gubernator_thread_stalled children following."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from gubernator_tpu.api.types import RateLimitReq
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.runtime.watchdog import Watchdog
+from gubernator_tpu.service.slo import SloObservatory
+
+NOW = 1_753_700_000_000
+
+
+def mk(key="k", **kw):
+    kw.setdefault("name", "wd")
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 1000)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(unique_key=key, **kw)
+
+
+def _stalled_children(wd):
+    """gubernator_thread_stalled children as {loop: value} via the SLO
+    observatory's scrape bridge (the production export path)."""
+    m = Metrics()
+    obs = SloObservatory(SimpleNamespace(), interval_s=1.0, watchdog=wd)
+    obs.metrics_sync(m)
+    fams = {f.name: f for f in m.registry.collect()}
+    return {
+        s.labels["loop"]: s.value
+        for s in fams["gubernator_thread_stalled"].samples
+    }
+
+
+class TestWatchdogUnit:
+    def test_beat_registers_and_check_clears(self):
+        wd = Watchdog(stall_ms=100.0)
+        wd.beat("a")
+        assert wd.check() == {"a": False}
+        assert wd.stalled_loops() == []
+
+    def test_stall_flagged_within_deadline_bound(self):
+        wd = Watchdog(stall_ms=100.0)
+        t0 = time.monotonic()
+        wd.beat("a")
+        # Drive check() with explicit clock: just inside the deadline
+        # is healthy, just past it stalls.
+        assert wd.check(now=t0 + 0.09) == {"a": False}
+        assert wd.check(now=t0 + 0.11) == {"a": True}
+        assert wd.stalled_loops() == ["a"]
+
+    def test_period_widens_deadline(self):
+        wd = Watchdog(stall_ms=100.0)
+        t0 = time.monotonic()
+        wd.beat("slow", period_s=1.0)  # deadline = 0.1 + 1.0
+        assert wd.check(now=t0 + 1.0) == {"slow": False}
+        assert wd.check(now=t0 + 1.2) == {"slow": True}
+
+    def test_recovery_clears_and_counts_one_event(self):
+        wd = Watchdog(stall_ms=50.0)
+        t0 = time.monotonic()
+        wd.beat("a")
+        wd.check(now=t0 + 1.0)
+        wd.check(now=t0 + 2.0)  # still the SAME stall: one event
+        assert wd.snapshot()["loops"]["a"]["stall_events"] == 1
+        wd.beat("a")
+        assert wd.check() == {"a": False}
+        assert wd.snapshot()["loops"]["a"]["stall_events"] == 1
+        # a second distinct stall increments again
+        wd.check(now=time.monotonic() + 1.0)
+        assert wd.snapshot()["loops"]["a"]["stall_events"] == 2
+
+    def test_serving_stalled_only_for_serving_loops(self):
+        wd = Watchdog(stall_ms=50.0)
+        wd.beat("background")
+        wd.beat("pump", serving=True)
+        time.sleep(0.1)
+        wd.check()  # both past the 50ms deadline
+        assert wd.serving_stalled() is True
+        wd.beat("pump")
+        wd.check()
+        # background still stalled, but it is not a serving loop
+        assert wd.stalled_loops() == ["background"]
+        assert wd.serving_stalled() is False
+
+    def test_unregister_removes_loop(self):
+        wd = Watchdog(stall_ms=50.0)
+        wd.beat("gone")
+        wd.unregister("gone")
+        assert wd.check() == {}
+        assert wd.snapshot()["loops"] == {}
+
+    def test_snapshot_shape(self):
+        wd = Watchdog(stall_ms=50.0)
+        wd.beat("a", serving=True, period_s=0.5)
+        snap = wd.snapshot()
+        assert snap["stall_ms"] == 50.0
+        row = snap["loops"]["a"]
+        assert set(row) == {
+            "age_ms", "deadline_ms", "serving", "stalled", "stall_events"
+        }
+        assert row["serving"] is True
+        assert row["deadline_ms"] == pytest.approx(550.0)
+
+    def test_monitor_thread_flags_without_explicit_check(self):
+        wd = Watchdog(stall_ms=60.0)
+        wd.beat("a")
+        wd.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                if wd.snapshot()["loops"]["a"]["stalled"]:
+                    break
+                time.sleep(0.01)
+            assert wd.snapshot()["loops"]["a"]["stalled"] is True
+            # the monitor loop heartbeats itself
+            assert "watchdog-monitor" in wd.snapshot()["loops"]
+        finally:
+            wd.stop()
+
+
+class TestWedgedCompletionThread:
+    def test_wedged_completion_flagged_and_recovers(self):
+        eng = DeviceEngine(
+            EngineConfig(
+                num_groups=1 << 10,
+                batch_size=64,
+                batch_wait_s=0.002,
+                pipeline_depth=2,
+            ),
+            now_fn=lambda: NOW,
+        )
+        wd = Watchdog(stall_ms=300.0)
+        eng.watchdog = wd
+        release = threading.Event()
+        orig = eng._complete_ticket
+
+        def wedged(t):
+            release.wait(timeout=10.0)
+            return orig(t)
+
+        try:
+            # prove liveness first: idle loop heartbeats via bounded get
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if "engine-complete" in wd.check():
+                    break
+                time.sleep(0.02)
+            assert wd.check().get("engine-complete") is False
+
+            eng._complete_ticket = wedged
+            fut = eng.check_bulk([mk()])
+            # the wedge holds the loop inside the completion stage; the
+            # stall must be flagged within the deadline + one bounded-get
+            # cycle (0.5s), with margin for slow CI
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if wd.check().get("engine-complete"):
+                    break
+                time.sleep(0.02)
+            assert wd.check()["engine-complete"] is True
+            assert wd.serving_stalled() is True  # serving loop => SLO burn
+            assert _stalled_children(wd)["engine-complete"] == 1
+
+            release.set()
+            assert fut.result(timeout=10.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if not wd.check()["engine-complete"]:
+                    break
+                time.sleep(0.02)
+            assert wd.check()["engine-complete"] is False
+            assert wd.serving_stalled() is False
+            assert _stalled_children(wd)["engine-complete"] == 0
+            assert (
+                wd.snapshot()["loops"]["engine-complete"]["stall_events"] >= 1
+            )
+        finally:
+            release.set()
+            eng._complete_ticket = orig
+            eng.close()
+
+
+class TestWedgedDemoterLoop:
+    def test_wedged_demoter_flagged_and_recovers(self):
+        eng = DeviceEngine(
+            EngineConfig(
+                num_groups=256,
+                batch_size=64,
+                batch_wait_s=0.001,
+                page_groups=32,
+                page_budget=2,
+                page_demote_interval_s=0.05,
+                # free target above the whole frame pool so every cycle
+                # takes the census path (where we plant the wedge)
+                page_free_target=64,
+            ),
+            now_fn=lambda: NOW,
+        )
+        wd = Watchdog(stall_ms=300.0)
+        eng.watchdog = wd
+        release = threading.Event()
+        orig = eng.table_census
+
+        def wedged_census(*a, **kw):
+            release.wait(timeout=10.0)
+            return orig(*a, **kw)
+
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if "page-demoter" in wd.check():
+                    break
+                time.sleep(0.02)
+            assert wd.check().get("page-demoter") is False
+
+            eng.table_census = wedged_census
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if wd.check().get("page-demoter"):
+                    break
+                time.sleep(0.02)
+            assert wd.check()["page-demoter"] is True
+            # demoter is a background loop: no availability burn
+            assert wd.serving_stalled() is False
+            assert _stalled_children(wd)["page-demoter"] == 1
+
+            release.set()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if not wd.check()["page-demoter"]:
+                    break
+                time.sleep(0.02)
+            assert wd.check()["page-demoter"] is False
+            assert _stalled_children(wd)["page-demoter"] == 0
+        finally:
+            release.set()
+            eng.table_census = orig
+            eng.close()

@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL014.
+"""guberlint rule set GL000-GL015.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -1268,6 +1268,113 @@ class GL014KernelParity(Rule):
                         f"in {_PARITY_TEST_FILE} — the parity claim is "
                         f"dangling",
                         f"parity-dangling:{key}",
+                    )
+                )
+        return out
+
+
+# Files that define SloSpec catalog entries (GL015): the observatory's
+# default catalog and the fixture twin.
+_SLO_CATALOG_FILES = (
+    "gubernator_tpu/service/slo.py",
+    # fixture twin — only ever scanned when passed explicitly
+    "gubernator_tpu/service/gl015_slo_parity.py",
+)
+_SLO_DOC_FILE = "docs/monitoring.md"
+_SLO_DOC_SECTION = "### SLO catalog"
+# First cell of a catalog table row: | `spec-id` | ...
+_SLO_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z0-9-]+)`\s*\|")
+
+_slo_doc_ids_cache: Optional[Set[str]] = None
+
+
+def slo_doc_ids() -> Set[str]:
+    """Spec ids listed in docs/monitoring.md's "### SLO catalog" table —
+    parsed from disk so the rule works on partial scans (fixtures);
+    cached per process. Scoped to the subsection so underscore metric
+    names elsewhere in the doc never alias a kebab-case spec id."""
+    global _slo_doc_ids_cache
+    if _slo_doc_ids_cache is None:
+        ids: Set[str] = set()
+        path = os.path.join(REPO_ROOT, _SLO_DOC_FILE)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        in_section = False
+        for line in lines:
+            if line.strip().startswith("#"):
+                in_section = line.strip() == _SLO_DOC_SECTION
+                continue
+            if in_section:
+                m = _SLO_DOC_ROW_RE.match(line.strip())
+                if m:
+                    ids.add(m.group(1))
+        _slo_doc_ids_cache = ids
+    return _slo_doc_ids_cache
+
+
+class GL015SloCatalogParity(Rule):
+    code = "GL015"
+    name = "slo-catalog-parity"
+    requires_reason = True
+    description = (
+        "every SloSpec the observatory catalog (service/slo.py) "
+        'constructs must have a row in docs/monitoring.md\'s "### SLO '
+        'catalog" table, and every row there must name a spec the code '
+        "still constructs — an SLO an operator cannot look up (or a "
+        "documented alert the code no longer evaluates) breaks the "
+        "paging runbook both ways"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if scan_path(mod.relpath) not in _SLO_CATALOG_FILES:
+            return []
+        doc_ids = slo_doc_ids()
+        # Spec ids this module constructs: SloSpec(id="...") keyword
+        # constants. Dynamic ids (merge overrides at runtime) are
+        # invisible here by design — the catalog table documents the
+        # built-ins.
+        declared: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "SloSpec"
+            ):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "id"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                    ):
+                        declared.setdefault(kw.value.value, node.lineno)
+        out = []
+        for sid in sorted(declared):
+            if sid not in doc_ids:
+                out.append(
+                    self.finding(
+                        mod.relpath,
+                        declared[sid],
+                        f"SloSpec '{sid}' has no row in {_SLO_DOC_FILE} "
+                        f'"{_SLO_DOC_SECTION}" — document the SLO (or '
+                        f"add an allow-slo-catalog-parity pragma)",
+                        f"slo-catalog:{sid}",
+                    )
+                )
+        # Ghost rows (doc id with no constructing SloSpec) only make
+        # sense against the REAL full catalog, not the fixture twin.
+        if scan_path(mod.relpath) == _SLO_CATALOG_FILES[0]:
+            for sid in sorted(doc_ids - set(declared)):
+                out.append(
+                    self.finding(
+                        mod.relpath,
+                        1,
+                        f'{_SLO_DOC_FILE} "{_SLO_DOC_SECTION}" lists '
+                        f"'{sid}' but service/slo.py constructs no such "
+                        f"SloSpec — the documented alert is a ghost",
+                        f"slo-catalog-ghost:{sid}",
                     )
                 )
         return out
