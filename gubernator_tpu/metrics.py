@@ -809,6 +809,60 @@ class Metrics:
             registry=r,
         )
 
+        # Crash-tolerant ownership (docs/robustness.md "Standby
+        # replication & crash recovery"; no reference analog — the
+        # reference loses every counter an owner holds on hard kill)
+        self.standby_loss_bound_hits = Gauge(
+            "gubernator_standby_loss_bound_hits",
+            "The published hard-kill loss bound: hits dirtied on this "
+            "owner since the last ACKED standby delta ship (unacked "
+            "pending plus not-yet-drained engine dirt). Killing this "
+            "node now loses at most this many hits.",
+            registry=r,
+        )
+        self.standby_keys_shipped = counter(
+            "gubernator_standby_keys_shipped",
+            "Snapshot rows shipped to ring successors by the standby "
+            "replication loop, by mode: delta (dirtied keys), full "
+            "(ring-change bootstrap), repair (anti-entropy region "
+            "re-ship), legacy (v=1 full-image fallback to a pre-standby "
+            "receiver).",
+            ["mode"],
+        )
+        self.standby_ship_errors = counter(
+            "gubernator_standby_ship_errors",
+            "Standby replication legs that failed, by reason: "
+            "circuit_open, deadline, send_error.",
+            ["reason"],
+        )
+        self.standby_shadow_keys = Gauge(
+            "gubernator_standby_shadow_keys",
+            "Shadow rows this node currently holds for upstream owners "
+            "it stands by for (non-serving until promotion).",
+            registry=r,
+        )
+        self.standby_promotions = counter(
+            "gubernator_standby_promotions",
+            "Standby promotions executed, by reason: breaker_open "
+            "(upstream owner's circuit open past "
+            "GUBER_STANDBY_PROMOTE_AFTER), ring_removed (owner left the "
+            "ring without retiring its shadow).",
+            ["reason"],
+        )
+        self.standby_promoted_keys = counter(
+            "gubernator_standby_promoted_keys",
+            "Shadow rows replayed at promotion, by destination: local "
+            "(merged into this node's table last-writer-wins), "
+            "forwarded (shipped to the key's current owner).",
+            ["dest"],
+        )
+        self.standby_anti_entropy_repairs = counter(
+            "gubernator_standby_anti_entropy_repairs",
+            "Regions re-shipped because the owner/standby digest "
+            "exchange found a mismatch (also counted in "
+            "gubernator_consistency_divergence kind=standby).",
+        )
+
         # GLOBAL behavior (reference global.go:50-67)
         self.broadcast_duration = Summary(
             "gubernator_broadcast_duration",

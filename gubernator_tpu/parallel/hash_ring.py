@@ -118,6 +118,35 @@ class ReplicatedConsistentHash:
             idx = 0
         return self._ring_peers[idx]
 
+    def successors(self, key: str, n: int = 1) -> List[object]:
+        """Up to `n` DISTINCT peers clockwise past the key's owner — the
+        peers that would own this key if the owner (and then each
+        successor in turn) left the ring. This is the standby placement
+        rule (parallel/standby.py): shadowing a key at its successors
+        means a promoted standby already owns exactly the rows it
+        inherits under the post-death ring. Raises if the pool is empty;
+        returns fewer than `n` when the pool is small."""
+        if not self._peers:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        h = self.hash_fn(key)
+        idx = bisect.bisect_left(self._ring_hashes, h)
+        if idx == len(self._ring_hashes):
+            idx = 0
+        ring_n = len(self._ring_peers)
+        owner = self._ring_peers[idx]
+        seen = {owner.info.grpc_address}
+        out: List[object] = []
+        for step in range(1, ring_n):
+            p = self._ring_peers[(idx + step) % ring_n]
+            addr = p.info.grpc_address
+            if addr in seen:
+                continue
+            seen.add(addr)
+            out.append(p)
+            if len(out) >= n:
+                break
+        return out
+
     def _ring_arrays(self):
         """Cached (hashes, is_owner, addr_padded, addr_lens) ring arrays
         for the vectorized edge (invalidated by add() — rebuilding

@@ -255,6 +255,27 @@ class Peer:
         )
         return pb.transfer_resp_from_bytes(raw)
 
+    async def standby_transfer(
+        self, payload: bytes, timeout: Optional[float] = None
+    ) -> dict:
+        """Ship one standby replication leg (pb.standby_to_bytes payload,
+        parallel/standby.py) to this peer. Rides the same
+        TransferSnapshots RPC as handover but under its own fault hook
+        (faults.OP_PEER_STANDBY), so chaos suites can drop/delay standby
+        legs without touching handover. Breaker-wrapped like every
+        transport leg."""
+        try:
+            if faults.active():
+                await faults.inject(
+                    self.info.grpc_address, faults.OP_PEER_STANDBY
+                )
+            out = await self._rpc_transfer_snapshots(payload, timeout)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return out
+
     async def lease(
         self, payload: bytes, timeout: Optional[float] = None
     ) -> bytes:
@@ -443,6 +464,10 @@ class PeerMesh:
         # Most recent ring-change handover (asyncio.Task or
         # concurrent.futures.Future); tests wait on it via wait_handover.
         self.handover_last = None
+        # Standby ReplicationManager seam (parallel/standby.py), wired
+        # by the daemon under GUBER_STANDBY; set_peers notifies it on
+        # membership change (full-image bootstrap + dead-peer promotion).
+        self.standby = None
         # Bounded like the reference's TTL'd error cache (peer_client.go
         # :206-235 caps ~100 entries): append is O(1) and pruning happens
         # only on READ. An unbounded list rebuilt per insert livelocks the
@@ -539,6 +564,8 @@ class PeerMesh:
             self.handover_last = self._spawn_handover(
                 self._handover(route, reason="ring_change")
             )
+        if self.standby is not None and old_addrs != new_addrs:
+            self.standby.on_ring_change(old_addrs, new_addrs)
 
     # -- ownership handover (docs/robustness.md) -----------------------------
 

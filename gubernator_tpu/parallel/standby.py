@@ -1,0 +1,731 @@
+"""Crash-tolerant ownership: async standby replication + anti-entropy.
+
+The reference (and this daemon before GUBER_STANDBY) loses every counter
+an owner holds when that owner dies without draining: graceful restarts
+hand state over (peers.drain_handover), but a SIGKILL, OOM, or kernel
+panic takes the whole table with it. This module bounds that loss.
+
+Mechanism (docs/robustness.md "Standby replication & crash recovery"):
+
+- Every owner continuously shadows its counter state to the ring
+  SUCCESSORS of each key (hash_ring.successors): the peers that would
+  own the key if this node left the ring. Placement by key, not by
+  node, means a promoted standby already holds exactly the rows it
+  inherits under the post-death ring.
+- Ships are incremental: the engine's flush paths feed a dirty-key
+  registry (engine.drain_dirty_keys — harvested from bookkeeping the
+  flush already does, no new device work), and every
+  GUBER_STANDBY_INTERVAL the ReplicationManager ships only the rows
+  dirtied since the last ACKED ship, as a versioned v=2 delta payload
+  (pb.standby_to_bytes) riding the existing TransferSnapshots RPC.
+  Ring changes trigger a full-image bootstrap. Legs run under the
+  per-peer circuit breakers and a handover-style deadline budget, and
+  are fault-injectable via faults.OP_PEER_STANDBY.
+- Receivers hold shadow rows in a NON-SERVING store keyed by source
+  owner. On owner death — its breaker open continuously past
+  GUBER_STANDBY_PROMOTE_AFTER, or the owner removed from the ring with
+  its shadow unretired — the standby PROMOTES: shadow rows merge into
+  the serving table through store.merge_snapshots_lww (idempotent and
+  handover-echo-safe: a row the dead owner already drained to us, or
+  that live traffic re-created newer, stays put).
+- A background anti-entropy loop exchanges per-region digests
+  (order-independent count + mix over a fixed 64-region key-hash
+  partition, mirroring the census heatmap's region idea) and re-ships
+  only mismatched regions; mismatches count into
+  consistency_divergence{kind="standby"} and converge to 0 post-heal.
+- Version skew: a receiver that predates this module rejects the v=2
+  payload with INVALID_ARGUMENT; the sender pins that peer legacy and
+  falls back to plain v=1 full images (the receiver LWW-merges them
+  into its serving table — the pre-standby degraded mode).
+
+Published guarantee, exported as gubernator_standby_loss_bound_hits and
+surfaced at /debug/standby: hard-killing this owner loses at most the
+hits dirtied since its last acked delta ship (unacked pending + engine
+dirt not yet drained). With no ring successors (cluster of one) the
+guarantee is vacuous and the gauge reads 0 — Loader.save is the only
+successor, same contract as drain handover.
+
+GUBER_STANDBY=0 keeps the daemon bit-exact with the pre-standby build:
+no dirty tracking (engine._dirty stays None), no loops, no svc.standby
+seam, and v=2 payloads are rejected exactly like any malformed transfer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Set
+
+import grpc
+
+from gubernator_tpu.service import pb
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.utils import clock as _clock
+from gubernator_tpu.utils import lockorder
+
+log = logging.getLogger("gubernator_tpu.standby")
+
+_M64 = (1 << 64) - 1
+
+# Anti-entropy digest regions: a fixed module constant (NOT per-node
+# config) so both sides of a digest exchange partition keys identically
+# even across a fleet with heterogeneous census settings. Mirrors the
+# census heatmap's default width.
+AE_REGIONS = 64
+
+
+def _row_mix(s) -> int:
+    """Order-independent per-row digest contribution: summing these over
+    a region commutes, so owner and standby need not iterate in the same
+    order. Covers the fields a divergent row would differ in."""
+    return (
+        int(s.stamp) * 1000003
+        + int(s.remaining) * 8191
+        + int(s.expire_at) * 131
+        + int(s.status)
+    ) & _M64
+
+
+class _Shadow:
+    """One upstream owner's non-serving shadow rows."""
+
+    __slots__ = ("rows", "seq", "updated_ms", "dropped")
+
+    def __init__(self):
+        self.rows: Dict[str, object] = {}  # key -> ItemSnapshot
+        self.seq = 0
+        self.updated_ms = 0
+        self.dropped = 0  # inserts refused by the per-source cap
+
+
+class ReplicationManager:
+    """Owner-side ship/anti-entropy loops + receiver-side shadow store
+    and promotion. One instance per daemon (both roles: every node is an
+    owner of its arc and a standby for its predecessors')."""
+
+    def __init__(
+        self,
+        svc,
+        behaviors: BehaviorConfig,
+        local_addr: str,
+        mesh,
+    ):
+        self.svc = svc
+        self.b = behaviors
+        self.local_addr = local_addr
+        self.mesh = mesh
+        self.interval_s = float(getattr(behaviors, "standby_interval_s", 1.0))
+        self.factor = max(1, int(getattr(behaviors, "standby_factor", 1)))
+        self.promote_after_s = float(
+            getattr(behaviors, "standby_promote_after_s", 3.0)
+        )
+        self.ae_interval_s = float(
+            getattr(behaviors, "standby_anti_entropy_interval_s", 10.0)
+        )
+        self.max_keys = int(getattr(behaviors, "standby_max_keys", 100_000))
+        # Owner side: unacked dirtied hits per key — THE loss bound's
+        # ledger half (the other half is undrained engine dirt). Only
+        # the ship loop (event-loop thread) touches it.
+        self._pending_hits: Dict[str, int] = {}
+        self._need_full = True  # bootstrap full image on first ship
+        self._legacy: Dict[str, bool] = {}  # addr -> v1-fallback pinned
+        self._seq = 0
+        # Receiver side: shadow stores by source owner address. receive()
+        # runs in executor threads (the TransferSnapshots servicer), the
+        # promotion path on the loop thread — hence a real lock.
+        self._shadow: Dict[str, _Shadow] = {}
+        self._shadow_lock = lockorder.make_lock("standby.shadow")
+        # Promotion triggers: ring-removal queue (set by on_ring_change,
+        # possibly off-loop — flags only, drained by the ship loop) and
+        # breaker-open-since tracking.
+        self._promote_queue: Set[str] = set()
+        self._open_since: Dict[str, float] = {}
+        self._promotions = 0
+        self._ship_task: Optional[asyncio.Task] = None
+        self._ae_task: Optional[asyncio.Task] = None
+        # Self-watchdog heartbeat seam, injected by the daemon (None
+        # keeps the manager usable standalone in tests).
+        self.watchdog = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._ship_task is None and self.interval_s > 0:
+            self._ship_task = asyncio.ensure_future(self._ship_loop())
+        if self._ae_task is None and self.ae_interval_s > 0:
+            self._ae_task = asyncio.ensure_future(self._ae_loop())
+
+    async def close(self) -> None:
+        """Stop the loops, then RETIRE our shadows at every reachable
+        successor: a gracefully draining node's state ships via handover
+        (peers.drain_handover), so leaving shadows behind would make the
+        standby and the handover both replay the same rows on a later
+        promotion. Retire-before-drain removes that double-replay."""
+        for t in (self._ship_task, self._ae_task):
+            if t is None:
+                continue
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # guberlint: allow-swallow -- shutdown path; ship errors were already logged per-pass
+                pass
+        self._ship_task = None
+        self._ae_task = None
+        try:
+            remotes = [
+                p for p in self.mesh.local_ring.peers() if not p.info.is_owner
+            ]
+        except Exception:  # guberlint: allow-swallow -- ring may already be torn down; nothing left to retire at
+            remotes = []
+        timeout = float(getattr(self.b, "global_timeout_s", 0.5))
+        for p in remotes:
+            addr = p.info.grpc_address
+            if self._legacy.get(addr) or not p.breaker.allow():
+                continue  # legacy peers hold no shadow; open circuit = dead anyway
+            try:
+                await p.standby_transfer(
+                    pb.standby_to_bytes("retire", self.local_addr,
+                                        seq=self._seq),
+                    timeout=timeout,
+                )
+            except Exception:  # guberlint: allow-swallow -- best-effort retire at teardown; an unreached peer promotes idempotently later
+                pass
+        wd = self.watchdog
+        if wd is not None:
+            wd.unregister("standby-ship")
+            wd.unregister("standby-anti-entropy")
+        eng = getattr(self.svc, "engine", None)
+        if eng is not None and hasattr(eng, "disable_dirty_tracking"):
+            eng.disable_dirty_tracking()
+
+    def on_ring_change(self, old_addrs: Set[str], new_addrs: Set[str]) -> None:
+        """Membership changed (PeerMesh.set_peers). Sync and possibly
+        off-loop: set flags only, the ship loop acts on them. Successor
+        assignments moved, so the next ship bootstraps full images;
+        sources that left the ring with a live shadow promote."""
+        self._need_full = True
+        for addr in old_addrs - new_addrs:
+            if addr in self._shadow:
+                self._promote_queue.add(addr)
+            self._legacy.pop(addr, None)
+            self._open_since.pop(addr, None)
+
+    # -- owner side: ship loop -----------------------------------------------
+
+    async def _ship_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            wd = self.watchdog
+            if wd is not None:
+                wd.beat("standby-ship", period_s=self.interval_s)
+            try:
+                await self.ship_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # guberlint: allow-swallow -- replication must outlive a flaky pass; unshipped keys stay pending, so the loss bound still counts them
+                log.warning("standby ship pass failed: %s", e)
+
+    async def ship_once(self) -> dict:
+        """One replication pass: drain engine dirt into the pending
+        ledger, run promotion triggers, ship pending (or full-bootstrap)
+        rows to each key's ring successors, clear a key from pending
+        only when ALL its targets acked. Callable directly from tests
+        and soak jobs regardless of the interval loop."""
+        m = self.svc.metrics
+        eng = self.svc.engine
+        for k, n in eng.drain_dirty_keys(self.max_keys).items():
+            self._pending_hits[k] = self._pending_hits.get(k, 0) + n
+        await self._scan_promotions()
+        ring = self.mesh.local_ring
+        remotes = [p for p in ring.peers() if not p.info.is_owner]
+        if not remotes:
+            # Cluster of one: no successor exists, the guarantee is
+            # vacuous (Loader.save is the only recovery path, same as
+            # drain handover) — don't let the ledger grow unbounded.
+            self._pending_hits.clear()
+            m.standby_loss_bound_hits.set(0)
+            return {"shipped": 0, "targets": 0}
+        full = self._need_full
+        if not self._pending_hits and not full:
+            self._set_loss_gauge()
+            return {"shipped": 0, "targets": 0}
+        self._need_full = False
+        loop = asyncio.get_running_loop()
+        from gubernator_tpu.store.store import snapshots_from_engine
+
+        try:
+            snaps = await loop.run_in_executor(
+                None, snapshots_from_engine, eng
+            )
+        except Exception as e:
+            self._need_full = self._need_full or full
+            log.warning("standby: snapshot gather failed: %s", e)
+            self._set_loss_gauge()
+            return {"shipped": 0, "targets": 0}
+        owned = []
+        for s in snaps:
+            try:
+                if ring.get(s.key).info.is_owner:
+                    owned.append(s)
+            except RuntimeError:
+                break  # pool emptied under us; next pass re-bootstraps
+        owned_keys = {s.key for s in owned}
+        for k in list(self._pending_hits):
+            if k not in owned_keys:
+                # Expired, evicted, or ownership moved (handover ships
+                # moved keys; expiry means there is nothing to lose).
+                del self._pending_hits[k]
+        rows = owned if full else [
+            s for s in owned if s.key in self._pending_hits
+        ]
+        by_target: Dict[str, tuple] = {}
+        key_targets: Dict[str, List[str]] = {}
+        for s in rows:
+            try:
+                succ = ring.successors(s.key, self.factor)
+            except RuntimeError:
+                continue
+            addrs = []
+            for p in succ:
+                addr = p.info.grpc_address
+                addrs.append(addr)
+                ent = by_target.get(addr)
+                if ent is None:
+                    by_target[addr] = (p, [s])
+                else:
+                    ent[1].append(s)
+            key_targets[s.key] = addrs
+        shipped = 0
+        if by_target:
+            self._seq += 1
+            seq = self._seq
+            acked = await asyncio.gather(*(
+                self._ship_to(p, items, full, seq)
+                for p, items in by_target.values()
+            ))
+            ok_by_addr = dict(zip(by_target.keys(), acked))
+            shipped = sum(len(s) for s in acked)
+            for k in list(self._pending_hits):
+                addrs = key_targets.get(k)
+                if addrs and all(k in ok_by_addr.get(a, ()) for a in addrs):
+                    del self._pending_hits[k]
+        self._set_loss_gauge()
+        return {"shipped": shipped, "targets": len(by_target)}
+
+    async def _ship_to(self, peer, items, full: bool, seq: int) -> Set[str]:
+        """Ship one target's rows in bounded chunks under its breaker and
+        a handover-style deadline budget. Returns the acked key set; any
+        failure leaves the rest pending (the loss bound keeps counting
+        them) and re-arms the full bootstrap when one was in flight."""
+        m = self.svc.metrics
+        loop = asyncio.get_running_loop()
+        addr = peer.info.grpc_address
+        if self._legacy.get(addr):
+            return await self._ship_v1(peer, items, "legacy")
+        budget_s = float(getattr(self.b, "forward_deadline_s", 2.0))
+        chunk = max(1, int(getattr(self.b, "handover_chunk", 512)))
+        deadline = loop.time() + budget_s
+        ok: Set[str] = set()
+        for i in range(0, len(items), chunk):
+            if not peer.breaker.allow():
+                m.standby_ship_errors.labels("circuit_open").inc()
+                self._need_full = self._need_full or full
+                return ok
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                m.standby_ship_errors.labels("deadline").inc()
+                self._need_full = self._need_full or full
+                return ok
+            part = items[i : i + chunk]
+            mode = "full" if full and i == 0 else "delta"
+            try:
+                await peer.standby_transfer(
+                    pb.standby_to_bytes(mode, self.local_addr, seq=seq,
+                                        snaps=part),
+                    timeout=remaining,
+                )
+            except Exception as e:
+                if self._is_version_skew(e):
+                    # Old receiver: it rejected the v=2 envelope. Pin it
+                    # legacy and fall back to plain v=1 full images (it
+                    # LWW-merges them into its serving table — the
+                    # pre-standby degraded mode).
+                    self._legacy[addr] = True
+                    self._need_full = True
+                    log.warning(
+                        "standby: %s rejected v2 payload; falling back "
+                        "to v1 full images", addr,
+                    )
+                    return ok | await self._ship_v1(peer, items[i:], "legacy")
+                m.standby_ship_errors.labels("send_error").inc()
+                self._need_full = self._need_full or full
+                self.mesh.record_error(f"{addr}: standby ship failed: {e}")
+                return ok
+            m.standby_keys_shipped.labels(mode).inc(len(part))
+            ok.update(s.key for s in part)
+        return ok
+
+    async def _ship_v1(self, peer, items, label: str) -> Set[str]:
+        """Plain v=1 snapshot ship (legacy fallback + promotion
+        forwarding): the receiver merges rows into its SERVING table via
+        merge_snapshots_lww — coarser than a shadow but LWW-safe."""
+        m = self.svc.metrics
+        loop = asyncio.get_running_loop()
+        addr = peer.info.grpc_address
+        budget_s = float(getattr(self.b, "forward_deadline_s", 2.0))
+        chunk = max(1, int(getattr(self.b, "handover_chunk", 512)))
+        deadline = loop.time() + budget_s
+        ok: Set[str] = set()
+        for i in range(0, len(items), chunk):
+            if not peer.breaker.allow():
+                m.standby_ship_errors.labels("circuit_open").inc()
+                return ok
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                m.standby_ship_errors.labels("deadline").inc()
+                return ok
+            part = items[i : i + chunk]
+            try:
+                await peer.standby_transfer(
+                    pb.snapshots_to_bytes(part), timeout=remaining
+                )
+            except Exception as e:
+                m.standby_ship_errors.labels("send_error").inc()
+                self.mesh.record_error(f"{addr}: standby v1 ship failed: {e}")
+                return ok
+            m.standby_keys_shipped.labels(label).inc(len(part))
+            ok.update(s.key for s in part)
+        return ok
+
+    @staticmethod
+    def _is_version_skew(e: Exception) -> bool:
+        code = getattr(e, "code", None)
+        if not callable(code):
+            return False
+        try:
+            return code() == grpc.StatusCode.INVALID_ARGUMENT
+        except Exception:  # guberlint: allow-swallow -- foreign exception with a non-grpc .code(); treat as a plain transport error
+            return False
+
+    # -- promotion -----------------------------------------------------------
+
+    async def _scan_promotions(self) -> None:
+        """Promotion triggers, run every ship pass: sources queued by
+        on_ring_change (left the ring unretired) and sources whose
+        breaker has been open continuously past promote_after_s."""
+        while self._promote_queue:
+            addr = self._promote_queue.pop()
+            if addr in self._shadow:
+                await self._promote(addr, "ring_removed")
+        now = time.monotonic()
+        for addr in list(self._shadow.keys()):
+            peer = self.mesh._all.get(addr)
+            if peer is None:
+                # Not in the mesh at all anymore (missed queue entry —
+                # e.g. the shadow arrived after the ring change).
+                await self._promote(addr, "ring_removed")
+                continue
+            if peer.breaker.state_name == "open":
+                since = self._open_since.setdefault(addr, now)
+                if now - since >= self.promote_after_s:
+                    self._open_since.pop(addr, None)
+                    await self._promote(addr, "breaker_open")
+            else:
+                self._open_since.pop(addr, None)
+
+    async def _promote(self, source_addr: str, reason: str) -> None:
+        """Replay one dead owner's shadow. Rows route by the CURRENT
+        ring: keys we now own — or that still map to the dead source
+        (we are its live successor; forwarding answers from local state
+        while its circuit is open) — merge locally through
+        merge_snapshots_lww (idempotent: a handover echo or a newer
+        live row wins by stamp / more-consumed-at-equal-stamp). Rows
+        owned by someone else forward best-effort as v=1 snapshots."""
+        with self._shadow_lock:
+            ent = self._shadow.pop(source_addr, None)
+        self._update_shadow_gauge()
+        if ent is None or not ent.rows:
+            return
+        m = self.svc.metrics
+        m.standby_promotions.labels(reason).inc()
+        self._promotions += 1
+        rows = list(ent.rows.values())
+        local: List[object] = []
+        forward_by: Dict[str, tuple] = {}
+        for s in rows:
+            try:
+                p = self.mesh.get(s.key)
+            except RuntimeError:
+                local.append(s)  # pool empty: keep the state here
+                continue
+            if p.info.is_owner or p.info.grpc_address == source_addr:
+                local.append(s)
+            else:
+                ent2 = forward_by.get(p.info.grpc_address)
+                if ent2 is None:
+                    forward_by[p.info.grpc_address] = (p, [s])
+                else:
+                    ent2[1].append(s)
+        if local:
+            from gubernator_tpu.store.store import merge_snapshots_lww
+
+            loop = asyncio.get_running_loop()
+            accepted, stale = await loop.run_in_executor(
+                None, merge_snapshots_lww, self.svc.engine, local
+            )
+            m.standby_promoted_keys.labels("local").inc(len(local))
+            log.warning(
+                "standby: promoted %s (%s): %d row(s) merged locally "
+                "(%d accepted, %d stale)",
+                source_addr, reason, len(local), accepted, stale,
+            )
+        for p, items in forward_by.values():
+            sent = await self._ship_v1(p, items, "legacy")
+            m.standby_promoted_keys.labels("forwarded").inc(len(sent))
+
+    # -- anti-entropy --------------------------------------------------------
+
+    async def _ae_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.ae_interval_s)
+            wd = self.watchdog
+            if wd is not None:
+                wd.beat("standby-anti-entropy", period_s=self.ae_interval_s)
+            try:
+                await self.anti_entropy_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # guberlint: allow-swallow -- repair must outlive a flaky pass; divergence persists and the next pass re-finds it
+                log.warning("standby anti-entropy pass failed: %s", e)
+
+    async def anti_entropy_once(self) -> dict:
+        """One digest exchange per standby target: send per-region
+        (count, mix) digests over the rows the target should hold; the
+        reply lists mismatched regions, which re-ship as a region-scoped
+        replace. In-flight deltas make transient mismatches — that's
+        honest divergence, and it converges to 0 once quiesced."""
+        m = self.svc.metrics
+        ring = self.mesh.local_ring
+        remotes = [p for p in ring.peers() if not p.info.is_owner]
+        if not remotes:
+            return {"targets": 0, "mismatched_regions": 0}
+        loop = asyncio.get_running_loop()
+        from gubernator_tpu.store.store import snapshots_from_engine
+
+        snaps = await loop.run_in_executor(
+            None, snapshots_from_engine, self.svc.engine
+        )
+        by_target: Dict[str, tuple] = {}
+        for s in snaps:
+            try:
+                if not ring.get(s.key).info.is_owner:
+                    continue
+                succ = ring.successors(s.key, self.factor)
+            except RuntimeError:
+                return {"targets": 0, "mismatched_regions": 0}
+            for p in succ:
+                ent = by_target.get(p.info.grpc_address)
+                if ent is None:
+                    by_target[p.info.grpc_address] = (p, [s])
+                else:
+                    ent[1].append(s)
+        timeout = float(getattr(self.b, "global_timeout_s", 0.5))
+        total_mismatch = 0
+        for addr, (peer, rows) in by_target.items():
+            if self._legacy.get(addr):
+                continue  # no shadow there to repair
+            if not peer.breaker.allow():
+                m.standby_ship_errors.labels("circuit_open").inc()
+                continue
+            digests = self._compute_digests(rows)
+            try:
+                resp = await peer.standby_transfer(
+                    pb.standby_to_bytes("digest", self.local_addr,
+                                        seq=self._seq, digests=digests),
+                    timeout=timeout,
+                )
+            except Exception as e:
+                if self._is_version_skew(e):
+                    self._legacy[addr] = True
+                    self._need_full = True
+                    continue
+                m.standby_ship_errors.labels("send_error").inc()
+                self.mesh.record_error(f"{addr}: standby digest failed: {e}")
+                continue
+            reply = (resp or {}).get("standby") or {}
+            mismatch = {int(r) for r in (reply.get("mismatch") or [])}
+            if not mismatch:
+                continue
+            total_mismatch += len(mismatch)
+            m.consistency_divergence.labels("standby").inc(len(mismatch))
+            m.standby_anti_entropy_repairs.inc(len(mismatch))
+            repair = [s for s in rows if self._region(s.key) in mismatch]
+            await self._ship_repair(peer, repair, sorted(mismatch))
+        return {"targets": len(by_target), "mismatched_regions": total_mismatch}
+
+    async def _ship_repair(self, peer, rows, regions) -> None:
+        """Region-scoped replace: the first chunk carries mode="full"
+        with the mismatched region ids as digest keys — the receiver
+        purges its shadow rows in exactly those regions (dropping strays
+        the owner no longer has) before inserting; remaining chunks ride
+        as plain deltas into the now-clean regions."""
+        m = self.svc.metrics
+        loop = asyncio.get_running_loop()
+        addr = peer.info.grpc_address
+        budget_s = float(getattr(self.b, "forward_deadline_s", 2.0))
+        chunk = max(1, int(getattr(self.b, "handover_chunk", 512)))
+        deadline = loop.time() + budget_s
+        purge = {int(r): (0, 0) for r in regions}
+        parts = [rows[i : i + chunk] for i in range(0, len(rows), chunk)] or [[]]
+        for i, part in enumerate(parts):
+            if not peer.breaker.allow():
+                m.standby_ship_errors.labels("circuit_open").inc()
+                return
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                m.standby_ship_errors.labels("deadline").inc()
+                return
+            mode = "full" if i == 0 else "delta"
+            try:
+                await peer.standby_transfer(
+                    pb.standby_to_bytes(
+                        mode, self.local_addr, seq=self._seq, snaps=part,
+                        digests=purge if i == 0 else None,
+                    ),
+                    timeout=remaining,
+                )
+            except Exception as e:
+                m.standby_ship_errors.labels("send_error").inc()
+                self.mesh.record_error(f"{addr}: standby repair failed: {e}")
+                return
+            if part:
+                m.standby_keys_shipped.labels("repair").inc(len(part))
+
+    def _region(self, key: str) -> int:
+        return self.mesh.hash_fn(key) % AE_REGIONS
+
+    def _compute_digests(self, rows) -> Dict[int, tuple]:
+        out: Dict[int, tuple] = {}
+        for s in rows:
+            r = self._region(s.key)
+            c, acc = out.get(r, (0, 0))
+            out[r] = (c + 1, (acc + _row_mix(s)) & _M64)
+        return out
+
+    # -- receiver side -------------------------------------------------------
+
+    def receive(self, parsed: dict) -> tuple:
+        """Apply one standby envelope (pb.maybe_standby_from_bytes
+        output). Sync and thread-safe: the TransferSnapshots servicer
+        runs it in an executor. Returns (accepted, stale, extra) where
+        `extra` rides the transfer response's free-form top level."""
+        mode = parsed["mode"]
+        owner = parsed["owner"]
+        seq = int(parsed.get("seq", 0))
+        items = parsed.get("items") or []
+        digests = parsed.get("digests") or {}
+        extra: dict = {"standby": {"seq": seq}}
+        accepted = stale = 0
+        with self._shadow_lock:
+            if mode == "retire":
+                ent = self._shadow.pop(owner, None)
+                extra["standby"]["retired"] = len(ent.rows) if ent else 0
+            elif mode == "digest":
+                ent = self._shadow.get(owner)
+                mine: Dict[int, tuple] = (
+                    self._compute_digests(ent.rows.values()) if ent else {}
+                )
+                theirs = {int(r): tuple(v) for r, v in digests.items()}
+                mismatch = sorted(
+                    r
+                    for r in set(mine) | set(theirs)
+                    if mine.get(r, (0, 0)) != theirs.get(r, (0, 0))
+                )
+                extra["standby"]["mismatch"] = mismatch
+            else:  # "delta" | "full"
+                ent = self._shadow.get(owner)
+                if ent is None:
+                    ent = self._shadow[owner] = _Shadow()
+                rows = ent.rows
+                if mode == "full":
+                    if digests:
+                        # Region-scoped replace (anti-entropy repair).
+                        purge = {int(r) for r in digests}
+                        for k in [
+                            k for k in rows if self._region(k) in purge
+                        ]:
+                            del rows[k]
+                    else:
+                        rows.clear()
+                for s in items:
+                    have = rows.get(s.key)
+                    if (
+                        mode == "delta"
+                        and have is not None
+                        and (
+                            have.stamp > s.stamp
+                            or (
+                                have.stamp == s.stamp
+                                and have.remaining <= s.remaining
+                            )
+                        )
+                    ):
+                        # Same LWW rule as the serving-table merge:
+                        # newer stamp wins; at equal stamps the
+                        # more-consumed side carries the true count.
+                        stale += 1
+                        continue
+                    if s.key not in rows and len(rows) >= self.max_keys:
+                        ent.dropped += 1
+                        continue
+                    rows[s.key] = s
+                    accepted += 1
+                ent.seq = seq
+                ent.updated_ms = _clock.now_ms()
+        self._update_shadow_gauge()
+        return accepted, stale, extra
+
+    # -- loss bound + introspection ------------------------------------------
+
+    def loss_bound_hits(self) -> int:
+        """The published guarantee: hard-killing this node NOW loses at
+        most this many hits — pending (shipped-but-unacked or
+        not-yet-shipped) plus engine dirt not yet drained."""
+        eng = getattr(self.svc, "engine", None)
+        dirt = eng.dirty_hits() if hasattr(eng, "dirty_hits") else 0
+        return sum(self._pending_hits.values()) + dirt
+
+    def _set_loss_gauge(self) -> None:
+        self.svc.metrics.standby_loss_bound_hits.set(self.loss_bound_hits())
+
+    def _update_shadow_gauge(self) -> None:
+        with self._shadow_lock:
+            n = sum(len(e.rows) for e in self._shadow.values())
+        self.svc.metrics.standby_shadow_keys.set(n)
+
+    def summary(self) -> dict:
+        """Live state for /debug/standby and the /debug/cluster rider."""
+        with self._shadow_lock:
+            shadows = {
+                addr: {
+                    "keys": len(e.rows),
+                    "seq": e.seq,
+                    "updated_ms": e.updated_ms,
+                    "dropped": e.dropped,
+                }
+                for addr, e in self._shadow.items()
+            }
+        return {
+            "enabled": True,
+            "loss_bound_hits": self.loss_bound_hits(),
+            "pending_keys": len(self._pending_hits),
+            "seq": self._seq,
+            "factor": self.factor,
+            "interval_s": self.interval_s,
+            "anti_entropy_interval_s": self.ae_interval_s,
+            "promote_after_s": self.promote_after_s,
+            "promotions": self._promotions,
+            "legacy_peers": sorted(self._legacy),
+            "shadows": shadows,
+        }

@@ -1461,6 +1461,14 @@ class MeshEngine(EngineBase):
         self._lock = lockorder.make_lock("engine.table")  # guards table swap (load/restore)
         # guards the host key dictionaries (pump + executor threads)
         self._keys_lock = lockorder.make_lock("engine.keys")
+        # Standby replication dirty-key harvest (parallel/standby.py):
+        # key string -> hits dirtied since the last drain, fed by the
+        # flush completion paths alongside the hotkey aggregation — no
+        # extra device work, no extra table pass. None (the default)
+        # keeps both flush paths bit-exact; only the ReplicationManager
+        # enables it.
+        self._dirty: Optional[Dict[str, int]] = None
+        self._dirty_lock = lockorder.make_lock("engine.dirty")
 
         if config.max_waves < 1:
             raise ValueError("max_waves must be >= 1")
@@ -1872,6 +1880,70 @@ class MeshEngine(EngineBase):
 
     def key_string(self, hi: int, lo: int) -> Optional[str]:
         return self._key_strings.get((hi, lo))
+
+    # ---- standby dirty-key harvest (parallel/standby.py) -------------------
+
+    def enable_dirty_tracking(self) -> None:
+        """Turn on the dirty-key registry the standby ReplicationManager
+        drains each ship pass. Idempotent. The None default keeps both
+        flush paths bit-exact with tracking off (GUBER_STANDBY=0)."""
+        if self._dirty is None:
+            with self._dirty_lock:
+                if self._dirty is None:
+                    self._dirty = {}
+
+    def disable_dirty_tracking(self) -> None:
+        with self._dirty_lock:
+            self._dirty = None
+
+    def drain_dirty_keys(self, max_keys: int = 0) -> Dict[str, int]:
+        """Return-and-clear the dirtied {key: hits} accumulated since
+        the last drain. With max_keys > 0, at most that many keys drain
+        (the rest stay pending for the next pass — the standby loss
+        bound keeps counting them). {} when tracking is off."""
+        with self._dirty_lock:
+            d = self._dirty
+            if not d:
+                return {}
+            if max_keys <= 0 or len(d) <= max_keys:
+                out = dict(d)
+                d.clear()
+                return out
+            out = {}
+            for k in list(d.keys())[:max_keys]:
+                out[k] = d.pop(k)
+            return out
+
+    def dirty_hits(self) -> int:
+        """Peek (no drain): hits dirtied since the last drain. Feeds the
+        live half of the standby loss bound."""
+        with self._dirty_lock:
+            d = self._dirty
+            return sum(d.values()) if d else 0
+
+    def _note_dirty(self, pairs) -> None:
+        """Merge [(key, hits)] into the dirty registry (callers already
+        checked self._dirty is not None; re-checked under the lock)."""
+        with self._dirty_lock:
+            d = self._dirty
+            if d is None:
+                return
+            for k, n in pairs:
+                d[k] = d.get(k, 0) + n
+
+    def _note_dirty_columnar(self, hi, lo, hits) -> None:
+        """Columnar-path harvest: resolve (hi, lo) through the host
+        key-string dictionary (anonymous rows are skipped — they are not
+        ring-routable, the same contract as handover snapshots)."""
+        with self._keys_lock:
+            ks = self._key_strings
+            resolved = [
+                (ks.get((int(h), int(l))), int(n))
+                for h, l, n in zip(hi.tolist(), lo.tolist(), hits.tolist())
+            ]
+        self._note_dirty(
+            (k, max(n, 0)) for k, n in resolved if k is not None
+        )
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
@@ -2400,6 +2472,9 @@ class MeshEngine(EngineBase):
             )
         hk = em.hotkeys if em.hotkeys.k > 0 else None
         hk_agg: Dict[Tuple[int, int], list] = {}
+        # Standby dirty harvest rides the same demux loop as the hotkey
+        # aggregation: zero extra passes, None when tracking is off.
+        dirty_agg: Optional[list] = [] if self._dirty is not None else None
         OVER = 1  # api.types.Status.OVER_LIMIT
         for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
@@ -2408,6 +2483,8 @@ class MeshEngine(EngineBase):
             hw = host[path][w]
             st, rem, rst, lim = hw[0], hw[1], hw[2], hw[3]
             status = int(st[lane])  # guberlint: allow-host-sync -- numpy demux of already-materialized rows
+            if dirty_agg is not None:
+                dirty_agg.append((req.hash_key(), max(int(req.hits), 0)))
             if hk is not None:
                 k = (place[3], place[4])
                 ent = hk_agg.get(k)
@@ -2442,6 +2519,8 @@ class MeshEngine(EngineBase):
             hk.update(
                 [(k, v[0], v[1], v[2]) for k, v in hk_agg.items()]
             )
+        if dirty_agg:
+            self._note_dirty(dirty_agg)
         em.observe_stage("resolve", time.perf_counter() - t_sync)
         self._observe_overlap(t)
 
@@ -2679,6 +2758,8 @@ class MeshEngine(EngineBase):
         st_req = status[ix]
         if em.hotkeys.k > 0:
             _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, st_req)
+        if self._dirty is not None:
+            self._note_dirty_columnar(hi, lo, cols.hits)
         return (st_req, r_limit[ix], remaining[ix], reset_time[ix])
 
     def _check_columns_replica_split(self, cols, now, select, hashes, t_start):

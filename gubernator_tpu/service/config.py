@@ -133,6 +133,37 @@ class BehaviorConfig:
     # the object path attaches metadata, service/fastpath.py).
     retry_after: bool = False
 
+    # -- crash-tolerant ownership (docs/robustness.md "Standby
+    # replication & crash recovery"; no reference analog: the reference
+    # loses every counter an owner holds when the owner dies hard) --------
+
+    # GUBER_STANDBY: owners continuously ship incremental snapshot
+    # deltas of their dirtied keys to their ring successor(s); on owner
+    # death the standby promotes the shadowed rows. Off restores
+    # hard-kill counter loss (planned ring changes stay lossless via
+    # handover) and keeps every serving path bit-exact with the
+    # pre-standby daemon.
+    standby: bool = True
+    # GUBER_STANDBY_INTERVAL: delta ship cadence. The published loss
+    # bound is "hits dirtied since the last acked ship", so this is the
+    # durability/traffic tradeoff knob.
+    standby_interval_s: float = 1.0
+    # GUBER_STANDBY_FACTOR: distinct ring successors each key's state
+    # is shadowed to (replication factor minus the owner itself).
+    standby_factor: int = 1
+    # GUBER_STANDBY_PROMOTE_AFTER: a standby promotes a dead owner's
+    # shadow once that owner's circuit has been continuously open this
+    # long (removal from the ring promotes immediately).
+    standby_promote_after_s: float = 3.0
+    # GUBER_STANDBY_ANTI_ENTROPY_INTERVAL: cadence of the per-region
+    # digest exchange that re-ships mismatched regions (repairs deltas
+    # lost to drops/partitions). 0 disables anti-entropy repair.
+    standby_anti_entropy_interval_s: float = 10.0
+    # GUBER_STANDBY_MAX_KEYS: cap on dirty keys gathered per ship pass
+    # and on shadow rows held per upstream owner; beyond it the oldest
+    # dirt stays pending (the loss bound keeps counting it).
+    standby_max_keys: int = 100_000
+
 
 @dataclasses.dataclass
 class EtcdConfig:
@@ -404,7 +435,9 @@ class DaemonConfig:
             page_budget=self.page_budget,
             page_demote_interval_s=self.page_demote_interval_s,
             page_free_target=self.page_free_target,
-            # Handover needs routable (string-keyed) snapshots even on
-            # the store-less columnar edge; with it off, skip the decode.
-            record_columnar_keys=self.behaviors.handover,
+            # Handover and standby replication need routable
+            # (string-keyed) snapshots even on the store-less columnar
+            # edge; with both off, skip the decode.
+            record_columnar_keys=self.behaviors.handover
+            or self.behaviors.standby,
         )

@@ -256,6 +256,27 @@ class Daemon:
             self.svc.lease_mgr = self._lease_mgr
             self._lease_mgr.start()
 
+        # Crash-tolerant ownership (docs/robustness.md "Standby
+        # replication & crash recovery"): every owner shadows its
+        # counter state to its ring successors; standbys promote on
+        # owner death. Only under GUBER_STANDBY — the None default (and
+        # the engine's None dirty registry) keeps every path bit-exact
+        # with the pre-standby daemon.
+        self._standby = None
+        if conf.behaviors.standby:
+            from gubernator_tpu.parallel.standby import ReplicationManager
+
+            self.engine.enable_dirty_tracking()
+            self._standby = ReplicationManager(
+                self.svc,
+                conf.behaviors,
+                local_addr=advertise,
+                mesh=self.svc.picker,
+            )
+            self.svc.standby = self._standby
+            self.svc.picker.standby = self._standby
+            self._standby.start()
+
         # Background divergence auditor (consistency observatory,
         # docs/monitoring.md "Consistency"): samples broadcast keys and
         # verifies one replica's view per pass. Off when the audit
@@ -314,6 +335,8 @@ class Daemon:
                 self._lease_mgr.watchdog = self._watchdog
             if self._profiler is not None:
                 self._profiler.watchdog = self._watchdog
+            if self._standby is not None:
+                self._standby.watchdog = self._watchdog
             self._slo = SloObservatory(
                 self.svc,
                 interval_s=conf.slo_sample_interval_s,
@@ -454,6 +477,13 @@ class Daemon:
             self._watchdog.stop()
         if getattr(self, "_pool", None) is not None:
             self._pool.close()
+        # Standby before the listener stops AND before drain_handover:
+        # the retire legs need peers' transports up, and retiring the
+        # shadows first guarantees the standby and the handover never
+        # both replay the same rows at a successor (docs/robustness.md
+        # "Standby replication & crash recovery").
+        if getattr(self, "_standby", None) is not None:
+            await self._standby.close()
         # preStop settle (the k8s preStop-sleep analog): calls already on
         # the wire get dispatched to handlers before the listener stops
         # accepting — without it, transport-queued RPCs die CANCELLED at

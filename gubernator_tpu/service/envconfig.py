@@ -159,7 +159,40 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         ),
         # Server-suggested backoff (ROADMAP item 3 first step).
         retry_after=_env_bool("GUBER_RETRY_AFTER"),
+        # Crash-tolerant ownership (docs/robustness.md "Standby
+        # replication & crash recovery"): GUBER_STANDBY=0 restores
+        # hard-kill counter loss and is bit-exact with the pre-standby
+        # daemon.
+        standby=_env_bool("GUBER_STANDBY", True),
+        standby_interval_s=parse_duration_s(
+            _env("GUBER_STANDBY_INTERVAL"), 1.0
+        ),
+        standby_factor=_env_int("GUBER_STANDBY_FACTOR", 1),
+        standby_promote_after_s=parse_duration_s(
+            _env("GUBER_STANDBY_PROMOTE_AFTER"), 3.0
+        ),
+        standby_anti_entropy_interval_s=parse_duration_s(
+            _env("GUBER_STANDBY_ANTI_ENTROPY_INTERVAL"), 10.0
+        ),
+        standby_max_keys=_env_int("GUBER_STANDBY_MAX_KEYS", 100_000),
     )
+    if behaviors.standby:
+        if behaviors.standby_interval_s <= 0:
+            raise ValueError(
+                f"'GUBER_STANDBY_INTERVAL={behaviors.standby_interval_s}' "
+                "is invalid; expected a positive duration"
+            )
+        if behaviors.standby_factor < 1:
+            raise ValueError(
+                f"'GUBER_STANDBY_FACTOR={behaviors.standby_factor}' is "
+                "invalid; expected a positive successor count"
+            )
+        if behaviors.standby_promote_after_s <= 0:
+            raise ValueError(
+                "'GUBER_STANDBY_PROMOTE_AFTER="
+                f"{behaviors.standby_promote_after_s}' is invalid; "
+                "expected a positive duration"
+            )
     if not (0.0 < behaviors.lease_fraction <= 1.0):
         raise ValueError(
             f"'GUBER_LEASE_FRACTION={behaviors.lease_fraction}' is "

@@ -156,6 +156,20 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         )
         return web.json_response(snap)
 
+    async def debug_standby(request: web.Request) -> web.Response:
+        """Crash-tolerance observatory (docs/robustness.md "Standby
+        replication & crash recovery"): the published hard-kill loss
+        bound, pending (unacked) ledger size, shadow inventory by
+        source owner, promotion history, and legacy (v1-fallback)
+        peers. Host-side dict copies plus one dirty-registry read under
+        its own lock — zero device work (GL009); executor because the
+        loss bound briefly takes that lock. {"enabled": false} when
+        GUBER_STANDBY is off."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.standby_debug_info
+        )
+        return web.json_response(snap)
+
     async def debug_cluster(request: web.Request) -> web.Response:
         """Cluster-wide debug view (docs/monitoring.md "Consistency"):
         this node's local_debug_info plus a breaker-gated, shared-deadline
@@ -201,6 +215,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/leases", debug_leases)
     app.router.add_get("/debug/admission", debug_admission)
     app.router.add_get("/debug/slo", debug_slo)
+    app.router.add_get("/debug/standby", debug_standby)
     app.router.add_get("/debug/cluster", debug_cluster)
 
 

@@ -193,6 +193,27 @@ class PeersV1Servicer:
         async with _instrumented(
             self.svc.metrics, "/pb.gubernator.PeersV1/TransferSnapshots"
         ):
+            # Standby envelope (v=2, parallel/standby.py) rides the same
+            # RPC: route it to the shadow store when this node runs a
+            # ReplicationManager; reject it INVALID_ARGUMENT otherwise —
+            # the SAME rejection class a pre-standby build produces, so
+            # skewed senders fall back to v=1 full images either way.
+            try:
+                parsed = pb.maybe_standby_from_bytes(request_bytes)
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            if parsed is not None:
+                sb = getattr(self.svc, "standby", None)
+                if sb is None:
+                    await context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        "standby replication not enabled on this node",
+                    )
+                loop = asyncio.get_running_loop()
+                accepted, stale, extra = await loop.run_in_executor(
+                    None, sb.receive, parsed
+                )
+                return pb.transfer_resp_to_bytes(accepted, stale, extra)
             try:
                 snaps, md, leases = pb.snapshots_full_from_bytes(
                     request_bytes

@@ -122,6 +122,11 @@ class V1Service:
         self.slo = None  # SloObservatory
         self.watchdog = None  # Watchdog
         self.metrics.add_sync(self._slo_sync)
+        # Crash-tolerant ownership seam (parallel/standby.py), wired by
+        # the daemon under GUBER_STANDBY. None (default) keeps every
+        # path — including TransferSnapshots payload handling — bit-exact
+        # with the pre-standby daemon.
+        self.standby = None  # ReplicationManager
 
     # ---- V1.GetRateLimits (reference gubernator.go:183-309) ----------------
 
@@ -722,6 +727,11 @@ class V1Service:
             # shows the fleet-wide budget view (docs/monitoring.md
             # "SLOs & burn rates").
             info["slo"] = self.slo.fleet_info()
+        if self.standby is not None:
+            # Standby summary (loss bound, shadow inventory, promotions)
+            # rides DebugInfo like the census, so /debug/cluster shows
+            # the fleet-wide durability picture with no wire bump.
+            info["standby"] = self.standby.summary()
         if keys:
             from gubernator_tpu.store.store import snapshots_from_engine
 
@@ -776,6 +786,17 @@ class V1Service:
         bound["total_hits"] = sum(bound.values())
         blob["bound"] = bound
         return blob
+
+    def standby_debug_info(self) -> dict:
+        """/debug/standby payload (docs/robustness.md "Standby
+        replication & crash recovery"): the published loss bound, the
+        pending (unacked) ledger, shadow inventory by source owner, and
+        promotion history. Host-side dict copies only — the loss bound
+        reads the engine's dirty registry under its own lock, never the
+        device (GL009)."""
+        if self.standby is None:
+            return {"enabled": False}
+        return self.standby.summary()
 
     def slo_debug_info(self) -> dict:
         """/debug/slo payload (docs/monitoring.md "SLOs & burn rates"):

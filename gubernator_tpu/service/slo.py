@@ -167,6 +167,19 @@ def default_specs() -> tuple:
             "(origin stamp to replica apply).",
         ),
         SloSpec(
+            id="durability",
+            sli="standby_loss_bound_hits",
+            objective=0.999,
+            threshold=1000.0,
+            comparator="gt",
+            description="The published hard-kill loss bound — hits "
+            "dirtied since the last acked standby delta ship "
+            "(/debug/standby `loss_bound_hits`) — stays under 1000. A "
+            "dead or partitioned successor stops acks, the bound grows "
+            "with traffic, and this SLO burns until the standby leg "
+            "heals or promotes.",
+        ),
+        SloSpec(
             id="shard-balance",
             sli="shard_imbalance_ratio",
             objective=0.99,
@@ -366,6 +379,15 @@ class SloObservatory:
                 "lease_outstanding_hits", float(lm.outstanding_hits()), now
             )
 
+        # Durability: the standby loss bound (pending unacked hits +
+        # undrained engine dirt — host dict sum under the dirty lock,
+        # zero device work).
+        sb = getattr(svc, "standby", None)
+        if sb is not None:
+            push(
+                "standby_loss_bound_hits", float(sb.loss_bound_hits()), now
+            )
+
         # Admission debt: the node's published over-admission bound
         # (lease outstanding + GLOBAL in-flight hits, /debug/admission
         # `bound`) as a fraction of the capacity the TTL-cached
@@ -529,6 +551,10 @@ class SloObservatory:
     # -- lifecycle -----------------------------------------------------------
 
     def _loop(self) -> None:
+        # First beat up front: the loop must appear in the watchdog
+        # table the moment it starts, not one interval later.
+        if self.watchdog is not None:
+            self.watchdog.beat("slo-sampler", period_s=self.interval_s)
         while not self._stop.wait(self.interval_s):
             if self.watchdog is not None:
                 self.watchdog.beat("slo-sampler", period_s=self.interval_s)

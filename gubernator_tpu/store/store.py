@@ -177,17 +177,29 @@ def merge_snapshots_lww(engine, items: List[ItemSnapshot]) -> tuple:
             int(stamp_col[i]),
             int(rem_col[i]),
         )
-    keep: List[ItemSnapshot] = []
+    # inject_snapshots overwrites verbatim in list order, so same-key
+    # duplicates inside one batch must be reduced by the SAME rule here
+    # — otherwise the last duplicate wins positionally and the merged
+    # state depends on arrival order (non-convergent under re-delivery).
+    def _loses(have: tuple, s: ItemSnapshot) -> bool:
+        return have[0] > s.stamp or (have[0] == s.stamp and have[1] <= s.remaining)
+
+    keep: Dict[tuple, ItemSnapshot] = {}
     stale = 0
     for s in items:
-        have = existing.get(key_hash128(s.key))
-        if have is not None and (
-            have[0] > s.stamp or (have[0] == s.stamp and have[1] <= s.remaining)
-        ):
+        kh = key_hash128(s.key)
+        have = existing.get(kh)
+        if have is not None and _loses(have, s):
             stale += 1
             continue
-        keep.append(s)
-    engine.inject_snapshots(keep)
+        prev = keep.get(kh)
+        if prev is not None:
+            if _loses((prev.stamp, prev.remaining), s):
+                stale += 1
+                continue
+            stale += 1  # prev superseded within the batch
+        keep[kh] = s
+    engine.inject_snapshots(list(keep.values()))
     return len(keep), stale
 
 

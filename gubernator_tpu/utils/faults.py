@@ -46,6 +46,7 @@ OP_PEER_GLOBALS = "update_peer_globals"
 OP_PEER_TRANSFER = "transfer_snapshots"
 OP_PEER_DEBUG = "debug_info"
 OP_PEER_LEASE = "lease"
+OP_PEER_STANDBY = "standby"
 OP_EDGE_CALL = "edge_call"
 EDGE_TARGET = "edge"
 

@@ -251,9 +251,14 @@ def gate(
 
 _MODE_FROM_JOB = re.compile(
     # order matters: longest-prefix first (mesh_ab before mesh, ici
-    # after mesh so bench_mesh_ab_n8 never keys as ici)
+    # after mesh so bench_mesh_ab_n8 never keys as ici). Every job in
+    # tools/jobs/ must key to exactly one of these modes — guberlint
+    # GL016 pins the parity (a job whose name matches nothing would
+    # ledger with mode="" and silently fall out of gate() baselines).
     r"(kernel10m|kernel_ab|kernel|engine_ab|engine|server|global|latency"
-    r"|edge|mesh_ab|mesh|ici|paged_table|lease_soak|admission_soak|slo_soak)"
+    r"|edge|mesh_ab|mesh|ici|paged_table|table_census|lease_soak"
+    r"|admission_soak|slo_soak|crash_soak|chaos_soak|consistency_soak"
+    r"|sanity|device_observatory|rolling_restart|pallas_ab|ab_narrow)"
 )
 _LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide|narrow)")
 

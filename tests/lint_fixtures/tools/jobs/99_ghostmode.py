@@ -1,0 +1,5 @@
+# TIMEOUT: 60
+"""GL016 violation fixture: a job whose stem matches no ledger mode and
+that has no tools/jobs/README.md row — two findings, one per direction."""
+
+print("RESULT {}")

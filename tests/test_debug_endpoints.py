@@ -200,6 +200,7 @@ def test_debug_slo_served_on_both_listeners(daemon):
             "enforcement-fidelity",
             "flush-latency",
             "propagation-freshness",
+            "durability",
             "shard-balance",
         ]
         for e in blob["slos"]:

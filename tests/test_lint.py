@@ -66,7 +66,7 @@ def test_registry_complete():
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
         "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
-        "GL014", "GL015",
+        "GL014", "GL015", "GL016",
     }
 
 
@@ -193,6 +193,18 @@ _CASES = [
         2,  # 1 undocumented spec + 1 reason-less pragma; ids with real
             # "### SLO catalog" rows and the reasoned-pragma spec stay
             # quiet (ghost rows only fire against the real slo.py)
+    ),
+    (
+        "GL016",
+        os.path.relpath(
+            os.path.join(
+                HERE, "lint_fixtures", "tools", "jobs", "99_ghostmode.py"
+            ),
+            REPO,
+        ),
+        {"'99_ghostmode'", "_MODE_FROM_JOB", "tools/jobs/README.md"},
+        2,  # no ledger mode + no README row; the ghost direction
+            # (README row with no job file) only fires on full scans
     ),
 ]
 
@@ -336,3 +348,26 @@ def test_gl015_repo_baseline_zero_and_doc_table_valid():
     from gubernator_tpu.service.slo import default_specs
 
     assert ids == {s.id for s in default_specs()}
+
+
+def test_gl016_repo_baseline_zero_and_readme_valid():
+    # Every shipping job must key to a ledger mode AND have a README
+    # row, and every README row must name a live job — GL016's repo
+    # baseline is pinned at zero in both directions.
+    import glob
+
+    jobs = sorted(
+        os.path.relpath(p, REPO)
+        for p in glob.glob(os.path.join(REPO, "tools", "jobs", "*.py"))
+    )
+    assert jobs, "tools/jobs must contain runnable jobs"
+    res = run_lint(paths=jobs, rule_codes=["GL016"])
+    assert [f.render() for f in res.new] == []
+
+    from tools.lint import Context, REGISTRY
+    from tools.lint.rules import jobs_readme_stems
+
+    assert jobs_readme_stems(), "tools/jobs/README.md must carry a job table"
+    gl016 = next(r for r in REGISTRY if r.code == "GL016")
+    ghosts = gl016.check_repo(Context([], full_repo=True))
+    assert [f.render() for f in ghosts] == []

@@ -58,6 +58,7 @@ class TestSpecs:
             "enforcement-fidelity",
             "flush-latency",
             "propagation-freshness",
+            "durability",
             "shard-balance",
         ]
         for s in default_specs():

@@ -392,3 +392,71 @@ def test_same_flush_own_hits_survive_displacement():
         assert [g.remaining for g in got] == [w.remaining for w in want]
     finally:
         eng.close()
+
+
+# ---------------------------------------------------------------------------
+# merge_snapshots_lww order-independence (standby/handover convergence)
+
+
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_merge_snapshots_lww_shuffle_fuzz(seed):
+    """The LWW merge rule (newer stamp wins; equal stamps -> the
+    more-consumed side wins) must converge to ONE final table state no
+    matter what order duplicate snapshots arrive in — standby promotion,
+    anti-entropy repair, and handover echoes all replay overlapping row
+    sets, so order-dependence would make recovery nondeterministic."""
+    from gubernator_tpu.store.store import (
+        ItemSnapshot,
+        merge_snapshots_lww,
+        snapshots_from_engine,
+    )
+
+    rng = random.Random(seed)
+    keys = [f"lww{i}" for i in range(10)]
+    snaps = []
+    for _ in range(60):
+        k = rng.choice(keys)
+        stamp = NOW + rng.choice([0, 0, 1000, 2000])  # many stamp ties
+        snaps.append(
+            ItemSnapshot(
+                key=k, algorithm=int(Algorithm.TOKEN_BUCKET), limit=100,
+                duration=600_000, remaining=rng.randrange(0, 101),
+                stamp=stamp, expire_at=stamp + 600_000,
+            )
+        )
+
+    # The expected winner per key, computed independently of the merge:
+    # max by (stamp, consumed) == (stamp, -remaining).
+    want = {}
+    for s in snaps:
+        cur = want.get(s.key)
+        if cur is None or (s.stamp, -s.remaining) > (cur.stamp, -cur.remaining):
+            want[s.key] = s
+
+    states = []
+    for trial in range(3):
+        order = snaps[:]
+        rng.shuffle(order)
+        eng = DeviceEngine(
+            EngineConfig(num_groups=1 << 9, batch_size=32),
+            now_fn=lambda: NOW,
+        )
+        try:
+            # Split into random merge batches too (chunked ships).
+            i = 0
+            while i < len(order):
+                n = rng.randrange(1, 9)
+                merge_snapshots_lww(eng, order[i : i + n])
+                i += n
+            state = {
+                s.key: (s.stamp, s.remaining)
+                for s in snapshots_from_engine(eng)
+            }
+        finally:
+            eng.close()
+        states.append(state)
+
+    assert states[0] == states[1] == states[2]
+    assert states[0] == {
+        k: (s.stamp, s.remaining) for k, s in want.items()
+    }

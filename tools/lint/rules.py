@@ -1,4 +1,4 @@
-"""guberlint rule set GL000-GL015.
+"""guberlint rule set GL000-GL016.
 
 Each rule pins one serving-path invariant; docs/linting.md is the
 operator-facing catalog. Rules are deliberately heuristic — static
@@ -1375,6 +1375,124 @@ class GL015SloCatalogParity(Rule):
                         f"'{sid}' but service/slo.py constructs no such "
                         f"SloSpec — the documented alert is a ghost",
                         f"slo-catalog-ghost:{sid}",
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL016 — tools/jobs <-> ledger mode map <-> jobs README parity.
+
+_JOBS_DIR = "tools/jobs"
+_JOBS_README = "tools/jobs/README.md"
+# A runnable device job: NN_name.py (helpers like README.md don't match).
+_JOB_PATH_RE = re.compile(r"^tools/jobs/(\d+_[a-z0-9_]+)\.py$")
+# Job stems mentioned in a README table row cell.
+_JOB_STEM_RE = re.compile(r"\b(\d+_[a-z0-9_]+)\b")
+
+_jobs_readme_cache: Optional[Dict[str, int]] = None
+
+
+def jobs_readme_stems() -> Dict[str, int]:
+    """Job stems named in tools/jobs/README.md table rows -> line number.
+    Parsed from disk (so fixture scans see the real catalog); cached per
+    process. Scoped to table rows so prose mentioning an old job name
+    never counts as its catalog entry."""
+    global _jobs_readme_cache
+    if _jobs_readme_cache is None:
+        stems: Dict[str, int] = {}
+        path = os.path.join(REPO_ROOT, _JOBS_README)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        for i, line in enumerate(lines, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            for stem in _JOB_STEM_RE.findall(line):
+                stems.setdefault(stem, i)
+        _jobs_readme_cache = stems
+    return _jobs_readme_cache
+
+
+def _ledger_mode_re() -> "re.Pattern[str]":
+    """The ONE job-name -> ledger mode regex (utils/ledger.py). Imported,
+    not re-parsed: the rule must agree with what archiving actually does."""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from gubernator_tpu.utils import ledger
+
+    return ledger._MODE_FROM_JOB
+
+
+class GL016JobLedgerParity(Rule):
+    code = "GL016"
+    name = "job-ledger-parity"
+    requires_reason = True
+    description = (
+        "every tools/jobs/NN_name.py must key to a ledger mode "
+        "(utils/ledger.py _MODE_FROM_JOB) and have a row in "
+        "tools/jobs/README.md — a job whose RESULT ledgers with mode='' "
+        "silently falls out of gate() regression baselines, and a README "
+        "row naming a deleted job is a ghost runbook entry"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        m = _JOB_PATH_RE.match(scan_path(mod.relpath))
+        if not m:
+            return []
+        stem = m.group(1)
+        out = []
+        if _ledger_mode_re().search(stem) is None:
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    1,
+                    f"job '{stem}' matches no mode in utils/ledger.py "
+                    f"_MODE_FROM_JOB — its RESULT rows would ledger with "
+                    f"mode='' and never gate; extend the mode alternation "
+                    f"(or add an allow-job-ledger-parity pragma)",
+                    f"ledger-mode:{stem}",
+                )
+            )
+        if stem not in jobs_readme_stems():
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    1,
+                    f"job '{stem}' has no row in {_JOBS_README} — add it "
+                    f"to the catalog table (or add an "
+                    f"allow-job-ledger-parity pragma)",
+                    f"readme-row:{stem}",
+                )
+            )
+        return out
+
+    def check_repo(self, ctx: Context) -> List[Finding]:
+        # Ghost direction (README row naming a job file that no longer
+        # exists) only makes sense against the real full tree.
+        if not ctx.full_repo:
+            return []
+        try:
+            present = {
+                fn[: -len(".py")]
+                for fn in os.listdir(os.path.join(REPO_ROOT, _JOBS_DIR))
+                if _JOB_PATH_RE.match(f"{_JOBS_DIR}/{fn}")
+            }
+        except OSError:
+            return []
+        out = []
+        for stem, line in sorted(jobs_readme_stems().items()):
+            if stem not in present:
+                out.append(
+                    self.finding(
+                        _JOBS_README,
+                        line,
+                        f"README row names job '{stem}' but "
+                        f"{_JOBS_DIR}/{stem}.py does not exist — the "
+                        f"catalog entry is a ghost",
+                        f"readme-ghost:{stem}",
                     )
                 )
         return out
