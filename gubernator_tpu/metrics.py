@@ -31,7 +31,7 @@ from prometheus_client import (
     CONTENT_TYPE_LATEST,
 )
 
-from gubernator_tpu.utils import lockorder
+from gubernator_tpu.utils import lockorder, raceguard
 
 log = logging.getLogger("gubernator_tpu.metrics")
 
@@ -488,8 +488,12 @@ class HotKeySketch:
                 if name is not None and key not in names:
                     names[key] = name
 
-    def _display(self, key) -> str:
-        name = self._names.get(key)
+    def _display(self, key, names) -> str:
+        """Display name from a names SNAPSHOT (never the live dict: the
+        resolver may take the engine key lock, which the flush path
+        acquires BEFORE metrics.hotkeys — resolving under our lock
+        would invert that order)."""
+        name = names.get(key)
         if name is None and self._resolver is not None:
             try:
                 name = self._resolver(key[0], key[1])
@@ -497,11 +501,23 @@ class HotKeySketch:
                 name = None
         return name if name is not None else f"hash:{key[0]:x}:{key[1]:x}"
 
+    def _sorted_copy(self) -> tuple:
+        """(entries, names) copied under the lock: entry VALUE lists are
+        copied too, so a concurrent update() (or one re-entered through
+        the display resolver) can't mutate the rows a snapshot already
+        sorted — pre-fix, a /debug/hotkeys row could report more hits
+        than the payload's own total_hits."""
+        entries = sorted(
+            ((key, list(ent)) for key, ent in self._entries.items()),
+            key=lambda kv: -kv[1][0],
+        )
+        return entries, dict(self._names)
+
     def snapshot(self) -> dict:
         """JSON payload for /debug/hotkeys: entries sorted hottest-
         first, with the sketch's global error bound (total/k)."""
         with self._lock:
-            entries = sorted(self._entries.items(), key=lambda kv: -kv[1][0])
+            entries, names = self._sorted_copy()
             total = self._total
             k = self._k
         return {
@@ -510,7 +526,7 @@ class HotKeySketch:
             "max_error": (total // k) if k else 0,
             "entries": [
                 {
-                    "key": self._display(key),
+                    "key": self._display(key, names),
                     "key_hash": [key[0], key[1]],
                     "hits": ent[0],
                     "err": ent[1],
@@ -532,10 +548,11 @@ class HotKeySketch:
         out = [f"# HELP {self.name} {self.doc}",
                f"# TYPE {self.name} gauge"]
         with self._lock:
-            entries = sorted(self._entries.items(), key=lambda kv: -kv[1][0])
+            entries, names = self._sorted_copy()
         for key, ent in entries:
             out.append(
-                f'{self.name}{{key="{_escape_label(self._display(key))}"}} '
+                f'{self.name}'
+                f'{{key="{_escape_label(self._display(key, names))}"}} '
                 f"{ent[0]}"
             )
         return out
@@ -549,6 +566,18 @@ class HotKeySketch:
                 "k": self._k,
                 "total_hits": self._total,
             }
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer"). _k is
+# write-guarded only: update()'s disabled-sketch precheck and the k
+# property read it racily on purpose (int read, configure() is rare).
+raceguard.guarded_by(HotKeySketch, {
+    "_entries": "metrics.hotkeys",
+    "_names": "metrics.hotkeys",
+    "_total": "metrics.hotkeys",
+    "_k": "w:metrics.hotkeys",
+    "_resolver": "@thread",
+})
 
 
 # The device-tier histogram families (single source of truth: the engine

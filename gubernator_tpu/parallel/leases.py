@@ -56,6 +56,8 @@ from gubernator_tpu.api.types import (
     Status,
 )
 
+from gubernator_tpu.utils import raceguard
+
 log = logging.getLogger("gubernator.leases")
 
 # Metadata keys (wire-visible, documented in docs/architecture.md).
@@ -186,7 +188,10 @@ class LeaseManager:
 
     def outstanding_by_key(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
-        for rec in self._leases.values():
+        # list(): the auditor sums this off the loop thread while grants
+        # land — iterating the live dict can raise "changed size during
+        # iteration" (values() is a view, not a copy).
+        for rec in list(self._leases.values()):
             out[rec.key] = out.get(rec.key, 0) + rec.slice_hits
         return out
 
@@ -809,3 +814,16 @@ class LeaseCache:
             "outstanding_local_hits": self.outstanding_hits(),
             **self.stats,
         }
+
+
+# Declared write protocol (docs/robustness.md "Race sanitizer"): the
+# lease ledgers are single-writer — every mutation runs on the owner
+# daemon's event loop (grant/return/sweep/revoke). @thread pins each
+# field to its first writer thread; cross-thread readers (SLO sampler,
+# auditor executor hops) read int counters or snapshot copies.
+raceguard.guarded_by(LeaseManager, {
+    "_leases": "@thread",
+    "_by_key": "@thread",
+    "_revoked": "@thread",
+    "_seq": "@thread",
+})

@@ -63,6 +63,7 @@ from gubernator_tpu.service import pb
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import lockorder
+from gubernator_tpu.utils import raceguard
 
 log = logging.getLogger("gubernator_tpu.standby")
 
@@ -206,7 +207,12 @@ class ReplicationManager:
         sources that left the ring with a live shadow promote."""
         self._need_full = True
         for addr in old_addrs - new_addrs:
-            if addr in self._shadow:
+            # receive() mutates _shadow from executor threads; the
+            # membership probe must hold the shadow lock like every
+            # other _shadow access.
+            with self._shadow_lock:
+                lost = addr in self._shadow
+            if lost:
                 self._promote_queue.add(addr)
             self._legacy.pop(addr, None)
             self._open_since.pop(addr, None)
@@ -223,7 +229,7 @@ class ReplicationManager:
                 await self.ship_once()
             except asyncio.CancelledError:
                 raise
-            except Exception as e:  # guberlint: allow-swallow -- replication must outlive a flaky pass; unshipped keys stay pending, so the loss bound still counts them
+            except Exception as e:
                 log.warning("standby ship pass failed: %s", e)
 
     async def ship_once(self) -> dict:
@@ -416,10 +422,17 @@ class ReplicationManager:
         breaker has been open continuously past promote_after_s."""
         while self._promote_queue:
             addr = self._promote_queue.pop()
-            if addr in self._shadow:
+            # Membership under the lock; the promote itself re-pops
+            # under the lock, so a shadow retired between the probe and
+            # the replay is simply a no-op there.
+            with self._shadow_lock:
+                queued = addr in self._shadow
+            if queued:
                 await self._promote(addr, "ring_removed")
         now = time.monotonic()
-        for addr in list(self._shadow.keys()):
+        with self._shadow_lock:
+            addrs = list(self._shadow.keys())
+        for addr in addrs:
             peer = self.mesh._all.get(addr)
             if peer is None:
                 # Not in the mesh at all anymore (missed queue entry —
@@ -496,7 +509,7 @@ class ReplicationManager:
                 await self.anti_entropy_once()
             except asyncio.CancelledError:
                 raise
-            except Exception as e:  # guberlint: allow-swallow -- repair must outlive a flaky pass; divergence persists and the next pass re-finds it
+            except Exception as e:
                 log.warning("standby anti-entropy pass failed: %s", e)
 
     async def anti_entropy_once(self) -> dict:
@@ -694,7 +707,13 @@ class ReplicationManager:
         not-yet-shipped) plus engine dirt not yet drained."""
         eng = getattr(self.svc, "engine", None)
         dirt = eng.dirty_hits() if hasattr(eng, "dirty_hits") else 0
-        return sum(self._pending_hits.values()) + dirt
+        # Scrape/debug threads call this while the ship loop mutates
+        # the ledger. The dict() copy makes the read one atomic
+        # snapshot: summing the live view happens to be GIL-atomic in
+        # CPython today, but that's an implementation accident, not a
+        # contract (free-threaded builds interleave C loops).
+        pending = dict(self._pending_hits)
+        return sum(pending.values()) + dirt
 
     def _set_loss_gauge(self) -> None:
         self.svc.metrics.standby_loss_bound_hits.set(self.loss_bound_hits())
@@ -729,3 +748,20 @@ class ReplicationManager:
             "legacy_peers": sorted(self._legacy),
             "shadows": shadows,
         }
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer"). The
+# shadow store is the only multi-writer field (executor-thread
+# receive() vs loop-thread promotion) and carries the real lock. The
+# owner-side ledgers are single-writer on the ship loop: @thread pins
+# the first writer, and the cross-thread readers (summary(), the loss
+# gauge) take C-level snapshots. _need_full / _legacy / _promote_queue /
+# _open_since stay undeclared: on_ring_change may set those flags
+# off-loop by design (atomic per-op under the GIL; the ship loop is
+# the sole consumer).
+raceguard.guarded_by(ReplicationManager, {
+    "_shadow": "standby.shadow",
+    "_pending_hits": "@thread",
+    "_seq": "@thread",
+    "_promotions": "@thread",
+})

@@ -38,6 +38,7 @@ import jax
 import numpy as np
 
 from gubernator_tpu.utils import lockorder
+from gubernator_tpu.utils import raceguard
 from gubernator_tpu.api.keys import group_of, key_hash128, key_hash128_batch
 from gubernator_tpu.api.types import (
     Behavior,
@@ -427,6 +428,7 @@ class EngineBase:
     thread at depth >= 2 (continuous batching: host encode of flush N+1
     overlaps device execution of flush N)."""
 
+    @raceguard.init_path
     def _init_base(self, thread_name: str) -> None:
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
@@ -1055,6 +1057,7 @@ class EngineBase:
         out["imbalance_ratio"] = max(dims) if dims else None
         return out
 
+    @raceguard.holds_lock("engine.census")
     def _census_churn(self, snap: dict) -> dict:
         """Churn ledger: interval deltas of the flush bookkeeping the
         engine already keeps, turned into rates at census cadence.
@@ -1613,8 +1616,13 @@ class MeshEngine(EngineBase):
             try:
                 pager = self._pager
                 want = int(getattr(self.cfg, "page_free_target", 1) or 0)
-                if want <= 0 or len(pager.free) >= want:
-                    continue
+                with raceguard.racy_read(
+                    "free",
+                    reason="lock-free headroom precheck; demote_victims "
+                    "re-reads under the table lock",
+                ):
+                    if want <= 0 or len(pager.free) >= want:
+                        continue
                 census = self.table_census()
                 dev = census.get("tiers", {}).get(
                     self.topo.primary_tier, census
@@ -1628,13 +1636,17 @@ class MeshEngine(EngineBase):
                 # pages whose SLOTS are idle, not merely pages with the
                 # oldest touch tick (a single probe re-warms a page's
                 # tick; the census still sees its other slots as cold).
-                coldness = None
                 ch = dev.get("cold_heatmap")
-                if ch:
-                    coldness = pager.coldness_from_heatmap(
-                        ch, int(dev.get("heatmap_groups_per_region", 1))
-                    )
                 with self._lock, self.topo.dispatch_guard():
+                    # The heatmap fold reads page_map, which serving
+                    # threads rebind under the table lock — folding
+                    # outside it can index a page demoted mid-scan.
+                    # Demote cadence only, so holding the lock is cheap.
+                    coldness = None
+                    if ch:
+                        coldness = pager.coldness_from_heatmap(
+                            ch, int(dev.get("heatmap_groups_per_region", 1))
+                        )
                     self.table = pager.demote_victims(
                         self.table, want_free=want, min_idle_ticks=1,
                         coldness=coldness,
@@ -1774,6 +1786,7 @@ class MeshEngine(EngineBase):
             subs["admission"] += admission_b
         return subs
 
+    @raceguard.init_path
     def _warmup(self) -> None:
         """Compile the decide AND inject kernels before serving: first XLA
         compilation takes seconds (tens of seconds on TPU), which would
@@ -1887,7 +1900,11 @@ class MeshEngine(EngineBase):
         """Turn on the dirty-key registry the standby ReplicationManager
         drains each ship pass. Idempotent. The None default keeps both
         flush paths bit-exact with tracking off (GUBER_STANDBY=0)."""
-        if self._dirty is None:
+        with raceguard.racy_read(
+            "_dirty", reason="double-checked enable; re-read under the lock"
+        ):
+            off = self._dirty is None
+        if off:
             with self._dirty_lock:
                 if self._dirty is None:
                     self._dirty = {}
@@ -2474,7 +2491,13 @@ class MeshEngine(EngineBase):
         hk_agg: Dict[Tuple[int, int], list] = {}
         # Standby dirty harvest rides the same demux loop as the hotkey
         # aggregation: zero extra passes, None when tracking is off.
-        dirty_agg: Optional[list] = [] if self._dirty is not None else None
+        with raceguard.racy_read(
+            "_dirty",
+            reason="None-gate only; _note_dirty re-checks under the lock",
+        ):
+            dirty_agg: Optional[list] = (
+                [] if self._dirty is not None else None
+            )
         OVER = 1  # api.types.Status.OVER_LIMIT
         for (req, fut), place in zip(t.items, t.placements):
             if place is None or place == "carry":
@@ -2758,7 +2781,12 @@ class MeshEngine(EngineBase):
         st_req = status[ix]
         if em.hotkeys.k > 0:
             _note_hotkeys_columnar(em.hotkeys, hi, lo, cols.hits, st_req)
-        if self._dirty is not None:
+        with raceguard.racy_read(
+            "_dirty",
+            reason="None-gate only; _note_dirty re-checks under the lock",
+        ):
+            track_dirty = self._dirty is not None
+        if track_dirty:
             self._note_dirty_columnar(hi, lo, cols.hits)
         return (st_req, r_limit[ix], remaining[ix], reset_time[ix])
 
@@ -3234,6 +3262,7 @@ class MeshEngine(EngineBase):
                 k: v for k, v in self._key_strings.items() if k in live
             }
 
+    @raceguard.holds_lock("engine.table")
     def _recover_table_locked(self) -> bool:
         """Called with the lock held after a failed device call: if the
         donated table buffers were consumed — or the table points at an
@@ -3697,3 +3726,27 @@ class _nullcontext:
 
 _FLUSH = object()
 _STOP = object()
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer").
+# Write-only ("w:") fields are read racily on purpose by the debug
+# snapshot, the SLO sampler, and the test suites (single reference or
+# int reads); the tight read+write protocol applies to the bulk/census/
+# admission caches and the dirty-key registry, whose readers all take
+# the matching lock (the deliberate lock-free None-gates sit inside
+# racy_read escapes above).
+raceguard.guarded_by(EngineBase, {
+    "_bulks": "engine.bulks",
+    "_census_cache": "engine.census",
+    "_census_ts": "engine.census",
+    "_census_prev": "engine.census",
+    "_admission_cache": "engine.admission",
+    "_admission_ts": "engine.admission",
+    "_shard_decisions": "w:engine.shards",
+    "_inflight": "w:engine.pipeline",
+})
+raceguard.guarded_by(MeshEngine, {
+    "table": "w:engine.table",
+    "_key_strings": "w:engine.keys",
+    "_dirty": "engine.dirty",
+})

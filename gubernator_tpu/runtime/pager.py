@@ -28,7 +28,9 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from gubernator_tpu.ops.layout import SlotTable
+from gubernator_tpu.utils import raceguard
 from gubernator_tpu.utils import transfer as _transfer
+from gubernator_tpu.utils.raceguard import holds_lock
 
 # Wide-row dtypes for assembling logical snapshot images (layout.py).
 _WIDE_DTYPES = {
@@ -157,6 +159,7 @@ class Pager:
                 out.add((hi, lo))
         return out
 
+    @holds_lock("engine.table")
     def host_tier_copy(self) -> Dict[int, Dict[str, np.ndarray]]:
         """Shallow copy for off-lock readers (census, snapshot). Stored
         row blocks are never mutated in place — demote replaces the dict
@@ -165,6 +168,7 @@ class Pager:
 
     # ---- residency transitions (engine lock held) --------------------------
 
+    @holds_lock("engine.table")
     def ensure_resident(self, table, pages) -> object:
         """Promote every page in `pages` (logical page indices), demoting
         LRU victims if no frame is free. Returns the updated table."""
@@ -178,6 +182,7 @@ class Pager:
                 table = self._promote_one(table, lp, protect)
         return table
 
+    @holds_lock("engine.table")
     def acquire_frame(self, lp: int) -> Optional[int]:
         """Pop a free frame eligible to hold logical page `lp` — any
         frame on one chip, the page's own shard pool on a mesh. None
@@ -192,6 +197,7 @@ class Pager:
                 return self.free.pop(i)
         return None
 
+    @holds_lock("engine.table")
     def _promote_one(self, table, lp: int, protect: Set[int]):
         pp = self.acquire_frame(lp)
         if pp is None:
@@ -226,6 +232,7 @@ class Pager:
         self.page_map[lp] = pp
         return table
 
+    @holds_lock("engine.table")
     def demote(self, table, lp: int):
         """Evacuate one resident page to the host tier (positional wide
         rows) and unbind its frame. All-empty pages are dropped, not
@@ -262,6 +269,7 @@ class Pager:
             return None
         return min(candidates, key=lambda lp: int(self.touch[lp]))  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
 
+    @holds_lock("engine.table")
     def coldness_from_heatmap(
         self, cold_heatmap, groups_per_region: int
     ) -> Dict[int, float]:
@@ -310,6 +318,7 @@ class Pager:
             key=lambda lp: (-cold.get(lp, 0.0), int(self.touch[lp])),  # guberlint: allow-host-sync -- touch ticks are a host numpy mirror
         )
 
+    @holds_lock("engine.table")
     def demote_victims(
         self, table, want_free: int, min_idle_ticks: int = 0, coldness=None
     ):
@@ -350,6 +359,7 @@ class Pager:
             1 for pp in self.free if self.shard_of_frame(pp) == shard
         )
 
+    @holds_lock("engine.table")
     def reset(self) -> None:
         """Post-recovery zeroing: the engine rebuilt an empty paged
         table, so every mirror entry, frame, and host page is gone."""
@@ -360,6 +370,7 @@ class Pager:
 
     # ---- observability -----------------------------------------------------
 
+    @holds_lock("engine.table")
     def pages_snapshot(self) -> dict:
         """/debug/table "pages" section + metrics-bridge source."""
         nlp = self.PK.num_logical_pages
@@ -403,3 +414,21 @@ class Pager:
         if nlp <= 4096:  # bounded debug payload
             snap["page_map"] = self.page_map.tolist()
         return snap
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer"). The
+# Pager owns no lock: every structural field is guarded by the OWNING
+# engine's table lock (matched by name — any engine's "engine.table"
+# counts, and each engine has exactly one pager). The cumulative move
+# counters are write-guarded only: the SLO sampler and tests read them
+# racily on purpose (monotonic ints).
+raceguard.guarded_by(Pager, {
+    "page_map": "engine.table",
+    "free": "engine.table",
+    "touch": "engine.table",
+    "host_tier": "engine.table",
+    "_tick": "engine.table",
+    "demotes": "w:engine.table",
+    "promotes": "w:engine.table",
+    "binds": "w:engine.table",
+})

@@ -32,6 +32,7 @@ from typing import Optional
 from gubernator_tpu.api.keys import key_hash128
 from gubernator_tpu.utils import clock as _clock
 from gubernator_tpu.utils import lockorder
+from gubernator_tpu.utils import raceguard
 from gubernator_tpu.utils import tracing
 
 # The provenance enum. Every answer a client can receive names exactly
@@ -106,17 +107,26 @@ class DecisionRecorder:
     # -- counting ------------------------------------------------------------
 
     def _child(self, path: str, label: str):
-        c = self._children.get((path, label))
+        # Cache get and insert both run under the lock (two racing
+        # threads used to each create a child and inc their own, with
+        # one cached — splitting counts across counter objects). The
+        # labels() call itself stays OUTSIDE: it takes the metrics
+        # registry lock, which must never nest under ours.
+        with self._lock:
+            c = self._children.get((path, label))
         if c is None:
             c = self.metrics.admission_decisions.labels(path, label)
-            self._children[(path, label)] = c
+            with self._lock:
+                c = self._children.setdefault((path, label), c)
         return c
 
     def _over_child(self, path: str):
-        c = self._over_children.get(path)
+        with self._lock:
+            c = self._over_children.get(path)
         if c is None:
             c = self.metrics.over_limit_counter.labels(path)
-            self._over_children[path] = c
+            with self._lock:
+                c = self._over_children.setdefault(path, c)
         return c
 
     def _count(self, path: str, label: str, n: int = 1) -> None:
@@ -226,3 +236,15 @@ class DecisionRecorder:
             "ring_size": self.ring.maxlen,
             "ring": ring,
         }
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer"). `ring`
+# is write-guarded only: the deque attribute is never rebound after
+# __init__ and maxlen is read racily by snapshot(); the append/copy
+# interior operations run under the lock above.
+raceguard.guarded_by(DecisionRecorder, {
+    "_children": "service.admission_ring",
+    "_over_children": "service.admission_ring",
+    "_counts": "service.admission_ring",
+    "ring": "w:service.admission_ring",
+})

@@ -394,7 +394,6 @@ class EdgeLeases:
                 pb.lease_req_to_bytes(grants, returns, holder=self.holder),
             )
             g_res, _r_res, _md = pb.lease_resp_from_bytes(raw)
-        # guberlint: allow-swallow -- maintenance is advisory; failed renews re-send next round and the owner-side sweep reclaims anything we never return
         except (EdgeError, ValueError, TypeError) as e:
             log.debug("edge lease maintenance failed: %s", e)
             self.cache.abort()

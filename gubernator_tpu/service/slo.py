@@ -45,6 +45,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from gubernator_tpu.utils import raceguard
 from gubernator_tpu.utils.timeseries import RingSet
 
 log = logging.getLogger(__name__)
@@ -581,3 +582,17 @@ class SloObservatory:
             t.join(timeout=5.0)
         if self.watchdog is not None:
             self.watchdog.unregister("slo-sampler")
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer"). The
+# Sampler-thread handle rebinds are single-threaded by contract (daemon
+# startup/shutdown); concurrent start()/stop() would leak or double-
+# start the loop, so write affinity is worth pinning. _ticks stays
+# DELIBERATELY undeclared: the loop owns it in production, but
+# sample_once() is documented as directly callable from tests and soak
+# jobs while the loop runs — a second `+= 1` writer the monitoring
+# counter tolerates (a lost increment skews nothing), and /debug/slo
+# reads the int racily by design.
+raceguard.guarded_by(SloObservatory, {
+    "_thread": "@thread",
+})

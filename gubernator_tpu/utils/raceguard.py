@@ -1,0 +1,410 @@
+"""Guarded-by race sanitizer: lock-coverage checking for shared fields.
+
+The lock-order sanitizer (utils/lockorder.py) proves the locks are
+acquired in a consistent ORDER, but nothing checks that a shared field
+is touched with its lock held at all — the classic unlocked-read /
+check-then-act bug class that ``go test -race`` catches in the Go
+reference. This module makes the guarded-by protocol itself a declared,
+runtime-checked invariant:
+
+- ``guarded_by(Cls, {"_field": "lock.name", ...})`` declares which lock
+  protects which attribute. With ``GUBER_RACE_SANITIZER`` unset (or the
+  lock sanitizer off — the held stacks live there) the declaration only
+  fills the registry: attributes stay raw, zero overhead. Under
+  ``GUBER_RACE_SANITIZER=1`` each declared field is replaced by a
+  ``Guarded`` data-descriptor that checks, on every read and write,
+  that the current thread holds the named lock (by NAME, via
+  lockorder's per-thread held stacks).
+- Per-field modes: ``"lock.name"`` checks reads AND writes;
+  ``"w:lock.name"`` checks writes only (for fields that gauges, debug
+  routes, or tests read racily on purpose); ``"@thread"`` pins the
+  field to its first writer thread (single-writer ledgers like the
+  lease maps — reads stay unchecked).
+- ``racy_read("field", reason=...)`` is the explicit escape for a
+  deliberate unlocked read (monotonic counters, TTL prechecks); the
+  reason is mandatory.
+- ``assert_held("engine.table")`` covers dict/list INTERIORS the
+  descriptor cannot see (``self._shadow[k].rows[...] = v`` mutates the
+  row dict, not the attribute).
+- ``@holds_lock("engine.table")`` marks methods whose contract is
+  "caller holds the lock" (the Pager's mutators): checked on entry at
+  runtime, and the marker GL017 honors statically.
+- ``@init_path`` marks construction-path methods: writes during
+  ``__init__`` (and anything it calls) are exempt — the object is not
+  yet shared. ``guarded_by`` wraps the class's own ``__init__``
+  automatically.
+
+Violations never raise in place (a worker thread's AttributeError would
+skew the very interleaving under test); they accumulate on a
+``RaceGraph`` (default: module-global ``DEFAULT_GRAPH``) with a witness
+site, and the tier-1 conftest asserts the default graph stays empty
+after every test — the same pattern as the lock-order sanitizer.
+Deliberate-violation tests pass a private graph.
+
+Like lockorder, the env gate is read when ``guarded_by`` runs (module
+import time for the production annotations), so the test session must
+set ``GUBER_RACE_SANITIZER`` before importing the annotated modules —
+conftest.py does this next to ``GUBER_LOCK_SANITIZER``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from gubernator_tpu.utils import lockorder
+
+
+def enabled() -> bool:
+    """Sanitizer gate. Requires the LOCK sanitizer too: the per-thread
+    held stacks this checker consults only exist on SanitizedLock."""
+    return (
+        os.environ.get("GUBER_RACE_SANITIZER", "") in ("1", "true")
+        and lockorder.enabled()
+    )
+
+
+_THIS_FILE = __file__
+
+
+def _site(skip: int = 2) -> str:
+    """Compact witness: 'file:line in func' of the offending access.
+    Filters by exact module path — a substring match would hide frames
+    from any file merely NAMED like this one (test_raceguard.py)."""
+    for frame in reversed(traceback.extract_stack()[:-skip]):
+        if frame.filename != _THIS_FILE:
+            return f"{frame.filename}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+class RaceGraph:
+    """Accumulates guarded-by violations with witness sites."""
+
+    def __init__(self) -> None:
+        # Plain lock: the sanitizer's own bookkeeping must not route
+        # through the sanitizers it implements.
+        self._mu = threading.Lock()
+        self.violations: List[dict] = []
+        self._seen: set = set()
+
+    def record(self, kind: str, cls: str, field: str, lock: str) -> None:
+        site = _site(skip=3)
+        key = (kind, cls, field, lock, site)
+        with self._mu:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self.violations.append({
+                "kind": kind,
+                "class": cls,
+                "field": field,
+                "lock": lock,
+                "thread": threading.current_thread().name,
+                "site": site,
+            })
+
+    def report(self) -> List[dict]:
+        with self._mu:
+            return list(self.violations)
+
+    def format_report(self) -> str:
+        lines = []
+        for v in self.report():
+            lines.append(
+                f"{v['kind']} of {v['class']}.{v['field']} without "
+                f"'{v['lock']}' held on thread {v['thread']} at {v['site']}"
+            )
+        return "\n".join(lines)
+
+    def clear(self) -> None:
+        with self._mu:
+            self.violations.clear()
+            self._seen.clear()
+
+
+DEFAULT_GRAPH = RaceGraph()
+
+# Declared protocol, always populated (even with the sanitizer off) so
+# tooling and tests can introspect what the codebase claims:
+# {class qualname: {field: mode-string}}.
+GUARDED_REGISTRY: Dict[str, Dict[str, str]] = {}
+
+_THREAD_MODE = "@thread"
+
+# Thread-local escape state. ``_local.init`` maps id(obj) -> depth for
+# objects currently inside a construction path; ``_local.racy`` maps
+# field name -> depth for active racy_read() blocks. Plain dicts keyed
+# by id work for __slots__ classes too.
+_local = threading.local()
+
+
+def _init_map() -> Dict[int, int]:
+    m = getattr(_local, "init", None)
+    if m is None:
+        m = {}
+        _local.init = m
+    return m
+
+
+def _racy_map() -> Dict[str, int]:
+    m = getattr(_local, "racy", None)
+    if m is None:
+        m = {}
+        _local.racy = m
+    return m
+
+
+def _holds(name: str, lock_graph: lockorder.LockOrderGraph) -> bool:
+    return any(n == name for n, _ in lock_graph._held())
+
+
+class Guarded:
+    """Data-descriptor enforcing a field's guarded-by declaration.
+
+    Plain classes store the value in the instance ``__dict__`` under
+    the field's own name (data descriptors take precedence, so reads
+    still route here). For ``__slots__`` classes the pre-existing slot
+    member-descriptor is captured as ``inner`` and delegated to.
+    """
+
+    __slots__ = ("field", "lock", "mode", "cls_name", "graph",
+                 "lock_graph", "inner", "_owner_key")
+
+    def __init__(self, field, lock, mode, cls_name, graph, lock_graph,
+                 inner=None):
+        self.field = field
+        self.lock = lock          # lock NAME, or None for @thread mode
+        self.mode = mode          # "rw" | "w" | "thread"
+        self.cls_name = cls_name
+        self.graph = graph
+        self.lock_graph = lock_graph
+        self.inner = inner
+        self._owner_key = "_rg_owner_" + field
+
+    # -- storage -----------------------------------------------------------
+
+    def _load(self, obj):
+        if self.inner is not None:
+            return self.inner.__get__(obj, type(obj))
+        try:
+            return obj.__dict__[self.field]
+        except KeyError:
+            raise AttributeError(
+                f"{type(obj).__name__!s} object has no attribute "
+                f"{self.field!r}"
+            ) from None
+
+    def _store(self, obj, value):
+        if self.inner is not None:
+            self.inner.__set__(obj, value)
+        else:
+            obj.__dict__[self.field] = value
+
+    # -- checks ------------------------------------------------------------
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        if self.mode == "rw" and id(obj) not in _init_map():
+            if self.field not in _racy_map() and not _holds(
+                self.lock, self.lock_graph
+            ):
+                self.graph.record("read", self.cls_name, self.field,
+                                  self.lock)
+        return self._load(obj)
+
+    def __set__(self, obj, value):
+        if id(obj) not in _init_map():
+            if self.mode == "thread":
+                self._check_affinity(obj)
+            elif not _holds(self.lock, self.lock_graph):
+                self.graph.record("write", self.cls_name, self.field,
+                                  self.lock)
+        self._store(obj, value)
+
+    def __delete__(self, obj):
+        if id(obj) not in _init_map():
+            if self.mode == "thread":
+                self._check_affinity(obj)
+            elif not _holds(self.lock, self.lock_graph):
+                self.graph.record("write", self.cls_name, self.field,
+                                  self.lock)
+        if self.inner is not None:
+            self.inner.__delete__(obj)
+        else:
+            del obj.__dict__[self.field]
+
+    def _check_affinity(self, obj):
+        d = getattr(obj, "__dict__", None)
+        if d is None:  # __slots__ class: nowhere to pin the owner
+            return
+        me = threading.get_ident()
+        owner = d.setdefault(self._owner_key, me)
+        if owner != me:
+            self.graph.record("cross-thread-write", self.cls_name,
+                              self.field, _THREAD_MODE)
+
+
+class racy_read:
+    """``with racy_read("_field", reason="...")``: suppress the read
+    check for the named field(s) on this thread inside the block. The
+    reason is mandatory and must say WHY the unlocked read is sound
+    (monotonic counter, precheck revalidated under the lock, ...)."""
+
+    def __init__(self, *fields: str, reason: str):
+        if not fields:
+            raise ValueError("racy_read needs at least one field name")
+        if not reason or not str(reason).strip():
+            raise ValueError("racy_read requires a non-empty reason")
+        self.fields = fields
+
+    def __enter__(self):
+        m = _racy_map()
+        for f in self.fields:
+            m[f] = m.get(f, 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        m = _racy_map()
+        for f in self.fields:
+            d = m.get(f, 0) - 1
+            if d <= 0:
+                m.pop(f, None)
+            else:
+                m[f] = d
+        return False
+
+
+def assert_held(
+    name: str,
+    *,
+    graph: Optional[RaceGraph] = None,
+    lock_graph: Optional[lockorder.LockOrderGraph] = None,
+) -> bool:
+    """Record a violation (and return False) if this thread does not
+    hold the named lock. For dict/list INTERIOR mutations the
+    descriptor cannot see. No-op (True) with the sanitizer off."""
+    if not enabled():
+        return True
+    lg = lock_graph or lockorder.DEFAULT_GRAPH
+    if _holds(name, lg):
+        return True
+    (graph or DEFAULT_GRAPH).record("unheld-assert", "<assert_held>",
+                                    "<interior>", name)
+    return False
+
+
+def init_path(fn):
+    """Mark a construction-path method: guarded writes inside it (on
+    the same object, same thread) are exempt. Also the static marker
+    GL017 honors for lock-free construction writes."""
+    if not enabled():
+        return fn
+
+    def wrapper(self, *args, **kwargs):
+        m = _init_map()
+        k = id(self)
+        m[k] = m.get(k, 0) + 1
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            d = m.get(k, 0) - 1
+            if d <= 0:
+                m.pop(k, None)
+            else:
+                m[k] = d
+
+    wrapper.__name__ = getattr(fn, "__name__", "init_path")
+    wrapper.__doc__ = fn.__doc__
+    wrapper.__wrapped__ = fn
+    wrapper._raceguard_init_path = True
+    return wrapper
+
+
+def holds_lock(
+    name: str,
+    *,
+    graph: Optional[RaceGraph] = None,
+    lock_graph: Optional[lockorder.LockOrderGraph] = None,
+):
+    """Mark a method whose contract is "caller holds ``name``". Checked
+    on entry at runtime under the sanitizer; GL017 treats the whole
+    body as lock-covered statically."""
+
+    def deco(fn):
+        if not enabled():
+            return fn
+        g = graph or DEFAULT_GRAPH
+        lg = lock_graph or lockorder.DEFAULT_GRAPH
+
+        def wrapper(self, *args, **kwargs):
+            if id(self) not in _init_map() and not _holds(name, lg):
+                g.record("unheld-method", type(self).__name__,
+                         getattr(fn, "__name__", "?"), name)
+            return fn(self, *args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "holds_lock")
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__wrapped__ = fn
+        wrapper._raceguard_holds = name
+        return wrapper
+
+    return deco
+
+
+def _find_inner(cls, field):
+    """Existing descriptor for ``field`` in the MRO (slot member), if
+    any — Guarded delegates storage to it for __slots__ classes."""
+    for klass in cls.__mro__:
+        d = klass.__dict__.get(field)
+        if d is not None and hasattr(d, "__set__") and hasattr(d, "__get__"):
+            return d
+    return None
+
+
+def _parse_mode(spec: str) -> Tuple[str, Optional[str]]:
+    """'lock.name' -> ('rw', name); 'w:lock.name' -> ('w', name);
+    'rw:lock.name' -> ('rw', name); '@thread' -> ('thread', None)."""
+    if spec == _THREAD_MODE:
+        return "thread", None
+    if spec.startswith("w:"):
+        return "w", spec[2:]
+    if spec.startswith("rw:"):
+        return "rw", spec[3:]
+    return "rw", spec
+
+
+def guarded_by(
+    cls,
+    fields: Dict[str, str],
+    *,
+    graph: Optional[RaceGraph] = None,
+    lock_graph: Optional[lockorder.LockOrderGraph] = None,
+):
+    """Declare (and, under the sanitizer, enforce) the guarded-by
+    protocol for ``cls``. Returns ``cls`` so it can wrap a class
+    statement, though the idiomatic call sits below the class body.
+
+    ``fields`` maps attribute name -> mode spec (module docstring).
+    The declaration always lands in ``GUARDED_REGISTRY``; descriptors
+    are installed only when the sanitizer is live.
+    """
+    reg = GUARDED_REGISTRY.setdefault(
+        f"{cls.__module__}.{cls.__qualname__}", {}
+    )
+    reg.update(fields)
+    if not enabled():
+        return cls
+    g = graph or DEFAULT_GRAPH
+    lg = lock_graph or lockorder.DEFAULT_GRAPH
+    for field, spec in fields.items():
+        mode, lock = _parse_mode(spec)
+        inner = _find_inner(cls, field)
+        setattr(cls, field, Guarded(field, lock, mode, cls.__name__,
+                                    g, lg, inner=inner))
+    init = cls.__dict__.get("__init__")
+    if init is not None and not getattr(init, "_raceguard_init_path", False):
+        setattr(cls, "__init__", init_path(init))
+    return cls

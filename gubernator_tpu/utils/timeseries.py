@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Callable
 
-from gubernator_tpu.utils import lockorder
+from gubernator_tpu.utils import lockorder, raceguard
 
 
 class Ring:
@@ -52,7 +52,10 @@ class Ring:
         self._lock = lockorder.make_lock("timeseries.ring")
 
     def __len__(self) -> int:
-        return self._n
+        with raceguard.racy_read(
+            "_n", reason="single int read; len() is an advisory gauge"
+        ):
+            return self._n
 
     def push(self, value: float, ts: float | None = None) -> None:
         """Append one sample; evicts the oldest once full."""
@@ -190,3 +193,17 @@ class RingSet:
                 row["mean"] = None if m is None else round(m, 6)
             out[name] = row
         return out
+
+
+# Declared lock protocol, checked under GUBER_RACE_SANITIZER=1
+# (docs/robustness.md "Race sanitizer"). Ring exercises the __slots__
+# path: the descriptors wrap the slot members in place.
+raceguard.guarded_by(Ring, {
+    "_ts": "timeseries.ring",
+    "_vals": "timeseries.ring",
+    "_n": "timeseries.ring",
+    "_head": "timeseries.ring",
+})
+raceguard.guarded_by(RingSet, {
+    "_rings": "timeseries.ringset",
+})

@@ -28,6 +28,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # probes. The autouse fixture below fails the offending test on any
 # cycle or double-acquire. See gubernator_tpu/utils/lockorder.py.
 os.environ.setdefault("GUBER_LOCK_SANITIZER", "1")
+# Guarded-by race sanitizer ON too (requires the lock sanitizer's held
+# stacks; must be set before the annotated modules import — guarded_by
+# reads the gate when it runs). Every declared field access is checked
+# against its lock, and the autouse fixture below fails the test that
+# recorded a violation. See gubernator_tpu/utils/raceguard.py.
+os.environ.setdefault("GUBER_RACE_SANITIZER", "1")
 
 import jax  # noqa: E402
 
@@ -123,6 +129,25 @@ def _lock_order_clean():
         raise AssertionError(
             "lock-order violation(s) recorded during this test:\n"
             + lockorder.DEFAULT_GRAPH.format_report()
+        )
+
+
+@pytest.fixture(autouse=True)
+def _race_guard_clean():
+    """Fail the test that introduced a guarded-by violation. Deliberate
+    violation tests (test_raceguard.py) use their own RaceGraph, so the
+    session-default graph must stay empty."""
+    from gubernator_tpu.utils import raceguard
+
+    before = len(raceguard.DEFAULT_GRAPH.report())
+    yield
+    after = raceguard.DEFAULT_GRAPH.report()
+    if len(after) > before:
+        report = raceguard.DEFAULT_GRAPH.format_report()
+        raceguard.DEFAULT_GRAPH.clear()
+        raise AssertionError(
+            "guarded-by race violation(s) recorded during this test:\n"
+            + report
         )
 
 

@@ -1,0 +1,65 @@
+"""GL017 violation fixture: guarded-field mutations outside the lock.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+from gubernator_tpu.utils import lockorder, raceguard
+from gubernator_tpu.utils.raceguard import holds_lock, init_path
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = lockorder.make_lock("engine.bulks")
+        self._rows = {}          # ok: __init__ is exempt
+        self._count = 0
+        self._tag = None
+
+    def locked_add(self, k, v):
+        with self._lock:
+            self._rows[k] = v    # ok: inside with self._lock
+            self._count += 1     # ok
+
+    def unlocked_add(self, k, v):
+        self._rows[k] = v        # finding: subscript store, no lock
+        self._count += 1         # finding: augassign, no lock
+
+    def unlocked_call(self, other):
+        self._rows.update(other)  # finding: mutator call, no lock
+
+    def conditional(self, k):
+        if k:
+            del self._rows[k]    # finding: delete inside if, no lock
+
+    @holds_lock("engine.bulks")
+    def contract_add(self, k, v):
+        self._rows[k] = v        # ok: @holds_lock covers the body
+
+    @init_path
+    def rebuild(self):
+        self._rows = {}          # ok: construction path
+        self._tag = "fresh"
+
+    def pragma_ok(self, k, v):
+        self._rows[k] = v  # guberlint: allow-lock-discipline -- fixture: witnessed single-thread path
+
+    def pragma_no_reason(self, k, v):
+        self._rows[k] = v  # guberlint: allow-lock-discipline
+
+    def affine_write(self, v):
+        self._tag = v            # ok: @thread mode is runtime-only
+
+
+raceguard.guarded_by(Ledger, {
+    "_rows": "engine.bulks",
+    "_count": "w:engine.bulks",
+    "_tag": "@thread",
+})
+
+
+class Sub(Ledger):
+    def sub_unlocked(self, k, v):
+        self._rows[k] = v        # finding: inherited guard, no lock
+
+    def sub_locked(self, k, v):
+        with self._lock:
+            self._rows[k] = v    # ok: inherited lock attr

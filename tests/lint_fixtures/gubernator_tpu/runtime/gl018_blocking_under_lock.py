@@ -1,0 +1,49 @@
+"""GL018 violation fixture: blocking calls inside hot-lock bodies.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import time
+
+import jax
+
+from gubernator_tpu.utils import lockorder
+
+
+class Engine:
+    def __init__(self):
+        self._lock = lockorder.make_lock("engine.table")
+        self._aux = lockorder.make_lock("warmup.cache")  # not a hot lock
+
+    def bad_sync(self, table):
+        with self._lock:
+            jax.block_until_ready(table)     # finding: block_until_ready
+            x = jax.device_get(table)        # finding: device_get
+        return x
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)                  # finding: time.sleep
+
+    def bad_future(self, fut):
+        with self._lock:
+            if fut is not None:
+                return fut.result()          # finding: .result under if
+
+    def ok_outside(self, table, fut):
+        with self._lock:
+            t = table
+        jax.block_until_ready(t)             # ok: lock released
+        return fut.result()                  # ok: no lock held
+
+    def ok_cold_lock(self, table):
+        with self._aux:
+            jax.block_until_ready(table)     # ok: not a hot lock
+
+    def pragma_ok(self, table):
+        with self._lock:
+            jax.block_until_ready(table)  # guberlint: allow-blocking-under-lock -- fixture: error-path probe
+
+    def pragma_no_reason(self, table):
+        with self._lock:
+            jax.block_until_ready(table)  # guberlint: allow-blocking-under-lock

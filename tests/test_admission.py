@@ -127,6 +127,70 @@ def test_recorder_counts_ring_and_metric_children():
     assert 'gubernator_over_limit_counter{path="owner"} 2.0' in text
 
 
+def test_recorder_child_create_race_counts_on_one_child():
+    """Regression: two threads racing through the first _count for a
+    (path, status) pair used to EACH create a counter child and inc
+    their own, with only one landing in the cache — splitting the tally
+    across objects, one of them unreachable. The cached child must see
+    both increments. labels() parks on an event so both threads are
+    provably inside the creation window (fails pre-fix every run, not
+    just on unlucky schedules)."""
+    import threading
+
+    class _Child:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k=1):
+            self.n += k
+
+    class _Family:
+        def __init__(self, gate=None):
+            self.gate = gate
+            self.created = []
+
+        def labels(self, *a):
+            c = _Child()
+            self.created.append(c)
+            if self.gate is not None:
+                self.gate.wait(timeout=5)
+            return c
+
+    gate = threading.Event()
+    decisions = _Family(gate)
+    m = SimpleNamespace(
+        admission_decisions=decisions, over_limit_counter=_Family()
+    )
+    rec = DecisionRecorder(m, ring_size=4)
+
+    threads = [
+        threading.Thread(
+            target=lambda: rec._count(PATH_OWNER, "under_limit")
+        )
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    # Both creators are parked inside labels() before either stores.
+    deadline = 100
+    while len(decisions.created) < 2 and deadline:
+        threading.Event().wait(0.01)
+        deadline -= 1
+    assert len(decisions.created) == 2, "threads never raced the create"
+    gate.set()
+    for t in threads:
+        t.join(timeout=5)
+
+    with rec._lock:
+        cached = rec._children[(PATH_OWNER, "under_limit")]
+        counted = rec._counts[(PATH_OWNER, "under_limit")]
+    assert cached.n == 2, (
+        "increments split across counter children: "
+        f"{[c.n for c in decisions.created]}"
+    )
+    assert counted == 2
+
+
 def test_recorder_columnar_masked_sums_and_sample():
     m = Metrics()
     rec = DecisionRecorder(m, ring_size=8)

@@ -114,6 +114,61 @@ def test_lease_wire_rejects_malformed():
         pb.lease_resp_from_bytes(b"junk{")
 
 
+@pytest.mark.chaos
+def test_outstanding_by_key_survives_concurrent_grants():
+    """Regression: outstanding_by_key() iterated the LIVE _leases
+    values() view; the consistency auditor sums it off the loop thread
+    while grants/expiries land, which can raise "dictionary changed
+    size during iteration". The list() snapshot must survive constant
+    resizing — and stay a consistent per-key sum."""
+    import sys
+    import threading
+    from types import SimpleNamespace
+
+    from gubernator_tpu.parallel.leases import LeaseRecord
+
+    mgr = LeaseManager(SimpleNamespace(now_fn=lambda: 0))
+    # Force rapid thread interleaving so the pre-fix Python-level for
+    # loop over the live view reliably observes a mid-iteration resize.
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+
+    def rec(i):
+        return LeaseRecord(
+            lease_id=f"L{i}", key=f"k{i % 4}", slice_hits=1,
+            expiry_ms=10**9, reset_time=10**9, limit=100,
+            duration=60_000, behavior=0, stamp=0,
+        )
+
+    errors = []
+
+    def auditor():
+        try:
+            for _ in range(2000):
+                by_key = mgr.outstanding_by_key()
+                assert all(v >= 0 for v in by_key.values())
+        except RuntimeError as e:  # pragma: no cover - pre-fix only
+            errors.append(e)
+
+    t = threading.Thread(target=auditor)
+    t.start()
+    try:
+        # Play the loop thread: install then drop batches so _leases
+        # resizes under the auditor's feet.
+        i = 0
+        while t.is_alive():
+            batch = [rec(i * 64 + j) for j in range(64)]
+            for r in batch:
+                mgr._install(r)
+            for r in batch:
+                mgr._drop_record(r)
+            i += 1
+        t.join(timeout=10)
+    finally:
+        sys.setswitchinterval(old_interval)
+    assert not errors, errors
+
+
 def test_snapshot_bytes_identical_without_leases():
     # The handover payload only grows a "leases" key when lease rows
     # actually ship — leases off ⇒ byte-identical snapshot chunks.

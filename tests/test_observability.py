@@ -121,6 +121,62 @@ def test_sketch_disabled_at_k_zero():
     assert sk.snapshot()["entries"] == []
 
 
+def test_sketch_snapshot_isolated_from_reentrant_update():
+    """Regression: snapshot() used to sort/serialize the LIVE entry
+    lists, so an update() re-entered through the display resolver (or
+    landing from another thread mid-serialization) mutated rows the
+    payload had already committed to — a /debug/hotkeys row could
+    report more hits than the payload's own total_hits. The copy taken
+    under the lock must be immune."""
+    sk = HotKeySketch("t_hot5", "d", k=4)
+    sk.update([((1, 2), 5, 0, None)])  # no name -> resolver consulted
+
+    def resolver(hi, lo):
+        # Side-effecting resolver: lands 100 more hits on the same key
+        # while snapshot() is resolving display names.
+        sk.update([((1, 2), 100, 0, None)])
+        return None
+
+    sk.set_resolver(resolver)
+    snap = sk.snapshot()
+    assert snap["total_hits"] == 5
+    assert snap["entries"][0]["hits"] == 5, (
+        "snapshot row mutated by a reentrant update"
+    )
+    # the reentrant hits did land for the NEXT snapshot
+    sk.set_resolver(None)
+    assert sk.snapshot()["entries"][0]["hits"] == 105
+
+
+@pytest.mark.chaos
+def test_sketch_snapshot_consistent_under_concurrent_update():
+    """Space-saving preserves sum(entry hits) == total exactly (an
+    eviction inherits the victim's count), so any snapshot taken
+    atomically must balance. Pre-fix, concurrent updates tore the
+    payload: total captured before entries serialized."""
+    import threading
+
+    sk = HotKeySketch("t_hot6", "d", k=4)
+    stop = threading.Event()
+
+    def pump():
+        i = 0
+        while not stop.is_set():
+            sk.update([(((i % 6), 0), 1, 0, None)])
+            i += 1
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        for _ in range(300):
+            snap = sk.snapshot()
+            total = sum(e["hits"] for e in snap["entries"])
+            assert total == snap["total_hits"], snap
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
 def test_sketch_render_lines_bounded_gauge():
     sk = HotKeySketch("t_hot4", "d", k=4)
     for i in range(32):

@@ -19,6 +19,13 @@ import pytest
 from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, Status
 from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
 from gubernator_tpu.runtime.pager import PageBudgetError
+from gubernator_tpu.utils import lockorder
+
+# Direct-Pager tests poke fields the engine normally touches under its
+# table lock. The race sanitizer (tests/conftest.py) checks locks by
+# NAME, so holding any lock named "engine.table" satisfies the Pager's
+# guarded-by declarations here.
+_TABLE_LOCK = lockorder.make_lock("engine.table")
 
 NOW = 1_753_700_000_000
 
@@ -137,8 +144,9 @@ def test_keyspace_beyond_resident_budget_zero_loss():
             rl = eng.check_batch([mk(key=k, hits=0)])[0]
             assert rl.remaining == 95, (k, rl.remaining)
         pager = eng._pager
-        assert pager.resident_count() <= 2
-        assert pager.demotes >= pager.host_count() > 0
+        with eng._lock:
+            assert pager.resident_count() <= 2
+            assert pager.demotes >= pager.host_count() > 0
     finally:
         eng.close()
 
@@ -165,7 +173,8 @@ def test_census_reports_tiers_and_page_map():
         assert pages["logical_pages"] == NUM_GROUPS // PAGE_GROUPS
         assert pages["budget"] == 2
         assert pages["resident"] + pages["free"] == 2
-        assert pages["host"] == eng._pager.host_count() > 0
+        with eng._lock:
+            assert pages["host"] == eng._pager.host_count() > 0
         assert pages["demotes"] > 0
     finally:
         eng.close()
@@ -178,9 +187,10 @@ def test_one_wave_over_budget_raises_loudly():
     eng = make_engine(page_groups=PAGE_GROUPS, page_budget=2)
     try:
         with pytest.raises(PageBudgetError, match="GUBER_TABLE_PAGE_BUDGET"):
-            eng._pager.ensure_resident(
-                eng.table, np.arange(4, dtype=np.int64)
-            )
+            with eng._lock:
+                eng._pager.ensure_resident(
+                    eng.table, np.arange(4, dtype=np.int64)
+                )
     finally:
         eng.close()
 
@@ -224,7 +234,9 @@ def test_snapshot_equals_flat_and_restores_across_budgets():
     tight = make_engine(page_groups=PAGE_GROUPS, page_budget=1)
     try:
         tight.restore(s_paged)
-        assert tight._pager.host_count() > 0, (
+        with tight._lock:
+            host_n = tight._pager.host_count()
+        assert host_n > 0, (
             "restore fit everything resident — budget isn't tight"
         )
         for i in range(40):
@@ -247,7 +259,8 @@ def test_handover_exports_keys_on_demoted_pages():
     dst = make_engine()
     try:
         keys = _serve_and_demote(src)
-        assert src._pager.host_count() > 0
+        with src._lock:
+            assert src._pager.host_count() > 0
         items = {s.key for s in snapshots_from_engine(src)}
         missing = [k for k in keys if f"pg_{k}" not in items]
         assert not missing, f"demoted keys absent from handover: {missing}"
@@ -353,21 +366,23 @@ def _resident_pager():
 
     p = Pager(_FakePK())
     # bind lp 0 -> frame 0 and lp 1 -> frame 1 by hand
-    p.page_map[0], p.page_map[1] = 0, 1
-    p.free = []
+    with _TABLE_LOCK:
+        p.page_map[0], p.page_map[1] = 0, 1
+        p.free = []
     return p
 
 
 def test_coldness_from_heatmap_folds_regions_to_pages():
     p = _resident_pager()
-    # 4 groups per page, 2 groups per census region -> page 0 (frame 0)
-    # covers regions 0-1, page 1 (frame 1) covers regions 2-3
-    hm = [5, 1, 0, 2]
-    cold = p.coldness_from_heatmap(hm, groups_per_region=2)
-    assert cold == {0: 6.0, 1: 2.0}
-    # region wider than a page: overlap-weighted share
-    cold = p.coldness_from_heatmap([8], groups_per_region=8)
-    assert cold == {0: 4.0, 1: 4.0}
+    with _TABLE_LOCK:
+        # 4 groups per page, 2 groups per census region -> page 0
+        # (frame 0) covers regions 0-1, page 1 (frame 1) regions 2-3
+        hm = [5, 1, 0, 2]
+        cold = p.coldness_from_heatmap(hm, groups_per_region=2)
+        assert cold == {0: 6.0, 1: 2.0}
+        # region wider than a page: overlap-weighted share
+        cold = p.coldness_from_heatmap([8], groups_per_region=8)
+        assert cold == {0: 4.0, 1: 4.0}
 
 
 def test_census_cold_page_evicted_before_hot_touched():
@@ -376,32 +391,36 @@ def test_census_cold_page_evicted_before_hot_touched():
     idle must be evicted before a census-busy page with an older touch.
     Census coldness also overrides the min_idle_ticks spare gate."""
     p = _resident_pager()
-    p._tick = 10
-    p.touch[0] = 10  # hot-touched...
-    p.touch[1] = 2   # ...vs old-touched
-    coldness = {0: 6.0, 1: 0.0}  # ...but census-cold vs census-busy
-    assert p._pick_victim(coldness) == 0
-    p.demote_victims(
-        object(), want_free=1, min_idle_ticks=100, coldness=coldness
-    )
-    assert p.page_map[0] == -1, "census-cold page was not evicted"
-    assert p.page_map[1] == 1, "census-busy page was evicted instead"
-    assert p.free == [0]
+    with _TABLE_LOCK:
+        p._tick = 10
+        p.touch[0] = 10  # hot-touched...
+        p.touch[1] = 2   # ...vs old-touched
+        coldness = {0: 6.0, 1: 0.0}  # ...but census-cold vs census-busy
+        assert p._pick_victim(coldness) == 0
+        p.demote_victims(
+            object(), want_free=1, min_idle_ticks=100, coldness=coldness
+        )
+        assert p.page_map[0] == -1, "census-cold page was not evicted"
+        assert p.page_map[1] == 1, "census-busy page was evicted instead"
+        assert p.free == [0]
 
 
 def test_pure_lru_fallback_and_min_idle_spare():
     p = _resident_pager()
-    p._tick = 10
-    p.touch[0], p.touch[1] = 9, 10
-    # no census signal: LRU picks the older touch
-    assert p._pick_victim(None) == 0
-    # both pages touched within min_idle_ticks and no census coldness:
-    # the demoter must spare them all and stop
-    p.demote_victims(object(), want_free=2, min_idle_ticks=5, coldness=None)
-    assert p.free == [] and p.page_map[0] == 0 and p.page_map[1] == 1
-    # without the idle gate the LRU victim goes
-    p.demote_victims(object(), want_free=1)
-    assert p.page_map[0] == -1 and p.page_map[1] == 1
+    with _TABLE_LOCK:
+        p._tick = 10
+        p.touch[0], p.touch[1] = 9, 10
+        # no census signal: LRU picks the older touch
+        assert p._pick_victim(None) == 0
+        # both pages touched within min_idle_ticks and no census
+        # coldness: the demoter must spare them all and stop
+        p.demote_victims(
+            object(), want_free=2, min_idle_ticks=5, coldness=None
+        )
+        assert p.free == [] and p.page_map[0] == 0 and p.page_map[1] == 1
+        # without the idle gate the LRU victim goes
+        p.demote_victims(object(), want_free=1)
+        assert p.page_map[0] == -1 and p.page_map[1] == 1
 
 
 def test_background_demoter_fills_free_target():

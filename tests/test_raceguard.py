@@ -1,0 +1,241 @@
+"""Guarded-by race sanitizer (gubernator_tpu/utils/raceguard.py).
+
+Deliberate-violation tests pass PRIVATE RaceGraph / LockOrderGraph
+instances so the session-default graphs (asserted empty after every
+test by conftest's autouse fixtures) never see the staged violations.
+conftest sets GUBER_RACE_SANITIZER=1 suite-wide, so guarded_by here
+installs live descriptors.
+"""
+
+import threading
+
+import pytest
+
+from gubernator_tpu.utils import lockorder, raceguard
+
+
+def _fresh(fields, slots=None):
+    """A stand-in class annotated against private graphs. Returns
+    (instance, race_graph, lock) — the lock is named 'test.guard' on a
+    private lock-order graph, so held-ness is isolated per test."""
+    rg = raceguard.RaceGraph()
+    lg = lockorder.LockOrderGraph()
+    lock = lockorder.make_lock("test.guard", graph=lg)
+
+    if slots is None:
+
+        class Box:
+            def __init__(self):
+                self._val = 0
+                self._ledger = {}
+                self._affine = 0
+
+    else:
+
+        class Box:
+            __slots__ = tuple(slots)
+
+            def __init__(self):
+                for f in slots:
+                    setattr(self, f, 0)
+
+    raceguard.guarded_by(Box, fields, graph=rg, lock_graph=lg)
+    return Box(), rg, lock
+
+
+def _kinds(rg):
+    return [(v["kind"], v["field"]) for v in rg.report()]
+
+
+def test_enabled_in_suite():
+    # conftest sets both gates before any annotated module imports —
+    # everything below relies on live descriptors.
+    assert raceguard.enabled()
+
+
+def test_read_write_clean_under_lock():
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    with lock:
+        box._val = 7
+        assert box._val == 7
+    assert rg.report() == []
+
+
+def test_unlocked_read_and_write_recorded():
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    box._val = 1  # write without the lock
+    _ = box._val  # read without the lock
+    kinds = _kinds(rg)
+    assert ("write", "_val") in kinds and ("read", "_val") in kinds
+    v = rg.report()[0]
+    assert v["lock"] == "test.guard"
+    assert "test_raceguard.py" in v["site"]
+
+
+def test_violations_dedupe_by_site():
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    for _ in range(5):
+        box._val = 1
+    assert len([k for k in _kinds(rg) if k[0] == "write"]) == 1
+
+
+def test_write_only_mode_allows_racy_reads():
+    box, rg, lock = _fresh({"_val": "w:test.guard"})
+    _ = box._val  # reads unchecked in w: mode
+    assert rg.report() == []
+    box._val = 2  # writes still checked
+    assert _kinds(rg) == [("write", "_val")]
+
+
+def test_racy_read_escape_suppresses_read_check():
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    with raceguard.racy_read("_val", reason="unit test escape"):
+        _ = box._val
+    assert rg.report() == []
+    _ = box._val  # outside the block the check is back
+    assert _kinds(rg) == [("read", "_val")]
+
+
+def test_racy_read_requires_reason_and_fields():
+    with pytest.raises(ValueError, match="reason"):
+        raceguard.racy_read("_val", reason="  ")
+    with pytest.raises(ValueError, match="field"):
+        raceguard.racy_read(reason="no fields")
+
+
+def test_racy_read_does_not_cover_writes():
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    with raceguard.racy_read("_val", reason="reads only"):
+        box._val = 3
+    assert _kinds(rg) == [("write", "_val")]
+
+
+def test_thread_affinity_mode():
+    box, rg, lock = _fresh({"_affine": "@thread"})
+    box._affine = 1  # first writer pins ownership
+    box._affine = 2  # same thread: fine
+    _ = box._affine  # reads never checked in @thread mode
+    assert rg.report() == []
+
+    t = threading.Thread(target=lambda: setattr(box, "_affine", 3))
+    t.start()
+    t.join()
+    assert _kinds(rg) == [("cross-thread-write", "_affine")]
+
+
+def test_init_writes_exempt_via_wrapped_init():
+    # guarded_by wraps Box.__init__ with init_path: the constructor's
+    # lock-free writes must not record.
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    assert rg.report() == []
+
+
+def test_assert_held():
+    rg = raceguard.RaceGraph()
+    lg = lockorder.LockOrderGraph()
+    lock = lockorder.make_lock("test.interior", graph=lg)
+    with lock:
+        assert raceguard.assert_held(
+            "test.interior", graph=rg, lock_graph=lg
+        )
+    assert rg.report() == []
+    assert not raceguard.assert_held(
+        "test.interior", graph=rg, lock_graph=lg
+    )
+    assert rg.report()[0]["kind"] == "unheld-assert"
+
+
+def test_holds_lock_checks_on_entry():
+    rg = raceguard.RaceGraph()
+    lg = lockorder.LockOrderGraph()
+    lock = lockorder.make_lock("test.guard", graph=lg)
+
+    class M:
+        @raceguard.holds_lock("test.guard", graph=rg, lock_graph=lg)
+        def poke(self):
+            return 42
+
+    m = M()
+    with lock:
+        assert m.poke() == 42
+    assert rg.report() == []
+    m.poke()
+    v = rg.report()
+    assert v and v[0]["kind"] == "unheld-method" and v[0]["field"] == "poke"
+    # the static marker GL017 keys on:
+    assert M.poke._raceguard_holds == "test.guard"
+
+
+def test_slots_class_delegates_to_member_descriptor():
+    box, rg, lock = _fresh({"_val": "test.guard"}, slots=("_val",))
+    with lock:
+        box._val = 9
+        assert box._val == 9
+    assert rg.report() == []
+    assert not hasattr(box, "__dict__")
+    box._val = 10
+    assert _kinds(rg) == [("write", "_val")]
+
+
+def test_registry_always_populated():
+    # Importing an annotated module is what lands its declaration.
+    from gubernator_tpu.runtime import pager  # noqa: F401
+    from gubernator_tpu.utils import timeseries  # noqa: F401
+
+    reg = raceguard.GUARDED_REGISTRY
+    assert reg["gubernator_tpu.utils.timeseries.Ring"]["_n"] == (
+        "timeseries.ring"
+    )
+    assert reg["gubernator_tpu.runtime.pager.Pager"]["page_map"] == (
+        "engine.table"
+    )
+
+
+def test_disabled_gate_is_raw_attribute(monkeypatch):
+    monkeypatch.delenv("GUBER_RACE_SANITIZER", raising=False)
+    assert not raceguard.enabled()
+
+    class Cold:
+        def __init__(self):
+            self._val = 0
+
+    rg = raceguard.RaceGraph()
+    raceguard.guarded_by(Cold, {"_val": "test.guard"}, graph=rg)
+    c = Cold()
+    c._val = 5  # no lock, no descriptor, no violation
+    assert c._val == 5
+    assert rg.report() == []
+    # declaration still lands in the registry for tooling
+    assert raceguard.GUARDED_REGISTRY[
+        f"{Cold.__module__}.{Cold.__qualname__}"
+    ]["_val"] == "test.guard"
+    assert not isinstance(Cold.__dict__.get("_val"), raceguard.Guarded)
+
+
+@pytest.mark.chaos
+def test_two_thread_race_provably_trips():
+    """The sanitizer's reason to exist: two threads hammering a guarded
+    field, one of them lockless, must leave a witness — deterministic
+    because every unlocked access records, not just unlucky ones."""
+    box, rg, lock = _fresh({"_val": "test.guard"})
+    stop = threading.Event()
+
+    def locked_writer():
+        while not stop.is_set():
+            with lock:
+                box._val += 1
+
+    def lockless_reader():
+        for _ in range(200):
+            _ = box._val
+
+    w = threading.Thread(target=locked_writer)
+    r = threading.Thread(target=lockless_reader)
+    w.start()
+    r.start()
+    r.join(timeout=10)
+    stop.set()
+    w.join(timeout=10)
+    kinds = _kinds(rg)
+    assert ("read", "_val") in kinds, rg.format_report()
+    assert ("write", "_val") not in kinds  # the locked side stays clean

@@ -4,6 +4,8 @@ receiver shadow semantics, promotion/echo idempotence, drain retire,
 fault-injected repair, and the GUBER_STANDBY=0 bit-exact pin. The
 acceptance soak is tools/jobs/44_crash_soak.py."""
 
+import asyncio
+import threading
 import time
 from types import SimpleNamespace
 
@@ -136,7 +138,8 @@ def test_receive_delta_applies_lww():
         pb.standby_to_bytes("delta", "o:1", seq=3,
                             snaps=[snap("k", stamp=100, remaining=70)])))
     assert (a, st) == (1, 0)
-    assert rm._shadow["o:1"].rows["k"].remaining == 70
+    with rm._shadow_lock:
+        assert rm._shadow["o:1"].rows["k"].remaining == 70
 
 
 def test_receive_full_replaces_and_region_purge():
@@ -146,7 +149,8 @@ def test_receive_full_replaces_and_region_purge():
     # Plain full image: wholesale replace.
     rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
         "full", "o:1", seq=2, snaps=[snap("fresh")])))
-    assert set(rm._shadow["o:1"].rows) == {"fresh"}
+    with rm._shadow_lock:
+        assert set(rm._shadow["o:1"].rows) == {"fresh"}
     # Region-scoped replace (anti-entropy repair): only rows in the
     # digest-keyed regions are purged before the insert.
     region = rm._region("fresh")
@@ -157,7 +161,8 @@ def test_receive_full_replaces_and_region_purge():
         "delta", "o:1", seq=3, snaps=[snap(other)])))
     rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
         "full", "o:1", seq=4, snaps=[], digests={region: (0, 0)})))
-    assert set(rm._shadow["o:1"].rows) == {other}
+    with rm._shadow_lock:
+        assert set(rm._shadow["o:1"].rows) == {other}
 
 
 def test_receive_digest_reports_mismatched_regions():
@@ -173,7 +178,8 @@ def test_receive_digest_reports_mismatched_regions():
     # Drop one shadow row: exactly its region mismatches (both ways —
     # also regions the owner has that the shadow lacks entirely).
     victim = rows[3]
-    del rm._shadow["o:1"].rows[victim.key]
+    with rm._shadow_lock:
+        del rm._shadow["o:1"].rows[victim.key]
     _, _, extra = rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
         "digest", "o:1", seq=3, digests=d)))
     assert extra["standby"]["mismatch"] == [rm._region(victim.key)]
@@ -185,7 +191,8 @@ def test_receive_retire_drops_shadow_and_cap_counts_drops():
     rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
         "delta", "o:1", seq=1,
         snaps=[snap("a"), snap("b"), snap("c")])))
-    ent = rm._shadow["o:1"]
+    with rm._shadow_lock:
+        ent = rm._shadow["o:1"]
     assert len(ent.rows) == 2 and ent.dropped == 1
     # Updates to EXISTING keys still apply at the cap.
     a, st, _ = rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
@@ -194,7 +201,75 @@ def test_receive_retire_drops_shadow_and_cap_counts_drops():
     _, _, extra = rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
         "retire", "o:1", seq=3)))
     assert extra["standby"]["retired"] == 2
-    assert "o:1" not in rm._shadow
+    with rm._shadow_lock:
+        assert "o:1" not in rm._shadow
+
+
+def test_ring_change_shadow_probe_holds_lock():
+    """Regression: on_ring_change probed `addr in self._shadow` without
+    the shadow lock while executor-thread receive() mutates it. The
+    race sanitizer (on suite-wide, tests/conftest.py) records any
+    unlocked probe — this test fails pre-fix via the explicit assert
+    below AND the autouse graph check."""
+    from gubernator_tpu.utils import raceguard
+
+    rm = _manager()
+    rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
+        "delta", "o:1", seq=1, snaps=[snap("a")])))
+    rm.on_ring_change({"o:1", "o:2"}, set())
+    # a departed source with a live shadow is queued; one without isn't
+    assert rm._promote_queue == {"o:1"}
+    assert raceguard.DEFAULT_GRAPH.report() == []
+
+
+def test_scan_promotions_shadow_scan_holds_lock():
+    """Same regression for _scan_promotions' membership probe and keys
+    iteration (both read _shadow from the loop thread while executor
+    receives land)."""
+    from gubernator_tpu.utils import raceguard
+
+    rm = _manager()
+    rm.receive(pb.standby_from_bytes(pb.standby_to_bytes(
+        "delta", "o:1", seq=1, snaps=[snap("a")])))
+    rm.mesh._all = {
+        "o:1": SimpleNamespace(
+            breaker=SimpleNamespace(state_name="closed")
+        )
+    }
+    asyncio.run(rm._scan_promotions())
+    assert raceguard.DEFAULT_GRAPH.report() == []
+
+
+@pytest.mark.chaos
+def test_loss_bound_scrape_survives_ledger_resize():
+    """loss_bound_hits() is scraped off the loop thread while the ship
+    loop mutates the ledger. The audit verdict: the old values() sum
+    was GIL-atomic in CPython (one C-level call), so this pins the
+    contract rather than a reproducible pre-fix crash — the dict() copy
+    keeps the read one atomic snapshot even on runtimes where C loops
+    can interleave (free-threaded builds)."""
+    rm = _manager()
+    errors = []
+
+    def scraper():
+        try:
+            for _ in range(2000):
+                rm.loss_bound_hits()
+        except RuntimeError as e:  # pragma: no cover - pre-fix only
+            errors.append(e)
+
+    t = threading.Thread(target=scraper)
+    t.start()
+    # Play the ship loop: grow then clear so the dict RESIZES (resize
+    # mid-iteration is what raises on the pre-fix read).
+    i = 0
+    while t.is_alive():
+        for j in range(64):
+            rm._pending_hits[f"k{i}:{j}"] = 1
+        rm._pending_hits.clear()
+        i += 1
+    t.join(timeout=10)
+    assert not errors, errors
 
 
 # ---------------------------------------------------------------------------
@@ -400,16 +475,18 @@ def test_standby_fault_drops_repaired_by_anti_entropy(loop_thread):
         faults.INJECTOR.clear()
         # Corrupt the shadow (simulated standby restart): anti-entropy
         # must find and repair it, then report clean.
-        shadow = b.svc.standby._shadow[a.grpc_address]
-        lost = list(shadow.rows)[:4]
-        for k in lost:
-            del shadow.rows[k]
+        with b.svc.standby._shadow_lock:
+            shadow = b.svc.standby._shadow[a.grpc_address]
+            lost = list(shadow.rows)[:4]
+            for k in lost:
+                del shadow.rows[k]
         r1 = loop_thread.run(a.svc.standby.anti_entropy_once(), timeout=30)
         assert r1["mismatched_regions"] > 0
         r2 = loop_thread.run(a.svc.standby.anti_entropy_once(), timeout=30)
         assert r2["mismatched_regions"] == 0
-        for k in lost:
-            assert k in b.svc.standby._shadow[a.grpc_address].rows
+        with b.svc.standby._shadow_lock:
+            for k in lost:
+                assert k in b.svc.standby._shadow[a.grpc_address].rows
     finally:
         faults.INJECTOR.clear()
         loop_thread.run(c.stop())
@@ -430,7 +507,8 @@ def test_standby_off_is_bit_exact(loop_thread):
         # No manager, no dirty tracking, no debug surface.
         for d in (a, b):
             assert d.svc.standby is None
-            assert d.engine._dirty is None
+            with d.engine._dirty_lock:
+                assert d.engine._dirty is None
             assert d.svc.standby_debug_info() == {"enabled": False}
         assert not _hit(loop_thread, a, "off_k", 3).error
         # A v=2 envelope is rejected INVALID_ARGUMENT — the same class a

@@ -84,11 +84,19 @@ class Pragmas:
     def lookup(self, line: int, name: str) -> Tuple[bool, Optional[str]]:
         """(present, reason) for an allow-<name> pragma covering `line`
         (same line or the comment line directly above)."""
+        ln, reason = self.lookup_line(line, name)
+        return ln is not None, reason
+
+    def lookup_line(
+        self, line: int, name: str
+    ) -> Tuple[Optional[int], Optional[str]]:
+        """Like lookup, but returns the pragma's own line number (for
+        the dead-pragma pruner) instead of a bare present flag."""
         for ln in (line, line - 1):
             allows = self.by_line.get(ln)
             if allows and name in allows:
-                return True, allows[name]
-        return False, None
+                return ln, allows[name]
+        return None, None
 
 
 class Module:
@@ -100,6 +108,36 @@ class Module:
         self.source = source
         self.tree = tree
         self.pragmas = Pragmas(source)
+        self._nodes: Optional[list] = None
+        self._scoped: Optional[list] = None
+
+    def nodes(self) -> list:
+        """Flat ast.walk of the whole tree, computed once and shared by
+        every rule (rules used to re-walk per rule)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def scoped(self) -> list:
+        """(node, enclosing-function-stack) pairs, depth-first, computed
+        once and shared across rules (the scoped twin of nodes())."""
+        if self._scoped is None:
+            out = []
+
+            def rec(node: ast.AST, stack: Tuple[ast.AST, ...]):
+                for child in ast.iter_child_nodes(node):
+                    out.append((child, stack))
+                    if isinstance(
+                        child,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ):
+                        rec(child, stack + (child,))
+                    else:
+                        rec(child, stack)
+
+            rec(self.tree, ())
+            self._scoped = out
+        return self._scoped
 
     def segment(self, node: ast.AST) -> str:
         try:
@@ -201,10 +239,15 @@ def load_modules(files: Iterable[str]) -> Tuple[List[Module], List[Finding]]:
 
 
 def _apply_pragmas(
-    findings: List[Finding], mods: List[Module]
+    findings: List[Finding],
+    mods: List[Module],
+    used: Optional[set] = None,
 ) -> List[Finding]:
     """Drop findings suppressed by inline pragmas; a reason-requiring
-    rule whose pragma lacks a reason keeps the finding (re-messaged)."""
+    rule whose pragma lacks a reason keeps the finding (re-messaged).
+    When ``used`` is given, every pragma that matched a finding — even
+    a reason-less one on a reason-requiring rule — is recorded there as
+    (path, pragma-line, name) for the dead-pragma pruner."""
     by_path = {m.relpath: m for m in mods}
     rules = {r.name: r for r in REGISTRY}
     out = []
@@ -213,10 +256,12 @@ def _apply_pragmas(
         if mod is None:
             out.append(f)
             continue
-        present, reason = mod.pragmas.lookup(f.line, f.name)
-        if not present:
+        pragma_line, reason = mod.pragmas.lookup_line(f.line, f.name)
+        if pragma_line is None:
             out.append(f)
             continue
+        if used is not None:
+            used.add((f.path, pragma_line, f.name))
         rule = rules.get(f.name)
         if rule is not None and rule.requires_reason and not reason:
             out.append(
@@ -266,6 +311,12 @@ class Result:
     findings: List[Finding]  # every unsuppressed finding
     new: List[Finding]  # findings not covered by the baseline
     stale_keys: List[str]  # baseline entries no longer observed
+    # allow-pragmas that suppressed nothing, as (path, line, name).
+    # Only computed on a full-repo, all-rules scan (a partial scan
+    # cannot tell "dead" from "not exercised").
+    stale_pragmas: List[Tuple[str, int, str]] = dataclasses.field(
+        default_factory=list
+    )
 
 
 def run_lint(
@@ -289,8 +340,18 @@ def run_lint(
         for mod in mods:
             findings.extend(rule.check_module(mod))
         findings.extend(rule.check_repo(ctx))
-    findings = _apply_pragmas(findings, mods)
+    used_pragmas: set = set()
+    findings = _apply_pragmas(findings, mods, used=used_pragmas)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    stale_pragmas: List[Tuple[str, int, str]] = []
+    if full_repo and wanted is None:
+        for m in mods:
+            for ln, allows in m.pragmas.by_line.items():
+                for name in allows:
+                    if (m.relpath, ln, name) not in used_pragmas:
+                        stale_pragmas.append((m.relpath, ln, name))
+        stale_pragmas.sort()
 
     base = dict(baseline or {})
     seen: Dict[str, int] = {}
@@ -304,7 +365,12 @@ def run_lint(
         for k, n in base.items()
         if seen.get(k, 0) < n
     )
-    return Result(findings=findings, new=new, stale_keys=stale)
+    return Result(
+        findings=findings,
+        new=new,
+        stale_keys=stale,
+        stale_pragmas=stale_pragmas,
+    )
 
 
 # Rule registration (import populates REGISTRY).

@@ -7,16 +7,66 @@ or baselined); 1 when new findings exist; 2 on usage errors.
 from __future__ import annotations
 
 import argparse
+import os
+import re
+import subprocess
 import sys
 
 from tools.lint import (
     DEFAULT_BASELINE,
+    DEFAULT_ROOTS,
+    REPO_ROOT,
     REGISTRY,
     load_baseline,
     run_lint,
     save_baseline,
 )
 from tools.lint import rules as _rules
+
+
+def changed_py_files() -> list:
+    """Repo-relative .py paths under the default scan roots that differ
+    from HEAD (staged, unstaged, or untracked) — the --changed-only
+    fast path for local runs."""
+    cmds = (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    names = []
+    for cmd in cmds:
+        out = subprocess.run(
+            cmd, cwd=REPO_ROOT, capture_output=True, text=True, check=True
+        ).stdout
+        names.extend(out.splitlines())
+    roots = tuple(r + "/" for r in DEFAULT_ROOTS)
+    return sorted(
+        {
+            n
+            for n in names
+            if n.endswith(".py")
+            and n.startswith(roots)
+            and os.path.exists(os.path.join(REPO_ROOT, n))
+        }
+    )
+
+
+def prune_pragma_line(text: str, names: set) -> str:
+    """Remove the allow-<name> directives in ``names`` from a source
+    line. Returns the line without its pragma when every allow in the
+    pragma is being pruned ('' for a pure comment line); returns the
+    line unchanged when any allow must stay (mixed pragmas are left for
+    a human)."""
+    m = re.search(r"#\s*guberlint:.*$", text)
+    if not m:
+        return text
+    declared = set(
+        am.group(1)
+        for am in re.finditer(r"allow-([a-z0-9-]+)", m.group(0))
+    )
+    if not declared or not declared.issubset(names):
+        return text
+    kept = text[: m.start()].rstrip()
+    return kept
 
 
 def main(argv=None) -> int:
@@ -56,6 +106,25 @@ def main(argv=None) -> int:
         default=None,
         help="comma list of rule codes or names to run (default: all)",
     )
+    ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="scan only .py files that differ from HEAD (plus untracked "
+        "ones) under the default roots — fast local runs; skips the "
+        "repo-scoped doc-drift rules like any explicit-path scan",
+    )
+    ap.add_argument(
+        "--prune-pragmas",
+        action="store_true",
+        help="full-repo scan reporting allow-pragmas that no longer "
+        "suppress any finding; exit 1 when any exist",
+    )
+    ap.add_argument(
+        "--fix",
+        action="store_true",
+        help="with --prune-pragmas: delete the dead pragmas in place "
+        "(pure-comment lines are removed, trailing pragmas stripped)",
+    )
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
@@ -70,6 +139,58 @@ def main(argv=None) -> int:
     baseline = (
         {} if args.no_baseline else load_baseline(args.baseline)
     )
+
+    if args.prune_pragmas:
+        if args.paths or args.rules or args.changed_only:
+            ap.error(
+                "--prune-pragmas requires a full-repo, all-rules scan "
+                "(no paths, --rules, or --changed-only)"
+            )
+        result = run_lint(baseline=baseline)
+        stale = result.stale_pragmas
+        for path, ln, name in stale:
+            print(f"{path}:{ln}: dead pragma allow-{name}")
+        if args.fix and stale:
+            by_file: dict = {}
+            for path, ln, name in stale:
+                by_file.setdefault(path, {}).setdefault(ln, set()).add(name)
+            for path, lines in sorted(by_file.items()):
+                abspath = os.path.join(REPO_ROOT, path)
+                with open(abspath, encoding="utf-8") as fh:
+                    src = fh.read().splitlines()
+                removed = 0
+                for ln, names in lines.items():
+                    new_text = prune_pragma_line(src[ln - 1], names)
+                    if new_text != src[ln - 1]:
+                        src[ln - 1] = new_text
+                        removed += 1
+                # A pragma-only line prunes to '': drop it entirely.
+                body = "\n".join(
+                    t
+                    for i, t in enumerate(src)
+                    if not (t == "" and (i + 1) in lines)
+                )
+                with open(abspath, "w", encoding="utf-8") as fh:
+                    fh.write(body + "\n")
+                print(f"prune-pragmas: {path}: {removed} pragma(s) removed")
+            return 0
+        if not args.quiet:
+            print(
+                f"guberlint: {len(stale)} dead pragma(s)",
+                file=sys.stderr,
+            )
+        return 1 if stale else 0
+
+    if args.changed_only:
+        if args.paths:
+            ap.error("--changed-only and explicit paths are exclusive")
+        changed = changed_py_files()
+        if not changed:
+            if not args.quiet:
+                print("guberlint: no changed files", file=sys.stderr)
+            return 0
+        args.paths = changed
+
     result = run_lint(
         paths=args.paths or None,
         rule_codes=rule_codes,

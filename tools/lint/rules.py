@@ -168,7 +168,7 @@ class GL001HostSync(Rule):
         if not _in_serving_path(mod.relpath):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.Call):
                 continue
             fn = func_name(stack)
@@ -230,7 +230,7 @@ class GL002JitPurity(Rule):
 
     def _traced_defs(self, mod: Module) -> List[ast.AST]:
         jit_wrapped_names: Set[str] = set()
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if (
                 isinstance(node, ast.Call)
                 and node.args
@@ -239,7 +239,7 @@ class GL002JitPurity(Rule):
             ):
                 jit_wrapped_names.add(node.args[0].id)
         traced: Dict[int, ast.AST] = {}
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             decorated = any("jit" in unparse(d) for d in node.decorator_list)
@@ -323,7 +323,7 @@ def code_knobs(
     for mod in modules:
         if not scan_path(mod.relpath).startswith("gubernator_tpu/"):
             continue
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if isinstance(node, ast.Constant) and isinstance(
                 node.value, str
             ):
@@ -415,7 +415,7 @@ class GL004ImportEnv(Rule):
         if not scan_path(mod.relpath).startswith("gubernator_tpu/"):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if any(
                 isinstance(
                     s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -489,7 +489,7 @@ class GL005DtypeDiscipline(Rule):
         if not scan_path(mod.relpath).startswith("gubernator_tpu/ops/"):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.Call):
                 continue
             fn = func_name(stack)
@@ -606,7 +606,7 @@ class GL006Swallow(Rule):
         if not scan_path(mod.relpath).startswith(_SWALLOW_SCOPES):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not _catches_everything(node):
@@ -651,7 +651,7 @@ class GL007SpanLevel(Rule):
         if not scan_path(mod.relpath).startswith(_SPAN_SCOPES):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
@@ -707,7 +707,7 @@ class GL008DebugRouteParity(Rule):
         if not scan_path(mod.relpath).startswith(_DEBUG_ROUTE_SCOPES):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
@@ -802,7 +802,7 @@ class GL009ScrapeDeviceWork(Rule):
         if not scan_path(mod.relpath).startswith(_SCRAPE_SCOPES):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.Attribute):
                 continue
             is_jnp = isinstance(
@@ -851,7 +851,7 @@ class GL010UnaccountedTransfer(Rule):
         if not scan_path(mod.relpath).startswith(_TRANSFER_SCOPES):
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
@@ -933,7 +933,7 @@ class GL011RawTableIndex(Rule):
             # the residency manager IS the paging layer's host half
             return []
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             field = None
             how = None
             if isinstance(node, ast.Subscript):
@@ -1032,7 +1032,7 @@ class GL012DecisionProvenance(Rule):
         if rel == "gubernator_tpu/service/admission.py":
             return []  # the provenance module itself
         out = []
-        for node, stack in walk_scoped(mod.tree):
+        for node, stack in mod.scoped():
             if not self._is_resp_ctor(node):
                 continue
             if self._has_error_kwarg(node):
@@ -1126,7 +1126,7 @@ class GL013EngineCoreDrift(Rule):
             return []
         core = engine_core_methods()
         out = []
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if not isinstance(node, ast.ClassDef):
                 continue
             for item in node.body:
@@ -1231,7 +1231,7 @@ class GL014KernelParity(Rule):
         # backend modules plus from-imports of decide impls. Keyword
         # names (decide=..., the facade FIELD) are not entry points.
         referenced: Dict[str, int] = {}
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if isinstance(node, ast.Attribute) and _DECIDE_NAME_RE.match(
                 node.attr
             ):
@@ -1337,7 +1337,7 @@ class GL015SloCatalogParity(Rule):
         # invisible here by design — the catalog table documents the
         # built-ins.
         declared: Dict[str, int] = {}
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes():
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Name)
@@ -1496,6 +1496,407 @@ class GL016JobLedgerParity(Rule):
                     )
                 )
         return out
+
+
+# ---------------------------------------------------------------------------
+# GL017/GL018: lock discipline. Both rules share one per-module pass
+# that resolves each class's guarded-by declaration (the
+# raceguard.guarded_by(Cls, {...}) call at module bottom), its lock
+# attributes (self.<attr> = lockorder.make_lock("name")), and the
+# local-inheritance merge (DeviceEngine inherits MeshEngine's locks and
+# guards when both ClassDefs live in the same module).
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "pop", "popleft", "popitem", "clear",
+    "update", "extend", "remove", "discard", "insert", "setdefault",
+    "sort", "fill",
+}
+
+# Calls that block (host sync, RPC turnaround, timed wait) and must not
+# run inside a `with <hot lock>` body: every thread needing the lock
+# stalls behind device/network latency — the hazard class the PR 6
+# pipeline split exists to kill.
+_BLOCKING_ATTRS = {"block_until_ready", "device_get", "result"}
+_BLOCKING_NAME_ATTRS = (("time", "sleep"),)
+_BLOCKING_FUNCS = {"urlopen", "device_get"}
+
+_HOT_LOCKS = {
+    "engine.table", "engine.keys", "engine.bulks", "engine.dirty",
+    "engine.pipeline", "engine.shards", "engine.census",
+    "engine.admission", "standby.shadow", "service.admission_ring",
+    "metrics.hotkeys", "timeseries.ring", "timeseries.ringset",
+}
+
+
+def _decorator_names(fn) -> List[Tuple[str, Optional[str]]]:
+    """(name, first-str-arg) per decorator; 'raceguard.holds_lock'
+    normalizes to 'holds_lock'."""
+    out = []
+    for dec in fn.decorator_list:
+        target, arg = dec, None
+        if isinstance(dec, ast.Call):
+            target = dec.func
+            if dec.args and isinstance(dec.args[0], ast.Constant):
+                if isinstance(dec.args[0].value, str):
+                    arg = dec.args[0].value
+        if isinstance(target, ast.Attribute):
+            out.append((target.attr, arg))
+        elif isinstance(target, ast.Name):
+            out.append((target.id, arg))
+    return out
+
+
+class _ClassLockInfo:
+    """Per-ClassDef lock protocol, pre-merge."""
+
+    def __init__(self):
+        self.bases: List[str] = []
+        self.lock_attrs: Dict[str, str] = {}  # self-attr -> lock name
+        self.guarded: Dict[str, str] = {}  # field -> mode spec
+
+
+def _module_lock_info(mod: Module) -> Dict[str, "_ClassLockInfo"]:
+    """Resolve every class's declared lock protocol in one pass, cached
+    on the Module (GL017 and GL018 share it)."""
+    cached = getattr(mod, "_lockinfo", None)
+    if cached is not None:
+        return cached
+    info: Dict[str, _ClassLockInfo] = {}
+    classes: List[ast.ClassDef] = []
+    for node in mod.nodes():
+        if isinstance(node, ast.ClassDef):
+            classes.append(node)
+            ci = info.setdefault(node.name, _ClassLockInfo())
+            ci.bases = [
+                b.id for b in node.bases if isinstance(b, ast.Name)
+            ]
+    for cls in classes:
+        ci = info[cls.name]
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (
+                isinstance(v, ast.Call)
+                and (
+                    (
+                        isinstance(v.func, ast.Attribute)
+                        and v.func.attr in ("make_lock", "make_rlock")
+                    )
+                    or (
+                        isinstance(v.func, ast.Name)
+                        and v.func.id in ("make_lock", "make_rlock")
+                    )
+                )
+                and v.args
+                and isinstance(v.args[0], ast.Constant)
+                and isinstance(v.args[0].value, str)
+            ):
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    ci.lock_attrs[tgt.attr] = v.args[0].value
+    # guarded_by(ClassName, {...}) calls anywhere at module level.
+    for node in mod.nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name != "guarded_by" or len(node.args) < 2:
+            continue
+        cls_arg, map_arg = node.args[0], node.args[1]
+        if not (
+            isinstance(cls_arg, ast.Name) and isinstance(map_arg, ast.Dict)
+        ):
+            continue
+        ci = info.setdefault(cls_arg.id, _ClassLockInfo())
+        for k, v in zip(map_arg.keys, map_arg.values):
+            if (
+                isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)
+            ):
+                ci.guarded[k.value] = v.value
+    # Merge along same-module base chains (subclass methods mutate
+    # inherited fields under inherited locks).
+    merged: Dict[str, _ClassLockInfo] = {}
+
+    def resolve(name: str, seen: Tuple[str, ...] = ()) -> _ClassLockInfo:
+        if name in merged:
+            return merged[name]
+        ci = info.get(name)
+        out = _ClassLockInfo()
+        if ci is None or name in seen:
+            return out
+        for base in ci.bases:
+            b = resolve(base, seen + (name,))
+            out.lock_attrs.update(b.lock_attrs)
+            out.guarded.update(b.guarded)
+        out.bases = ci.bases
+        out.lock_attrs.update(ci.lock_attrs)
+        out.guarded.update(ci.guarded)
+        merged[name] = out
+        return out
+
+    for name in info:
+        resolve(name)
+    mod._lockinfo = merged
+    return merged
+
+
+def _self_field(node: ast.AST) -> Optional[str]:
+    """The `field` of a self.<field> target, digging through
+    subscripts/attribute chains (self._shadow[k] -> _shadow)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class GL017LockDiscipline(Rule):
+    code = "GL017"
+    name = "lock-discipline"
+    requires_reason = True
+    description = (
+        "a field in a class's raceguard.guarded_by declaration may only "
+        "be mutated lexically inside `with self.<lock>` for the declared "
+        "lock, or in a method marked @holds_lock(<lock>) / @init_path "
+        "(or __init__) — the static twin of the GUBER_RACE_SANITIZER "
+        "runtime check"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        lockinfo = _module_lock_info(mod)
+        if not any(ci.guarded for ci in lockinfo.values()):
+            return []
+        out: List[Finding] = []
+        for node in mod.nodes():
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = lockinfo.get(node.name)
+            if ci is None or not ci.guarded:
+                continue
+            # field -> required lock name (None for @thread: unchecked
+            # statically, the runtime affinity pin owns that mode)
+            req: Dict[str, Optional[str]] = {}
+            for field, spec in ci.guarded.items():
+                if spec == "@thread":
+                    continue
+                req[field] = spec.split(":", 1)[1] if ":" in spec else spec
+            if not req:
+                continue
+            for meth in node.body:
+                if not isinstance(
+                    meth, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                decs = _decorator_names(meth)
+                if meth.name == "__init__" or any(
+                    d == "init_path" for d, _ in decs
+                ):
+                    continue
+                held = {
+                    arg for d, arg in decs if d == "holds_lock" and arg
+                }
+                self._scan(mod, node.name, meth, meth.body, held,
+                           ci.lock_attrs, req, out)
+        return out
+
+    def _check_exprs(self, mod, cls_name, meth, roots, held, req, out):
+        """Flag guarded-field mutations in a statement's expression
+        parts: subscript/attr assignment targets are handled by the
+        caller; here we catch mutating METHOD calls (append/update/...)."""
+        for root in roots:
+            for sub in ast.walk(root):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _MUTATOR_METHODS
+                ):
+                    field = _self_field(sub.func.value)
+                    if field in req and req[field] not in held:
+                        self._flag(mod, cls_name, meth, sub, field,
+                                   req[field], out)
+
+    def _scan(self, mod, cls_name, meth, body, held, lock_attrs, req, out):
+        for node in body:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                added = set()
+                for item in node.items:
+                    ce = item.context_expr
+                    if (
+                        isinstance(ce, ast.Attribute)
+                        and isinstance(ce.value, ast.Name)
+                        and ce.value.id == "self"
+                        and ce.attr in lock_attrs
+                    ):
+                        added.add(lock_attrs[ce.attr])
+                self._scan(mod, cls_name, meth, node.body,
+                           held | added, lock_attrs, req, out)
+                continue
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                # Nested defs escape the lexical lock scope (a closure
+                # may run after release); flow-insensitivity can't
+                # decide either way, so they are out of scope here —
+                # the runtime sanitizer still covers them.
+                continue
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            for tgt in targets:
+                field = _self_field(tgt)
+                if field in req and req[field] not in held:
+                    self._flag(mod, cls_name, meth, node, field,
+                               req[field], out)
+            # Expression parts of this statement only — nested
+            # statement bodies recurse below so a `with` inside an
+            # `if` still extends the held set.
+            exprs = [
+                c for c in ast.iter_child_nodes(node)
+                if isinstance(c, ast.expr)
+            ]
+            self._check_exprs(mod, cls_name, meth, exprs, held, req, out)
+            for attr in ("body", "orelse", "finalbody"):
+                sub_body = getattr(node, attr, None)
+                if sub_body and isinstance(sub_body, list):
+                    self._scan(mod, cls_name, meth, sub_body, held,
+                               lock_attrs, req, out)
+            for h in getattr(node, "handlers", ()) or ():
+                self._scan(mod, cls_name, meth, h.body, held,
+                           lock_attrs, req, out)
+
+    def _flag(self, mod, cls_name, meth, node, field, lock, out):
+        out.append(
+            self.finding(
+                mod.relpath,
+                node.lineno,
+                f"{cls_name}.{field} is guarded by '{lock}' but this "
+                f"mutation in {meth.name}() is not inside "
+                f"`with self.<{lock} lock>` or a @holds_lock({lock!r}) "
+                f"method (or add an allow-lock-discipline pragma with a "
+                f"reason)",
+                f"{cls_name}.{meth.name}.{field}",
+            )
+        )
+
+
+class GL018BlockingUnderLock(Rule):
+    code = "GL018"
+    name = "blocking-under-lock"
+    requires_reason = True
+    description = (
+        "no block_until_ready / device_get / .result() / time.sleep / "
+        "urlopen inside a `with` block holding a named hot lock — every "
+        "thread needing that lock then stalls behind device or network "
+        "latency (the hazard the PR 6 pipeline split removed)"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        lockinfo = _module_lock_info(mod)
+        if not any(ci.lock_attrs for ci in lockinfo.values()):
+            return []
+        out: List[Finding] = []
+        for node in mod.nodes():
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = lockinfo.get(node.name)
+            if ci is None or not ci.lock_attrs:
+                continue
+            hot_attrs = {
+                attr: lock
+                for attr, lock in ci.lock_attrs.items()
+                if lock in _HOT_LOCKS
+            }
+            if not hot_attrs:
+                continue
+            for meth in node.body:
+                if isinstance(
+                    meth, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self._scan(mod, node.name, meth, meth.body,
+                               hot_attrs, None, out)
+        return out
+
+    def _blocking_call(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_ATTRS:
+                return f.attr
+            for base, attr in _BLOCKING_NAME_ATTRS:
+                if _is_name_attr(f, base, attr):
+                    return f"{base}.{attr}"
+        elif isinstance(f, ast.Name) and f.id in _BLOCKING_FUNCS:
+            return f.id
+        return None
+
+    def _scan(self, mod, cls_name, meth, body, hot_attrs, lock, out):
+        for node in body:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner_lock = lock
+                for item in node.items:
+                    ce = item.context_expr
+                    if (
+                        isinstance(ce, ast.Attribute)
+                        and isinstance(ce.value, ast.Name)
+                        and ce.value.id == "self"
+                        and ce.attr in hot_attrs
+                    ):
+                        inner_lock = hot_attrs[ce.attr]
+                self._scan(mod, cls_name, meth, node.body, hot_attrs,
+                           inner_lock, out)
+                continue
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # closures run outside the lexical lock scope
+            if lock is not None:
+                # Whole-subtree walk: everything nested in this
+                # statement executes with the lock held.
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        what = self._blocking_call(sub)
+                        if what is not None:
+                            out.append(
+                                self.finding(
+                                    mod.relpath,
+                                    sub.lineno,
+                                    f"blocking call {what}() inside a "
+                                    f"`with` holding hot lock '{lock}' "
+                                    f"in {cls_name}.{meth.name}() — "
+                                    f"move it outside the critical "
+                                    f"section (or add an "
+                                    f"allow-blocking-under-lock pragma "
+                                    f"with a reason)",
+                                    f"{cls_name}.{meth.name}.{what}",
+                                )
+                            )
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub_body = getattr(node, attr, None)
+                if sub_body and isinstance(sub_body, list):
+                    self._scan(mod, cls_name, meth, sub_body, hot_attrs,
+                               lock, out)
+            for h in getattr(node, "handlers", ()) or ():
+                self._scan(mod, cls_name, meth, h.body, hot_attrs,
+                           lock, out)
 
 
 # ---------------------------------------------------------------------------
