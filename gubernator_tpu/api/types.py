@@ -148,6 +148,12 @@ ERR_ENGINE_DRAINING = RETRYABLE_PREFIX + " engine draining; retry"
 # A peer's forward batch queue is full (overload shed, never blocked).
 ERR_PEER_OVERLOADED = RETRYABLE_PREFIX + " peer forward queue full; retry"
 
+# The engine intake governor shed this request before it was enqueued
+# (intake budget exceeded, CoDel standing-queue shed, or brownout) —
+# the request was NOT applied; responses carry retry_after_ms metadata
+# with the server-suggested backoff (service/overload.py).
+ERR_OVERLOADED = RETRYABLE_PREFIX + " intake overloaded; retry"
+
 
 def is_retryable_error(error: str) -> bool:
     """True when a RateLimitResp.error marks a request that was NOT

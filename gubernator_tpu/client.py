@@ -26,6 +26,7 @@ from gubernator_tpu.api.types import (
     PeerInfo,
     RateLimitReq,
     RateLimitResp,
+    is_retryable_error,
 )
 from gubernator_tpu.service import pb
 from gubernator_tpu.service.rpc import V1Stub
@@ -65,7 +66,16 @@ class GubernatorClient:
     a local slice with zero RPCs, and the cache reconciles with the
     server at renew cadence through the V1/Lease RPC. The server must
     run with GUBER_LEASES=true — against an older or lease-less server
-    every check simply falls through to the normal RPC path."""
+    every check simply falls through to the normal RPC path.
+
+    Retries are BUDGETED (docs/robustness.md "Overload control &
+    brownout"): up to `retries` re-dispatches for transport UNAVAILABLE
+    and for per-item typed retryable errors (the server's overload /
+    draining sheds), each spending one token from a RetryBudget that
+    refills at `retry_budget` per first attempt — so a retry storm can
+    amplify offered load by at most 1 + retry_budget. Server-suggested
+    `retry_after_ms` response metadata paces the backoff. `retries=0`
+    restores the single-shot pre-budget behavior exactly."""
 
     def __init__(
         self,
@@ -75,9 +85,17 @@ class GubernatorClient:
         leases: bool = False,
         lease_low_water: float = 0.25,
         lease_max_keys: int = 1024,
+        retries: int = 3,
+        retry_budget: float = 0.1,
     ):
         self.address = address
         self.default_timeout = default_timeout
+        self.retries = max(0, int(retries))
+        self.retry_budget = None
+        if self.retries > 0:
+            from gubernator_tpu.service.overload import RetryBudget
+
+            self.retry_budget = RetryBudget(ratio=retry_budget)
         if tls is not None:
             from gubernator_tpu.service.tls import (
                 client_channel_options,
@@ -144,22 +162,73 @@ class GubernatorClient:
                 t.add_done_callback(self._lease_tasks.discard)
             if len(local) == len(reqs):
                 return [local[i] for i in range(len(reqs))]
-        msg = pb.pb.GetRateLimitsReq()
         fwd_idx = []
         for i, r in enumerate(reqs):
             if i in local:
                 continue
             tracing.propagate_inject(r.metadata)
-            msg.requests.append(pb.req_to_pb(r))
             fwd_idx.append(i)
-        resp = await self.stub.get_rate_limits(
-            msg, timeout=timeout or self.default_timeout
-        )
         out: List[Optional[RateLimitResp]] = [
             local.get(i) for i in range(len(reqs))
         ]
-        for i, m in zip(fwd_idx, resp.responses):
-            out[i] = pb.resp_from_pb(m)
+
+        def build(idxs):
+            m = pb.pb.GetRateLimitsReq()
+            for i in idxs:
+                m.requests.append(pb.req_to_pb(reqs[i]))
+            return m
+
+        budget = self.retry_budget
+        if budget is not None and fwd_idx:
+            budget.record(len(fwd_idx))
+        pending = fwd_idx
+        attempt = 0
+        while pending:
+            try:
+                resp = await self.stub.get_rate_limits(
+                    build(pending), timeout=timeout or self.default_timeout
+                )
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if (
+                    attempt >= self.retries
+                    or code != grpc.StatusCode.UNAVAILABLE
+                    or budget is None
+                    or not budget.try_spend()
+                ):
+                    raise
+                attempt += 1
+                await asyncio.sleep(min(0.025 * (2 ** attempt), 1.0))
+                continue
+            for i, m in zip(pending, resp.responses):
+                out[i] = pb.resp_from_pb(m)
+            # Per-item typed retryable sheds (UNAVAILABLE: prefix — the
+            # request was NOT applied, re-dispatch is safe). Paced by
+            # the server's retry_after_ms suggestion when present.
+            retry_idx = [
+                i
+                for i in pending
+                if out[i] is not None and is_retryable_error(out[i].error)
+            ]
+            if (
+                not retry_idx
+                or attempt >= self.retries
+                or budget is None
+                or not budget.try_spend()
+            ):
+                break
+            attempt += 1
+            delay = 0.025 * (2 ** attempt)
+            for i in retry_idx:
+                md = out[i].metadata or {}
+                try:
+                    delay = max(
+                        delay, int(md.get("retry_after_ms", 0)) / 1000.0
+                    )
+                except (TypeError, ValueError):
+                    pass
+            await asyncio.sleep(min(delay, 5.0))
+            pending = retry_idx
         return [
             r if r is not None else RateLimitResp(error="missing response")
             for r in out

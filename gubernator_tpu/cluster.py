@@ -34,7 +34,11 @@ class Cluster:
         datacenters: Optional[Sequence[str]] = None,
         behaviors: Optional[BehaviorConfig] = None,
         cache_size: int = 8192,
+        **daemon_conf,
     ) -> "Cluster":
+        """Extra keyword args pass through to every DaemonConfig —
+        e.g. ``overload=True, intake_limit=64`` arms the overload
+        control plane mesh-wide (tools/jobs/45_overload_soak.py)."""
         c = cls()
         dcs = list(datacenters) if datacenters else [DATACENTER_NONE] * count
         for dc in dcs:
@@ -42,6 +46,7 @@ class Cluster:
                 data_center=dc,
                 cache_size=cache_size,
                 behaviors=behaviors or BehaviorConfig(),
+                **daemon_conf,
             )
             c.daemons.append(await Daemon.spawn(conf))
         c.rewire()

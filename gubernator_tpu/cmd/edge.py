@@ -12,6 +12,9 @@ Env:
     GUBER_EDGE_UPSTREAM       device daemon's GUBER_EDGE_LISTEN_ADDRESS
                               (unix:///path or host:port; required)
     GUBER_EDGE_CONNECTIONS    upstream connections (default 2)
+    GUBER_EDGE_RETRIES        budgeted upstream retries per call
+                              (default 2; 0 = pure single-shot relay)
+    GUBER_RETRY_BUDGET        retry-budget refill ratio (default 0.1)
     GUBER_LEASES              serve leased keys locally (zero upstream
                               frames on the hot path); the daemon must
                               also run with GUBER_LEASES=true
@@ -90,6 +93,14 @@ def main() -> None:
                 os.environ.get("GUBER_EDGE_TIMEOUT", ""), 30.0
             ),
             timeout_counter=metrics.edge_call_timeouts,
+            # knob: GUBER_EDGE_RETRIES — budgeted UNAVAILABLE retries +
+            # one shed re-dispatch per call; 0 restores the pure relay.
+            retries=int(os.environ.get("GUBER_EDGE_RETRIES", "") or 2),
+            # knob: GUBER_RETRY_BUDGET (same ratio the daemon and the
+            # client SDK use — docs/robustness.md retry-budget math)
+            retry_budget=float(
+                os.environ.get("GUBER_RETRY_BUDGET", "") or 0.1
+            ),
         )
         leases = None
         # knob: GUBER_LEASES (same switch as the daemon's — an edge only

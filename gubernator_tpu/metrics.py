@@ -805,9 +805,12 @@ class Metrics:
         )
         self.forward_queue_full = counter(
             "gubernator_forward_queue_full",
-            "Forwarded checks shed with the typed overload error because "
-            "the target peer's batch queue was full (producers never "
-            "block on a full queue).",
+            "Forwarded checks shed before leaving this node, by reason: "
+            "'queue_full' — the target peer's batch queue was full "
+            "(producers never block on a full queue); 'brownout' — the "
+            "overload ladder reached degraded-local and answered "
+            "locally instead of forwarding.",
+            ["reason"],
         )
 
         # Zero-loss elasticity (docs/robustness.md "Rolling restarts &
@@ -1425,6 +1428,32 @@ class Metrics:
             "frames); 1.0 = balanced, absent on single-device "
             "topologies.",
             registry=r,
+        )
+
+        # Overload control plane (service/overload.py; GUBER_OVERLOAD —
+        # docs/robustness.md "Overload control & brownout").
+        self.overload_level = Gauge(
+            "gubernator_overload_level",
+            "Brownout ladder level: 0 normal, 1 shed observability "
+            "extras, 2 answer would-be peer forwards locally "
+            "(degraded-local), 3 shed heavy-hitter tenants outright.",
+            registry=r,
+        )
+        self.overload_transitions = counter(
+            "gubernator_overload_transitions",
+            "Brownout ladder transitions, labeled with the level "
+            "ENTERED (escalations and recoveries both count).",
+            ["level"],
+        )
+        self.intake_shed_counter = counter(
+            "gubernator_intake_shed_counter",
+            "Requests refused by the intake governor before any device "
+            "work, by reason: queue_full (depth >= GUBER_INTAKE_LIMIT), "
+            "deadline_expired (caller deadline passed at admit or "
+            "pickup), codel (standing queue above GUBER_INTAKE_TARGET_MS), "
+            "tenant (same controller, dominant-tenant multiplier), "
+            "brownout (ladder level 3 heavy-tenant shed).",
+            ["reason"],
         )
 
         self._syncs = []

@@ -124,7 +124,11 @@ class Peer:
 
     def _ensure_pump(self) -> asyncio.Queue:
         if self._queue is None:
-            self._queue = asyncio.Queue(maxsize=1000)
+            self._queue = asyncio.Queue(
+                maxsize=max(
+                    1, int(getattr(self.behaviors, "peer_queue", 1000))
+                )
+            )
             self._pump_task = asyncio.ensure_future(self._run_batch())
         return self._queue
 
@@ -160,7 +164,7 @@ class Peer:
             if self.metrics is not None and hasattr(
                 self.metrics, "forward_queue_full"
             ):
-                self.metrics.forward_queue_full.inc()
+                self.metrics.forward_queue_full.labels("queue_full").inc()
             raise PeerOverloadedError(self.info.grpc_address, q.qsize())
         # Upper bound so a request can never hang if the pump dies between
         # the _closed check and the put (shutdown race); a tighter caller
@@ -474,6 +478,16 @@ class PeerMesh:
         # event loop under an error storm (O(n^2) over a 5-minute TTL) —
         # found by soak: goodput collapsed to zero and never recovered.
         self._errors: "collections.deque" = collections.deque(maxlen=100)
+        # Budgeted forward retries (service/overload.py RetryBudget,
+        # knob GUBER_RETRY_BUDGET): each transport-level retry leg in
+        # forward() spends a token deposited by first attempts, so a
+        # mesh-wide brownout cannot amplify offered load by more than
+        # 1 + retry_budget per hop.
+        from gubernator_tpu.service.overload import RetryBudget
+
+        self.retry_budget = RetryBudget(
+            ratio=float(getattr(behaviors, "retry_budget", 0.1))
+        )
 
     # -- PeerPicker interface ------------------------------------------------
 
@@ -787,6 +801,19 @@ class PeerMesh:
                 _clock.now_ms() + int(budget_s * 1000)
             )
         attempts = 0
+        self.retry_budget.record(1.0)  # first attempt refills the bucket
+        # Brownout alignment (service/overload.py): at ladder level >= 2
+        # this node stops queueing new work onto a mesh that is already
+        # missing its SLOs and answers from local state instead — the
+        # same degraded-replica contract as GUBER_OWNER_UNREACHABLE=local,
+        # different trigger.
+        ovm = getattr(self.svc, "overload", None)
+        if (
+            ovm is not None
+            and not peer.info.is_owner
+            and ovm.degrade_forwards()
+        ):
+            return await self._brownout_local(peer, req)
         while True:
             if peer.info.is_owner:
                 # Ownership migrated to us mid-flight: serve locally.
@@ -829,7 +856,9 @@ class PeerMesh:
                 raise
             except Exception as e:
                 self.record_error(f"{peer.info.grpc_address}: {e}")
-                if attempts >= 5:
+                # Retry legs are budgeted: when the bucket is dry the
+                # whole mesh is failing and another leg only adds load.
+                if attempts >= 5 or not self.retry_budget.try_spend():
                     self.svc.metrics.check_error_counter.labels(
                         "Error in get_peer_rate_limit"
                     ).inc()
@@ -843,6 +872,35 @@ class PeerMesh:
                     + max(0, int((deadline - loop.time()) * 1000))
                 )
                 peer = self.get(key)
+
+    async def _brownout_local(self, peer: Peer, req: RateLimitReq) -> RateLimitResp:
+        """Overload ladder level >= 2: answer a would-be forward from
+        local engine state. The owner may be perfectly healthy — the
+        LOCAL node is browning out — so the hit still rides the
+        reconciliation queue when one exists, and the answer carries
+        the degraded marker + provenance like every degraded-local
+        path."""
+        m = self.svc.metrics
+        if hasattr(m, "forward_queue_full"):
+            m.forward_queue_full.labels("brownout").inc()
+        resp = await asyncio.wrap_future(self.svc.engine.check_async(req))
+        resp.metadata = dict(resp.metadata or {})
+        resp.metadata["owner"] = peer.info.grpc_address
+        resp.metadata["degraded"] = "brownout"
+        self.svc.metrics.degraded_local_answers.inc()
+        cfg = getattr(self.svc.engine, "cfg", None)
+        if bool(getattr(cfg, "stage_metadata", False)):
+            _admission.stamp_decision(resp, _admission.PATH_DEGRADED_LOCAL)
+        recorder = getattr(self.svc, "recorder", None)
+        if recorder is not None:
+            recorder.record_decision(
+                _admission.PATH_DEGRADED_LOCAL, resp, key=req.hash_key()
+            )
+        if self.svc.global_mgr is not None and req.hits:
+            self.svc.global_mgr.queue_hit(
+                dataclasses.replace(req, metadata=dict(req.metadata))
+            )
+        return resp
 
     async def _owner_unreachable(self, peer: Peer, req: RateLimitReq) -> RateLimitResp:
         """The owner's circuit is open. mode=local answers from local

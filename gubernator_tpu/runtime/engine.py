@@ -306,15 +306,18 @@ class _Slot:
 
     `span` (the caller's request span, captured once per bulk) and
     `t_enq` (enqueue stamp for GUBER_STAGE_METADATA) are observability
-    side-channels — both stay None on the knob-off path."""
+    side-channels — both stay None on the knob-off path. `deadline_ms`
+    (absolute epoch ms, GUBER_OVERLOAD only) lets the pump drop the
+    member at pickup when the caller already gave up."""
 
-    __slots__ = ("value", "_done", "span", "t_enq")
+    __slots__ = ("value", "_done", "span", "t_enq", "deadline_ms")
 
     def __init__(self):
         self.value = None
         self._done = False
         self.span = None
         self.t_enq = None
+        self.deadline_ms = None
 
     def set_result(self, v) -> None:
         self.value = v
@@ -430,8 +433,13 @@ class EngineBase:
 
     @raceguard.init_path
     def _init_base(self, thread_name: str) -> None:
+        # guberlint: allow-unbounded-queue -- bounded at intake by the overload governor (GUBER_INTAKE_LIMIT sheds past-budget puts in check_async/check_bulk); knob-off keeps the historical unbounded bit-exact contract
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
+        # Intake admission governor (service/overload.py IntakeGovernor,
+        # duck-typed like the watchdog seam): the daemon injects it when
+        # GUBER_OVERLOAD is on; None means admit everything (bit-exact).
+        self.overload = None
         self._draining = False
         # Flush-ticket sequence (pump-thread only; the drain pass runs
         # on the same thread): the /debug/engine <-> trace join key.
@@ -490,6 +498,7 @@ class EngineBase:
             # launches more device work); the SimpleQueue carries tickets
             # to the completion thread in FIFO dispatch order.
             self._pipe_sem = threading.Semaphore(depth)
+            # guberlint: allow-unbounded-queue -- bounded by construction: the pipeline semaphore's `depth` permits cap how many tickets can be in the queue at once
             self._pipe_q = queue.SimpleQueue()
             self._pipe_lock = lockorder.make_lock("engine.pipeline")
             self._inflight = 0
@@ -764,6 +773,14 @@ class EngineBase:
         if err is not None:
             fut.set_result(RateLimitResp(error=err))
             return fut
+        ov = self.overload
+        if ov is not None:
+            shed, dl = ov.admit(req, self._queue.qsize())
+            if shed is not None:
+                fut.set_result(shed)
+                return fut
+            if dl is not None:
+                fut.deadline_ms = dl
         if req.created_at is None:
             req.created_at = self.now_fn()
         # Request-span capture for the batch-boundary link (None unless
@@ -792,6 +809,8 @@ class EngineBase:
         slots: List[_Slot] = []
         work = []
         now = None
+        ov = self.overload
+        depth = self._queue.qsize() if ov is not None else 0
         # One request-span capture per BULK (members share the caller's
         # context): the flush that serves them links back to this span.
         rs = tracing.current_span()
@@ -803,6 +822,13 @@ class EngineBase:
             if err is not None:
                 slot.set_result(RateLimitResp(error=err))
                 continue
+            if ov is not None:
+                shed, dl = ov.admit(req, depth)
+                if shed is not None:
+                    slot.set_result(shed)
+                    continue
+                if dl is not None:
+                    slot.deadline_ms = dl
             if req.created_at is None:
                 if now is None:
                     now = self.now_fn()
@@ -1132,16 +1158,46 @@ class EngineBase:
                 """Add a queue entry (single triple or bulk); True if it
                 asks for an immediate flush. Queue wait (enqueue ->
                 pump pickup) feeds the queue_wait histogram: sustained
-                growth means the pump is falling behind intake."""
+                growth means the pump is falling behind intake. With the
+                overload governor injected, the same wait drives its
+                CoDel controller, and members whose caller deadline
+                already expired are refused HERE — before any device
+                work — instead of being flushed."""
                 qw = self.metrics.queue_wait
+                ov = self.overload
                 if type(entry) is _Bulk:
-                    qw.observe(time.perf_counter() - entry.t_enq)
-                    batch.extend(entry.work)
+                    w = time.perf_counter() - entry.t_enq
+                    qw.observe(w)
+                    live = entry.work
+                    if ov is not None:
+                        ov.observe_wait(w)
+                        live = []
+                        for req, slot in entry.work:
+                            dl = slot.deadline_ms
+                            if dl is not None and ov.deadline_expired(dl):
+                                slot.set_result(ov.refuse_expired(req))
+                            else:
+                                live.append((req, slot))
+                        entry.work = live
+                    batch.extend(live)
                     with self._bulks_lock:
                         self._bulks.append(entry)
-                    return any(r.behavior & NB for r, _ in entry.work)
+                    if not live:
+                        # Every member expired at pickup: the slots are
+                        # all resolved, so the bulk future must resolve
+                        # now — no flush will ever sweep it.
+                        self._sweep_bulks()
+                        return False
+                    return any(r.behavior & NB for r, _ in live)
                 req, fut, t_enq = entry
-                qw.observe(time.perf_counter() - t_enq)
+                w = time.perf_counter() - t_enq
+                qw.observe(w)
+                if ov is not None:
+                    ov.observe_wait(w)
+                    dl = getattr(fut, "deadline_ms", None)
+                    if dl is not None and ov.deadline_expired(dl):
+                        fut.set_result(ov.refuse_expired(req))
+                        return False
                 batch.append((req, fut))
                 return bool(req.behavior & NB)
 

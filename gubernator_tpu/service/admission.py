@@ -43,6 +43,7 @@ PATH_DEGRADED_LOCAL = "degraded_local"  # owner circuit open; local answer
 PATH_LEASE = "lease"  # holder-side zero-RPC debit from a leased slice
 PATH_FASTPATH = "fastpath"  # columnar edge fastpath (owner-local decide)
 PATH_FORWARDED = "forwarded"  # answered by the owner over peer forwarding
+PATH_SHED = "shed"  # overload governor refused it (never reached a table)
 
 PATHS = (
     PATH_OWNER,
@@ -51,6 +52,7 @@ PATHS = (
     PATH_LEASE,
     PATH_FASTPATH,
     PATH_FORWARDED,
+    PATH_SHED,
 )
 
 # Response-metadata keys (GUBER_STAGE_METADATA surface, service/pb.py

@@ -43,6 +43,20 @@ class BehaviorConfig:
     # time.
     forward_deadline_s: float = 2.0
 
+    # GUBER_PEER_QUEUE: bound on each peer's forward batch queue (was a
+    # hardcoded 1000). A full queue sheds with the typed retryable
+    # overload error instead of blocking producers; size it to
+    # batch_limit x the number of batches you are willing to buffer
+    # toward one slow peer.
+    peer_queue: int = 1000
+
+    # GUBER_RETRY_BUDGET: token-bucket retry budget for the client and
+    # edge relays (service/overload.py RetryBudget) — each first attempt
+    # deposits this fraction of a token, each retry spends one, so
+    # retries can never multiply offered load by more than 1 + budget.
+    # 0 disables retries entirely under sustained failure.
+    retry_budget: float = 0.1
+
     # Per-peer circuit breaker (utils/breaker.py): trip after this many
     # consecutive transport failures, hold open for an exponential
     # backoff (base doubling per consecutive trip, capped, ±10% jitter),
@@ -388,6 +402,23 @@ class DaemonConfig:
     slo_sample_interval_s: float = 5.0
     slo_specs: str = ""
     watchdog_stall_ms: float = 5000.0
+
+    # -- overload control plane (docs/robustness.md "Overload control &
+    # brownout"; service/overload.py) ------------------------------------
+
+    # GUBER_OVERLOAD: master switch. Off (default) keeps intake,
+    # forwarding, and every response bit-exact with the pre-overload
+    # daemon — no governor is injected, the intake queue stays
+    # effectively unbounded.
+    overload: bool = False
+    # GUBER_INTAKE_LIMIT: engine intake queue budget; past it, intake
+    # resolves the typed retryable ERR_OVERLOADED (with retry_after_ms)
+    # instead of queueing toward a timeout.
+    intake_limit: int = 8192
+    # GUBER_INTAKE_TARGET_MS: CoDel target for the intake queue-wait
+    # signal — when the per-interval MINIMUM wait sustains above this,
+    # the governor sheds probabilistically with per-tenant weighting.
+    intake_target_ms: float = 20.0
 
     # Continuous profiling (docs/monitoring.md "Device resources"):
     # GUBER_PROFILE_INTERVAL > 0 starts a background sampler that takes

@@ -347,6 +347,37 @@ class Daemon:
             self._watchdog.start()
             self._slo.start()
 
+        # Overload control plane (docs/robustness.md "Overload control
+        # & brownout"): the intake governor is injected into the engine
+        # (deadline-aware bounded intake + CoDel tenant-fair shedding)
+        # and the brownout ladder folds the SLO burn rates + watchdog
+        # stall flags into a published degradation level. Off (default)
+        # wires nothing — intake and forwarding stay bit-exact.
+        self._overload = None
+        if conf.overload:
+            from gubernator_tpu.service.overload import (
+                IntakeGovernor,
+                OverloadManager,
+            )
+
+            governor = IntakeGovernor(
+                limit=conf.intake_limit,
+                target_ms=conf.intake_target_ms,
+                metrics=self.svc.metrics,
+                recorder=self.svc.recorder,
+            )
+            self._overload = OverloadManager(
+                self.svc,
+                governor,
+                slo=self._slo,
+                watchdog=self._watchdog,
+            )
+            self.svc.overload = self._overload
+            # Injected attribute, checked per-call by intake and
+            # per-pickup by the pump (same seam model as the watchdog).
+            self.engine.overload = governor
+            self._overload.start()
+
         # Discovery pool pushes membership through set_peers
         # (reference daemon.go:208-243). Unknown/unavailable backends fail
         # fast rather than silently serving as a cluster of one.
@@ -469,8 +500,13 @@ class Daemon:
             await self._auditor.close()
         if getattr(self, "_profiler", None) is not None:
             self._profiler.stop()
-        # SLO sampler + watchdog before the loops they observe: a loop
-        # stopping during drain must not be flagged as a stall.
+        # Ladder before the SLO sampler it reads, then sampler +
+        # watchdog before the loops they observe: a loop stopping
+        # during drain must not be flagged as a stall. The engine keeps
+        # its governor through drain — queued entries whose deadline
+        # lapses mid-drain are still dropped at pickup.
+        if getattr(self, "_overload", None) is not None:
+            self._overload.stop()
         if getattr(self, "_slo", None) is not None:
             self._slo.stop()
         if getattr(self, "_watchdog", None) is not None:

@@ -215,7 +215,15 @@ class EdgeClient:
     BehaviorConfig.edge_timeout_s / GUBER_EDGE_TIMEOUT by the edge
     entry point; it was a hard-coded 30.0). `timeout_counter` is any
     .inc()-able — timed-out calls bump it so edge-tier stalls are
-    observable at the edge's /metrics."""
+    observable at the edge's /metrics.
+
+    With `retries` > 0 (knob GUBER_EDGE_RETRIES at the edge entry
+    point) UNAVAILABLE transport legs are re-sent under a token-bucket
+    RetryBudget (service/overload.py, knob GUBER_RETRY_BUDGET): each
+    first attempt deposits `retry_budget` tokens and each retry spends
+    one, so an edge fleet's retry storm can amplify daemon load by at
+    most 1 + retry_budget. `retries=0` (the constructor default) is
+    the historical single-shot relay, bit-exact."""
 
     def __init__(
         self,
@@ -223,10 +231,18 @@ class EdgeClient:
         connections: int = 2,
         timeout_s: float = 30.0,
         timeout_counter=None,
+        retries: int = 0,
+        retry_budget: float = 0.1,
     ):
         self.address = address
         self.timeout_s = timeout_s
         self.timeout_counter = timeout_counter
+        self.retries = max(0, int(retries))
+        self.retry_budget = None
+        if self.retries > 0:
+            from gubernator_tpu.service.overload import RetryBudget
+
+            self.retry_budget = RetryBudget(ratio=retry_budget)
         self._n = max(1, connections)
         self._conns: list = [None] * self._n
         self._locks = [asyncio.Lock() for _ in range(self._n)]
@@ -268,6 +284,31 @@ class EdgeClient:
                     )
 
     async def call(
+        self, method: int, payload: bytes, timeout: Optional[float] = None
+    ) -> bytes:
+        """One framed call, with budgeted UNAVAILABLE retries. Only
+        transport-level UNAVAILABLE legs (daemon unreachable, pipe lost)
+        re-send; DEADLINE_EXCEEDED and typed daemon errors propagate
+        immediately — the daemon may already have applied the work."""
+        budget = self.retry_budget
+        if budget is not None:
+            budget.record(1.0)
+        attempt = 0
+        while True:
+            try:
+                return await self._call_once(method, payload, timeout)
+            except EdgeError as e:
+                if (
+                    e.code != "UNAVAILABLE"
+                    or attempt >= self.retries
+                    or budget is None
+                    or not budget.try_spend()
+                ):
+                    raise
+                attempt += 1
+                await asyncio.sleep(min(0.025 * (2 ** attempt), 1.0))
+
+    async def _call_once(
         self, method: int, payload: bytes, timeout: Optional[float] = None
     ) -> bytes:
         from gubernator_tpu.utils import faults
@@ -340,7 +381,10 @@ class EdgeLeases:
     background Lease RPC (renew at the low-water mark, returns for
     retired slices, grants for newly-wanted keys) — the cache's
     `inflight` flag is the only serialization needed because the edge
-    process is single-loop."""
+    process is single-loop. Maintenance frames ride EdgeClient.call,
+    so when the edge runs with retries they share its RetryBudget —
+    a flapping daemon pipe cannot turn lease upkeep into a retry
+    storm."""
 
     def __init__(self, client: EdgeClient, cache, holder: str = "edge",
                  local_counter=None, recorder=None):
@@ -417,15 +461,64 @@ class EdgeLeases:
             pass
 
 
+async def _redispatch_sheds(
+    client: EdgeClient, req_msg, raw_resp: bytes
+) -> bytes:
+    """One budgeted re-dispatch of per-item typed retryable errors (the
+    daemon's overload governor refused those items without applying
+    them — api.types.is_retryable_error), paced by the server's
+    retry_after_ms response metadata. Active only when the EdgeClient
+    has a RetryBudget (GUBER_EDGE_RETRIES > 0); the gate below is a
+    bytes scan, so a shed-free response costs no protobuf parse."""
+    from gubernator_tpu.api.types import RETRYABLE_PREFIX, is_retryable_error
+    from gubernator_tpu.service import pb
+
+    budget = client.retry_budget
+    if budget is None or RETRYABLE_PREFIX.encode() not in raw_resp:
+        return raw_resp
+    try:
+        resp = pb.pb.GetRateLimitsResp.FromString(raw_resp)
+    except Exception:  # guberlint: allow-swallow -- a response we cannot parse relays verbatim; the client sees exactly what the daemon sent
+        return raw_resp
+    retry = [
+        (i, m)
+        for i, m in enumerate(resp.responses)
+        if i < len(req_msg.requests) and is_retryable_error(m.error)
+    ]
+    if not retry or not budget.try_spend():
+        return raw_resp
+    delay = 0.05
+    for _, m in retry:
+        try:
+            delay = max(delay, int(m.metadata.get("retry_after_ms", 0)) / 1000.0)
+        except (TypeError, ValueError):
+            pass
+    await asyncio.sleep(min(delay, 5.0))
+    sub = pb.pb.GetRateLimitsReq()
+    for i, _ in retry:
+        sub.requests.append(req_msg.requests[i])
+    try:
+        sub_resp = pb.pb.GetRateLimitsResp.FromString(
+            await client.call(METHOD_GET_RATE_LIMITS, sub.SerializeToString())
+        )
+    except (EdgeError, ValueError):
+        return raw_resp  # keep the original typed sheds; they are retryable
+    for (i, _), m in zip(retry, sub_resp.responses):
+        resp.responses[i].CopyFrom(m)
+    return resp.SerializeToString()
+
+
 async def serve_edge_get_rate_limits(
     client: EdgeClient, raw: bytes, leases: Optional[EdgeLeases] = None
 ) -> bytes:
     """GetRateLimits over the framed upstream, optionally through the
     edge lease cache: leased items are answered locally (zero frames to
     the daemon), only the misses are forwarded, and the responses are
-    spliced back in request order. With `leases` None this is exactly
-    the old one-line byte relay."""
-    if leases is None:
+    spliced back in request order. With `leases` None and no retry
+    budget this is exactly the old one-line byte relay; with a budget
+    (GUBER_EDGE_RETRIES) per-item overload sheds get one budgeted,
+    retry_after_ms-paced re-dispatch before reaching the client."""
+    if leases is None and client.retry_budget is None:
         return await client.call(METHOD_GET_RATE_LIMITS, raw)
     from gubernator_tpu.service import pb
 
@@ -433,6 +526,10 @@ async def serve_edge_get_rate_limits(
         msg = pb.pb.GetRateLimitsReq.FromString(raw)
     except Exception:  # guberlint: allow-swallow -- unparseable payload relays verbatim so the daemon produces the same error a lease-less edge would
         return await client.call(METHOD_GET_RATE_LIMITS, raw)
+    if leases is None:
+        return await _redispatch_sheds(
+            client, msg, await client.call(METHOD_GET_RATE_LIMITS, raw)
+        )
     local = {}
     miss: list = []
     for i, m in enumerate(msg.requests):
@@ -443,7 +540,9 @@ async def serve_edge_get_rate_limits(
             miss.append(i)
     leases.kick()
     if not local:
-        return await client.call(METHOD_GET_RATE_LIMITS, raw)
+        return await _redispatch_sheds(
+            client, msg, await client.call(METHOD_GET_RATE_LIMITS, raw)
+        )
     fwd_resps = []
     if miss:
         sub = pb.pb.GetRateLimitsReq()
@@ -470,7 +569,7 @@ async def serve_edge_get_rate_limits(
                 )
             else:
                 out.responses.append(nxt)
-    return out.SerializeToString()
+    return await _redispatch_sheds(client, msg, out.SerializeToString())
 
 
 class EdgeV1Servicer:

@@ -131,6 +131,8 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         circuit_open_max_s=parse_duration_s(_env("GUBER_CIRCUIT_OPEN_MAX"), 30.0),
         circuit_half_open_probes=_env_int("GUBER_CIRCUIT_HALF_OPEN_PROBES", 1),
         owner_unreachable=_env("GUBER_OWNER_UNREACHABLE", "error").lower(),
+        peer_queue=_env_int("GUBER_PEER_QUEUE", 1000),
+        retry_budget=_env_float("GUBER_RETRY_BUDGET", 0.1),
         global_requeue_limit=_env_int("GUBER_GLOBAL_REQUEUE_LIMIT", 10),
         global_requeue_max_keys=_env_int("GUBER_GLOBAL_REQUEUE_MAX_KEYS", 10_000),
         edge_timeout_s=parse_duration_s(_env("GUBER_EDGE_TIMEOUT"), 30.0),
@@ -203,6 +205,17 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             f"'GUBER_OWNER_UNREACHABLE={behaviors.owner_unreachable}' is "
             "invalid; choices are [error, local]"
         )
+    if behaviors.peer_queue < 1:
+        raise ValueError(
+            f"'GUBER_PEER_QUEUE={behaviors.peer_queue}' is invalid; the "
+            "peer forward queue must hold at least 1 entry"
+        )
+    if not (0.0 <= behaviors.retry_budget <= 1.0):
+        raise ValueError(
+            f"'GUBER_RETRY_BUDGET={behaviors.retry_budget}' is invalid; "
+            "expected a fraction in [0, 1] (0 disables retries under "
+            "sustained failure)"
+        )
 
     conf = DaemonConfig(
         instance_id=_env("GUBER_INSTANCE_ID", ""),
@@ -271,6 +284,12 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         ),
         slo_specs=_env("GUBER_SLO_SPECS"),
         watchdog_stall_ms=_env_float("GUBER_WATCHDOG_STALL_MS", 5000.0),
+        # Overload control plane (docs/robustness.md "Overload control
+        # & brownout"): master switch (off = bit-exact), intake queue
+        # budget, CoDel queue-wait target.
+        overload=_env_bool("GUBER_OVERLOAD"),
+        intake_limit=_env_int("GUBER_INTAKE_LIMIT", 8192),
+        intake_target_ms=_env_float("GUBER_INTAKE_TARGET_MS", 20.0),
         # Continuous profiling (docs/monitoring.md "Device resources"):
         # sampler cadence (0 = off), per-capture trace length, and how
         # many trace dirs the rotation keeps.
@@ -304,6 +323,16 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             parse_slo_specs(conf.slo_specs)
         except ValueError as e:
             raise ValueError(f"'GUBER_SLO_SPECS' is invalid: {e}") from None
+    if conf.intake_limit < 1:
+        raise ValueError(
+            f"'GUBER_INTAKE_LIMIT={conf.intake_limit}' is invalid; the "
+            "intake budget must admit at least 1 queued entry"
+        )
+    if conf.intake_target_ms <= 0:
+        raise ValueError(
+            f"'GUBER_INTAKE_TARGET_MS={conf.intake_target_ms}' is "
+            "invalid; the CoDel target must be a positive duration"
+        )
     if conf.admission_ring < 1:
         raise ValueError(
             f"'GUBER_ADMISSION_RING={conf.admission_ring}' is invalid; "

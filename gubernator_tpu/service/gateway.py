@@ -170,6 +170,20 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
         )
         return web.json_response(snap)
 
+    async def debug_overload(request: web.Request) -> web.Response:
+        """Overload control plane (docs/robustness.md "Overload
+        control & brownout"): the brownout ladder level + the signals
+        driving it, and the intake governor's controller state — shed
+        counts by reason, CoDel standing-queue state, per-tenant shed
+        weights and heavy-hitter attribution. Host-side dict copies
+        under the governor's own lock — zero device work (GL009);
+        executor for the lock. {"enabled": false} when GUBER_OVERLOAD
+        is off."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, svc.overload_debug_info
+        )
+        return web.json_response(snap)
+
     async def debug_cluster(request: web.Request) -> web.Response:
         """Cluster-wide debug view (docs/monitoring.md "Consistency"):
         this node's local_debug_info plus a breaker-gated, shared-deadline
@@ -216,6 +230,7 @@ def add_debug_routes(app: web.Application, svc: V1Service) -> None:
     app.router.add_get("/debug/admission", debug_admission)
     app.router.add_get("/debug/slo", debug_slo)
     app.router.add_get("/debug/standby", debug_standby)
+    app.router.add_get("/debug/overload", debug_overload)
     app.router.add_get("/debug/cluster", debug_cluster)
 
 

@@ -1,0 +1,673 @@
+"""Overload control plane: bounded intake, fair shedding, brownout.
+
+The engine intake used to be an unbounded queue: a flood (or a retry
+storm amplifying one) grew the queue without limit, expired-deadline
+work was still executed, and one hot tenant starved everyone enqueued
+behind it. This module is the self-protection layer (GUBER_OVERLOAD,
+default off = bit-exact with the pre-overload daemon):
+
+- ``IntakeGovernor`` — injected as ``engine.overload`` (the runtime
+  stays service-free; the engine duck-types the seam exactly like its
+  watchdog hook). ``admit()`` runs before a request is enqueued:
+  already-expired deadlines (the PR 3 absolute ``deadline_ms`` wire
+  metadata) are refused outright, intake past GUBER_INTAKE_LIMIT is
+  shed with the typed retryable ERR_OVERLOADED + ``retry_after_ms``,
+  and when the engine's own ``queue_wait`` signal sustains above
+  GUBER_INTAKE_TARGET_MS a CoDel-style controller sheds
+  probabilistically — weighted per tenant (tenant = rate-limit
+  namespace ``req.name``) so a flooding tenant sheds first. Heavy
+  hitters are attributed with the PR 7 HotKeySketch machinery. The
+  pump side calls ``deadline_expired()`` at pickup so queued work
+  whose caller already gave up never touches the device.
+
+- ``RetryBudget`` — token-bucket retry budget (GUBER_RETRY_BUDGET,
+  default 10%): each first attempt deposits ``ratio`` tokens, each
+  retry spends one. Used by GubernatorClient and the edge relays so
+  client retries can never multiply an overload by more than
+  ``1 + ratio``.
+
+- ``OverloadManager`` — the brownout ladder. A sampler thread folds
+  the PR 17 SLO burn rates (``flush-latency`` fast-burn/exhausted),
+  the watchdog's serving-loop stall flag, and the governor's own
+  sustained-overload state into one level: normal(0) →
+  shed-observability-extras(1) → degraded-local-for-replicas(2) →
+  shed-low-priority-tenants(3), with escalation after a short bad
+  streak and recovery hysteresis on a longer good streak. The level
+  is published as the ``gubernator_overload_level`` gauge and the
+  ``/debug/overload`` payload on both listeners (riding DebugInfo
+  into /debug/cluster).
+
+Shed responses are stamped with admission provenance (PATH_SHED) and
+counted through the DecisionRecorder, so the admission observatory
+sees shed traffic instead of losing it. Docs:
+docs/robustness.md "Overload control & brownout".
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Optional
+
+from gubernator_tpu.api.keys import key_hash128
+from gubernator_tpu.api.types import ERR_OVERLOADED, RateLimitResp
+from gubernator_tpu.metrics import HotKeySketch
+from gubernator_tpu.parallel.leases import RETRY_AFTER_MD_KEY
+from gubernator_tpu.service.admission import PATH_SHED, stamp_decision
+from gubernator_tpu.utils import clock as _clock
+from gubernator_tpu.utils import lockorder
+from gubernator_tpu.utils import raceguard
+
+log = logging.getLogger("gubernator_tpu.overload")
+
+# Absolute caller deadline, epoch ms (the PR 3 forwarding wire
+# metadata — parallel/peers.py budgets forwards against the same key).
+DEADLINE_MD_KEY = "deadline_ms"
+
+# A caller deadline that expired before the request reached the device.
+# Deliberately NOT retryable (no RETRYABLE_PREFIX): the caller already
+# gave up, re-dispatching the same dead request only adds load.
+ERR_DEADLINE_EXPIRED = (
+    "DEADLINE_EXCEEDED: caller deadline expired; request not applied"
+)
+
+# Shed reason labels (gubernator_intake_shed_counter{reason=...}).
+SHED_QUEUE_FULL = "queue_full"  # intake depth >= GUBER_INTAKE_LIMIT
+SHED_DEADLINE = "deadline_expired"  # refused at admit or dropped at pickup
+SHED_CODEL = "codel"  # standing queue above target; fair-share shed
+SHED_TENANT = "tenant"  # same controller, dominant-tenant multiplier
+SHED_BROWNOUT = "brownout"  # ladder level 3: heavy tenant shed outright
+SHED_REASONS = (
+    SHED_QUEUE_FULL,
+    SHED_DEADLINE,
+    SHED_CODEL,
+    SHED_TENANT,
+    SHED_BROWNOUT,
+)
+
+# Brownout ladder levels, least to most degraded.
+LEVEL_NORMAL = 0
+LEVEL_SHED_OBSERVABILITY = 1
+LEVEL_DEGRADED_LOCAL = 2
+LEVEL_SHED_TENANTS = 3
+LEVEL_NAMES = (
+    "normal",
+    "shed_observability",
+    "degraded_local",
+    "shed_tenants",
+)
+
+
+def request_deadline_ms(req) -> Optional[int]:
+    """The absolute epoch-ms deadline a request carries, or None."""
+    md = getattr(req, "metadata", None)
+    if not md:
+        return None
+    raw = md.get(DEADLINE_MD_KEY)
+    if raw is None:
+        return None
+    try:
+        return int(float(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+class RetryBudget:
+    """Token-bucket retry budget (the classic retries-as-a-fraction-of-
+    first-attempts rule). Every first attempt deposits ``ratio`` tokens
+    (capped at ``burst``); every retry spends one. While the server is
+    healthy the bucket sits full and retries are free; during sustained
+    overload the bucket drains and retries are capped at ``ratio`` of
+    the offered first-attempt load — a retry storm can amplify
+    overload by at most 1 + ratio."""
+
+    def __init__(self, ratio: float = 0.1, burst: float = 10.0):
+        self.ratio = max(0.0, min(float(ratio), 1.0))
+        self.burst = max(1.0, float(burst))
+        self._lock = lockorder.make_lock("overload.retry_budget")
+        self._tokens = self.burst  # start full: first failure may retry
+        self._attempts = 0
+        self._retries = 0
+        self._denied = 0
+
+    def record(self, n: int = 1) -> None:
+        """Account ``n`` first attempts (refills the bucket)."""
+        with self._lock:
+            self._attempts += n
+            self._tokens = min(self.burst, self._tokens + n * self.ratio)
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        """Spend budget for one retry; False means drop the retry."""
+        with self._lock:
+            if self._tokens >= cost:
+                self._tokens -= cost
+                self._retries += 1
+                return True
+            self._denied += 1
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ratio": self.ratio,
+                "burst": self.burst,
+                "tokens": round(self._tokens, 3),
+                "attempts": self._attempts,
+                "retries": self._retries,
+                "denied": self._denied,
+            }
+
+
+raceguard.guarded_by(RetryBudget, {
+    "_tokens": "overload.retry_budget",
+    "_attempts": "overload.retry_budget",
+    "_retries": "overload.retry_budget",
+    "_denied": "overload.retry_budget",
+})
+
+
+class IntakeGovernor:
+    """Per-engine intake admission controller.
+
+    ``admit(req, depth)`` is called by the engine before enqueueing
+    (object path: check_async / check_bulk members); it returns
+    ``(shed_resp_or_None, deadline_ms_or_None)``. A non-None response
+    short-circuits the request without touching the queue; a non-None
+    deadline rides on the slot/future so the pump can drop it at
+    pickup via ``deadline_expired()`` + ``refuse_expired()``.
+
+    The CoDel-style controller watches the engine's queue-wait signal
+    through ``observe_wait()``: per 100 ms interval it tracks the
+    MINIMUM wait (the standing-queue indicator — a transient burst has
+    a small min, a standing queue does not). When the interval minimum
+    stays above target, shed probability ramps from a small base to
+    ``p_max`` over ``ramp_s`` seconds of sustained overload, weighted
+    per tenant by recent share-of-intake (EWMA over 1 s windows,
+    clamped to [0.25, 4.0]) so the flooder sheds first and light
+    tenants mostly pass. Ladder level 3 additionally sheds
+    heavy-hitter tenants (window share >= ``heavy_share``) outright.
+
+    Lock order: ``overload.intake`` is leaf-ish — the tenant sketch
+    (``metrics.hotkeys``) and the admission recorder
+    (``service.admission_ring``) are only touched OUTSIDE it."""
+
+    def __init__(
+        self,
+        limit: int = 8192,
+        target_ms: float = 20.0,
+        *,
+        metrics=None,
+        recorder=None,
+        interval_s: float = 0.1,
+        window_s: float = 1.0,
+        ramp_s: float = 1.0,
+        p_base: float = 0.05,
+        p_max: float = 0.9,
+        heavy_share: float = 0.5,
+        tenant_k: int = 128,
+        rng=None,
+        now=time.monotonic,
+    ):
+        self.limit = max(1, int(limit))
+        self.target_s = max(float(target_ms), 0.001) / 1000.0
+        self.metrics = metrics
+        self.recorder = recorder
+        self.interval_s = max(float(interval_s), 0.001)
+        self.window_s = max(float(window_s), self.interval_s)
+        self.ramp_s = max(float(ramp_s), 0.001)
+        self.p_base = float(p_base)
+        self.p_max = float(p_max)
+        self.heavy_share = float(heavy_share)
+        self.tenant_k = max(1, int(tenant_k))
+        self._rng = rng if rng is not None else random.random
+        self._now = now
+        self._lock = lockorder.make_lock("overload.intake")
+        # CoDel interval state.
+        self._interval_min: Optional[float] = None
+        self._interval_end = now() + self.interval_s
+        self._over_since: Optional[float] = None
+        self._wait_ewma = 0.0
+        # Tenant fairness state. `_tenant_window` accumulates raw admit
+        # counts for the current window; on rollover it folds into the
+        # `_tenant_rates` EWMA, from which `_tenant_mult` (shed weight)
+        # and `_heavy` (level-3 shed set) are rebuilt as fresh objects
+        # (admit reads them racily-by-swap, never mutated in place).
+        self._tenant_window: dict = {}
+        self._tenant_rates: dict = {}
+        self._tenant_mult: dict = {}
+        self._heavy: frozenset = frozenset()
+        self._window_end = now() + self.window_s
+        self._level = LEVEL_NORMAL
+        self._shed_counts = {r: 0 for r in SHED_REASONS}
+        # Heavy-hitter attribution sketch (PR 7 machinery), fed outside
+        # the intake lock; suppressed at ladder level >= 1 (it is an
+        # observability extra, not a control input).
+        self.tenant_sketch = HotKeySketch(
+            "overload_intake_tenants",
+            "per-tenant intake admits (debug-only sketch)",
+            k=self.tenant_k,
+        )
+        self._hash_cache: dict = {}  # tenant -> (hi, lo), racily rebuilt
+        self._shed_children = None
+        if metrics is not None:
+            self._shed_children = {
+                r: metrics.intake_shed_counter.labels(r)
+                for r in SHED_REASONS
+            }
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(self, req, depth: int):
+        """Admission-control one request about to be enqueued. Returns
+        ``(resp, deadline_ms)``: a non-None resp is the final answer
+        (shed/refused, never enqueued); deadline_ms (when present and
+        unexpired) must ride on the slot for pickup-time drop."""
+        dl = request_deadline_ms(req)
+        if dl is not None and _clock.now_ms() >= dl:
+            return self.refuse_expired(req), None
+        tenant = req.name or "<none>"
+        now = self._now()
+        reason = None
+        with self._lock:
+            self._maybe_roll(now)
+            self._tenant_window[tenant] = (
+                self._tenant_window.get(tenant, 0) + 1
+            )
+            level = self._level
+            if depth >= self.limit:
+                reason = SHED_QUEUE_FULL
+            elif level >= LEVEL_SHED_TENANTS and tenant in self._heavy:
+                reason = SHED_BROWNOUT
+            else:
+                p = self._shed_p_locked(now)
+                if p > 0.0:
+                    mult = self._tenant_mult.get(tenant, 1.0)
+                    if self._rng() < min(self.p_max, p * mult):
+                        reason = (
+                            SHED_TENANT if mult > 1.5 else SHED_CODEL
+                        )
+            retry_ms = self._retry_after_ms_locked()
+        if level < LEVEL_SHED_OBSERVABILITY:
+            self.tenant_sketch.update(
+                [(self._tenant_hash(tenant), 1, 0, tenant)]
+            )
+        if reason is None:
+            return None, dl
+        return self._shed(req, reason, retry_ms), dl
+
+    def deadline_expired(self, deadline_ms: int) -> bool:
+        """Pickup-time check for a slot's stored deadline."""
+        return _clock.now_ms() >= deadline_ms
+
+    def refuse_expired(self, req) -> RateLimitResp:
+        """Terminal (non-retryable) refusal for an expired deadline —
+        used both at admit and by the pump at pickup."""
+        resp = RateLimitResp(error=ERR_DEADLINE_EXPIRED, metadata={})
+        stamp_decision(resp, PATH_SHED)
+        self._count_shed(SHED_DEADLINE)
+        self._record(req, resp)
+        return resp
+
+    def _shed(self, req, reason: str, retry_ms: int) -> RateLimitResp:
+        resp = RateLimitResp(
+            error=ERR_OVERLOADED,
+            metadata={RETRY_AFTER_MD_KEY: str(retry_ms)},
+        )
+        stamp_decision(resp, PATH_SHED)
+        self._count_shed(reason)
+        self._record(req, resp)
+        return resp
+
+    def _count_shed(self, reason: str) -> None:
+        with self._lock:
+            self._shed_counts[reason] += 1
+        ch = self._shed_children
+        if ch is not None:
+            ch[reason].inc()
+
+    def _record(self, req, resp) -> None:
+        rec = self.recorder
+        if rec is not None:
+            rec.record_decision(PATH_SHED, resp, key=req.hash_key())
+
+    def _tenant_hash(self, tenant: str):
+        h = self._hash_cache.get(tenant)
+        if h is None:
+            if len(self._hash_cache) >= 4096:
+                self._hash_cache = {}
+            h = key_hash128(tenant)
+            self._hash_cache[tenant] = h
+        return h
+
+    # -- queue-wait controller -----------------------------------------------
+
+    def observe_wait(self, wait_s: float) -> None:
+        """Fed by the engine pump with each dequeued entry's queue
+        wait — the same signal the ``queue_wait`` histogram observes."""
+        now = self._now()
+        with self._lock:
+            self._wait_ewma += 0.1 * (wait_s - self._wait_ewma)
+            if self._interval_min is None or wait_s < self._interval_min:
+                self._interval_min = wait_s
+            self._maybe_roll(now)
+
+    @raceguard.holds_lock("overload.intake")
+    def _maybe_roll(self, now: float) -> None:
+        """Roll the CoDel interval / fairness window clocks. Runs under
+        the intake lock; both admit() and observe_wait() drive it so
+        the controller can't go stale when only one side is active."""
+        if now >= self._interval_end:
+            # An interval with no pump observations has no standing-
+            # queue evidence (idle or fully drained): treat as under
+            # target — depth-based shedding still covers a stalled pump.
+            if (
+                self._interval_min is not None
+                and self._interval_min > self.target_s
+            ):
+                if self._over_since is None:
+                    self._over_since = now
+            else:
+                self._over_since = None
+            self._interval_min = None
+            self._interval_end = now + self.interval_s
+        if now >= self._window_end:
+            self._roll_window_locked()
+            self._window_end = now + self.window_s
+
+    @raceguard.holds_lock("overload.intake")
+    def _roll_window_locked(self) -> None:
+        counts, self._tenant_window = self._tenant_window, {}
+        rates = {}
+        for t, r in self._tenant_rates.items():
+            nr = 0.5 * r + 0.5 * counts.pop(t, 0)
+            if nr >= 0.25:
+                rates[t] = nr
+        for t, c in counts.items():
+            rates[t] = 0.5 * c
+        if len(rates) > self.tenant_k:
+            keep = sorted(rates, key=rates.get, reverse=True)
+            rates = {t: rates[t] for t in keep[: self.tenant_k]}
+        self._tenant_rates = rates
+        total = sum(rates.values())
+        n = len(rates)
+        if total > 0.0 and n > 1:
+            self._tenant_mult = {
+                t: min(4.0, max(0.25, (r / total) * n))
+                for t, r in rates.items()
+            }
+            self._heavy = frozenset(
+                t for t, r in rates.items()
+                if r / total >= self.heavy_share
+            )
+        else:
+            # A single tenant has no one to be fair against: plain
+            # CoDel (mult 1.0) and no heavy set.
+            self._tenant_mult = {}
+            self._heavy = frozenset()
+
+    def _shed_p_locked(self, now: float) -> float:
+        if self._over_since is None:
+            return 0.0
+        frac = min(1.0, (now - self._over_since) / self.ramp_s)
+        return min(self.p_max, self.p_base + frac * self.p_max)
+
+    def _retry_after_ms_locked(self) -> int:
+        base_ms = 2.0 * max(self._wait_ewma, self.target_s) * 1000.0
+        return max(25, min(int(base_ms), 5000))
+
+    # -- ladder / introspection ----------------------------------------------
+
+    def set_level(self, level: int) -> None:
+        with self._lock:
+            self._level = max(
+                LEVEL_NORMAL, min(int(level), LEVEL_SHED_TENANTS)
+            )
+
+    def overloaded(self) -> dict:
+        """Controller state for the ladder: sustained standing queue."""
+        now = self._now()
+        with self._lock:
+            self._maybe_roll(now)
+            over = self._over_since
+            return {
+                "overloaded": over is not None,
+                "sustained_s": (now - over) if over is not None else 0.0,
+            }
+
+    def snapshot(self) -> dict:
+        now = self._now()
+        with self._lock:
+            self._maybe_roll(now)
+            over = self._over_since
+            snap = {
+                "limit": self.limit,
+                "target_ms": round(self.target_s * 1000.0, 3),
+                "level": self._level,
+                "overloaded": over is not None,
+                "sustained_s": round(
+                    (now - over) if over is not None else 0.0, 3
+                ),
+                "wait_ewma_ms": round(self._wait_ewma * 1000.0, 3),
+                "shed_p": round(self._shed_p_locked(now), 4),
+                "retry_after_ms": self._retry_after_ms_locked(),
+                "shed": dict(self._shed_counts),
+                "tenant_mult": {
+                    t: round(m, 3)
+                    for t, m in sorted(self._tenant_mult.items())
+                },
+                "heavy_tenants": sorted(self._heavy),
+            }
+        sk = self.tenant_sketch.snapshot()
+        snap["hot_tenants"] = [
+            {"tenant": e["key"], "admits": e["hits"]}
+            for e in sk["entries"][:8]
+        ]
+        return snap
+
+
+# Declared lock protocol (docs/robustness.md "Race sanitizer").
+# `_tenant_mult` / `_heavy` are write-guarded: rebuilt as fresh objects
+# under the lock, read racily-by-swap on the admit fast path.
+# `_hash_cache` stays DELIBERATELY undeclared: a lost insert only costs
+# one recomputed hash.
+raceguard.guarded_by(IntakeGovernor, {
+    "_interval_min": "overload.intake",
+    "_interval_end": "overload.intake",
+    "_over_since": "overload.intake",
+    "_wait_ewma": "overload.intake",
+    "_tenant_window": "overload.intake",
+    "_tenant_rates": "overload.intake",
+    "_tenant_mult": "w:overload.intake",
+    "_heavy": "w:overload.intake",
+    "_window_end": "overload.intake",
+    "_level": "overload.intake",
+    "_shed_counts": "overload.intake",
+})
+
+
+class OverloadManager:
+    """The brownout ladder: folds SLO burn rates, the watchdog's
+    serving-stall flag, and the governor's sustained-overload state
+    into one published degradation level, with escalation streaks and
+    recovery hysteresis. Owns the IntakeGovernor the daemon injects
+    into the engine."""
+
+    def __init__(
+        self,
+        svc,
+        governor: IntakeGovernor,
+        *,
+        slo=None,
+        watchdog=None,
+        interval_s: float = 0.25,
+        escalate_after: int = 2,
+        hysteresis: int = 8,
+    ):
+        self.svc = svc
+        self.governor = governor
+        self.slo = slo
+        self.watchdog = watchdog
+        self.interval_s = max(float(interval_s), 0.01)
+        self.escalate_after = max(1, int(escalate_after))
+        self.hysteresis = max(1, int(hysteresis))
+        self._level = LEVEL_NORMAL
+        self._since_ms = _clock.now_ms()
+        self._bad_streak = 0
+        self._good_streak = 0
+        self._last_signals: dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._transition_children = None
+        m = getattr(svc, "metrics", None)
+        if m is not None:
+            self._transition_children = {
+                lv: m.overload_transitions.labels(str(lv))
+                for lv in range(len(LEVEL_NAMES))
+            }
+
+    # -- level effects (read by server/peers/gateway) ------------------------
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def shed_observability(self) -> bool:
+        """Level >= 1: drop observability extras on the hot path."""
+        return self._level >= LEVEL_SHED_OBSERVABILITY
+
+    def degrade_forwards(self) -> bool:
+        """Level >= 2: answer would-be peer forwards locally (the
+        degraded-local path) instead of queueing onto a sick mesh."""
+        return self._level >= LEVEL_DEGRADED_LOCAL
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self) -> int:
+        """One ladder step: gather signals, update streaks, maybe move
+        one level. Called by the sampler loop; tests call it directly."""
+        sigs = {
+            "slo_fast_burn": [],
+            "serving_stalled": False,
+            "intake_overloaded": False,
+        }
+        slo = self.slo if self.slo is not None else getattr(
+            self.svc, "slo", None
+        )
+        if slo is not None:
+            try:
+                rows = slo.evaluate()
+            except Exception:  # guberlint: allow-swallow -- a broken SLO source must not take down the ladder; the remaining signals still drive it
+                rows = []
+            for r in rows:
+                if r.get("state") in ("fast_burn", "exhausted"):
+                    sigs["slo_fast_burn"].append(r.get("id"))
+        wd = self.watchdog
+        if wd is not None:
+            sigs["serving_stalled"] = bool(wd.serving_stalled())
+        ov = self.governor.overloaded()
+        sigs["intake_overloaded"] = ov["overloaded"]
+        pressure = bool(
+            sigs["slo_fast_burn"]
+            or sigs["serving_stalled"]
+            or sigs["intake_overloaded"]
+        )
+        if pressure:
+            self._good_streak = 0
+            self._bad_streak += 1
+            if (
+                self._bad_streak >= self.escalate_after
+                and self._level < LEVEL_SHED_TENANTS
+            ):
+                self._set_level(self._level + 1)
+                self._bad_streak = 0
+        else:
+            self._bad_streak = 0
+            self._good_streak += 1
+            if (
+                self._good_streak >= self.hysteresis
+                and self._level > LEVEL_NORMAL
+            ):
+                self._set_level(self._level - 1)
+                self._good_streak = 0
+        self._last_signals = sigs
+        return self._level
+
+    def _set_level(self, level: int) -> None:
+        prev, self._level = self._level, level
+        self._since_ms = _clock.now_ms()
+        self.governor.set_level(level)
+        ch = self._transition_children
+        if ch is not None:
+            ch[level].inc()
+        lvl_log = log.warning if level > prev else log.info
+        lvl_log(
+            "overload ladder %s: level %d (%s) -> %d (%s)",
+            "escalated" if level > prev else "recovered",
+            prev, LEVEL_NAMES[prev], level, LEVEL_NAMES[level],
+        )
+
+    # -- publication ---------------------------------------------------------
+
+    def metrics_sync(self, m) -> None:
+        """Scrape-time bridge (Metrics.add_sync via V1Service)."""
+        m.overload_level.set(self._level)
+
+    def debug_info(self) -> dict:
+        """/debug/overload payload (also rides DebugInfo into
+        /debug/cluster)."""
+        return {
+            "enabled": True,
+            "level": self._level,
+            "level_name": LEVEL_NAMES[self._level],
+            "since_ms": self._since_ms,
+            "escalate_after": self.escalate_after,
+            "hysteresis": self.hysteresis,
+            "signals": dict(self._last_signals),
+            "intake": self.governor.snapshot(),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.beat("overload-ladder", period_s=self.interval_s)
+        while not self._stop.wait(self.interval_s):
+            if self.watchdog is not None:
+                self.watchdog.beat(
+                    "overload-ladder", period_s=self.interval_s
+                )
+            try:
+                self.evaluate()
+            except Exception:
+                # A broken signal source must not kill the ladder; the
+                # watchdog beat above keeps the loop itself observable.
+                log.exception("overload ladder evaluation failed")
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="gubernator-overload-ladder",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        if self.watchdog is not None:
+            self.watchdog.unregister("overload-ladder")
+
+
+# The ladder's streak/level state is owned by the sampler thread in
+# production; evaluate() is documented as directly callable from tests
+# and soak jobs (without start()), so write affinity — not a lock — is
+# the right pin, mirroring SloObservatory.
+raceguard.guarded_by(OverloadManager, {
+    "_thread": "@thread",
+})

@@ -122,6 +122,12 @@ class V1Service:
         self.slo = None  # SloObservatory
         self.watchdog = None  # Watchdog
         self.metrics.add_sync(self._slo_sync)
+        # Overload control plane seam (service/overload.py), wired by
+        # the daemon under GUBER_OVERLOAD. None (default) keeps intake
+        # and forwarding bit-exact with the pre-overload daemon; the
+        # sync bridge is registered unconditionally and no-ops unwired.
+        self.overload = None  # OverloadManager
+        self.metrics.add_sync(self._overload_sync)
         # Crash-tolerant ownership seam (parallel/standby.py), wired by
         # the daemon under GUBER_STANDBY. None (default) keeps every
         # path — including TransferSnapshots payload handling — bit-exact
@@ -732,6 +738,12 @@ class V1Service:
             # rides DebugInfo like the census, so /debug/cluster shows
             # the fleet-wide durability picture with no wire bump.
             info["standby"] = self.standby.summary()
+        if self.overload is not None:
+            # Brownout ladder blob (level, signals, intake governor
+            # state) rides DebugInfo so /debug/cluster shows which
+            # nodes are degraded and why (docs/robustness.md "Overload
+            # control & brownout").
+            info["overload"] = self.overload.debug_info()
         if keys:
             from gubernator_tpu.store.store import snapshots_from_engine
 
@@ -807,6 +819,26 @@ class V1Service:
         if self.slo is None:
             return {"enabled": False}
         return {"enabled": True, **self.slo.debug_info()}
+
+    def overload_debug_info(self) -> dict:
+        """/debug/overload payload (docs/robustness.md "Overload
+        control & brownout"): the brownout ladder level + driving
+        signals and the intake governor's controller state (shed
+        counts by reason, tenant weights, heavy-hitter attribution).
+        Host-side dict copies only — zero device work (GL009)."""
+        if self.overload is None:
+            return {"enabled": False}
+        return self.overload.debug_info()
+
+    def _overload_sync(self, _metrics=None) -> None:
+        """Scrape-time bridge for gubernator_overload_level. No-op
+        until the daemon wires the overload manager."""
+        if self.overload is None:
+            return
+        try:
+            self.overload.metrics_sync(self.metrics)
+        except Exception:  # guberlint: allow-swallow -- scrape bridge: a failed ladder read must not poison /metrics
+            return
 
     def _slo_sync(self, _metrics=None) -> None:
         """Scrape-time bridge for the SLO families (burn rate, budget

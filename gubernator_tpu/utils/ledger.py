@@ -257,7 +257,8 @@ _MODE_FROM_JOB = re.compile(
     # ledger with mode="" and silently fall out of gate() baselines).
     r"(kernel10m|kernel_ab|kernel|engine_ab|engine|server|global|latency"
     r"|edge|mesh_ab|mesh|ici|paged_table|table_census|lease_soak"
-    r"|admission_soak|slo_soak|crash_soak|chaos_soak|consistency_soak"
+    r"|admission_soak|slo_soak|crash_soak|overload_soak|chaos_soak"
+    r"|consistency_soak"
     r"|sanity|device_observatory|rolling_restart|pallas_ab|ab_narrow)"
 )
 _LAYOUT_FROM_JOB = re.compile(r"(fused|packed|wide|narrow)")

@@ -1,0 +1,22 @@
+"""GL019 violation fixture: unbounded queues on serving paths.
+
+Never imported — parsed by guberlint only (tests/test_lint.py).
+"""
+
+import asyncio
+import queue
+
+
+class Intake:
+    def __init__(self, depth: int):
+        self.q1 = queue.SimpleQueue()            # finding: no bound exists
+        self.q2 = queue.Queue()                  # finding: maxsize absent
+        self.q3 = asyncio.Queue()                # finding: maxsize absent
+        self.q4 = asyncio.Queue(maxsize=0)       # finding: 0 = unbounded
+        self.ok_literal = queue.Queue(maxsize=1000)      # ok: bounded
+        self.ok_positional = queue.Queue(512)            # ok: bounded
+        self.ok_computed = asyncio.Queue(maxsize=max(1, depth))  # ok: knob
+        self.ok_pragma = queue.SimpleQueue()  # guberlint: allow-unbounded-queue -- fixture: producer holds a semaphore bounding depth
+
+    def pragma_no_reason(self):
+        self.bad_pragma = queue.SimpleQueue()  # guberlint: allow-unbounded-queue

@@ -224,7 +224,7 @@ def test_forward_queue_sheds_with_typed_overload():
         with pytest.raises(PeerOverloadedError) as exc:
             await peer.get_peer_rate_limit(_req(99_999))
         assert is_retryable_error(str(exc.value))
-        assert metrics.forward_queue_full.labels().get() == 1
+        assert metrics.forward_queue_full.labels("queue_full").get() == 1
         blocked.set()
         await peer.shutdown()
 

@@ -66,7 +66,7 @@ def test_registry_complete():
     assert codes == {
         "GL000", "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
         "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
-        "GL014", "GL015", "GL016", "GL017", "GL018",
+        "GL014", "GL015", "GL016", "GL017", "GL018", "GL019",
     }
 
 
@@ -213,6 +213,15 @@ _CASES = [
          "requires a non-empty reason"},
         5,  # 4 blocking calls under a hot lock + 1 reason-less pragma;
             # the same calls outside locks or under a cold lock pass
+    ),
+    (
+        "GL019",
+        fixture("runtime", "gl019_unbounded_queue.py"),
+        {"queue.SimpleQueue", "queue.Queue", "asyncio.Queue",
+         "requires a non-empty reason"},
+        5,  # 4 unbounded constructions + 1 reason-less pragma; bounded
+            # (literal/positional/computed) and reasoned-pragma sites
+            # stay quiet
     ),
     (
         "GL016",
@@ -387,6 +396,16 @@ def test_gl018_repo_baseline_zero():
     res = run_lint(rule_codes=["GL018"])
     assert [f.render() for f in res.new] == []
     assert not any(f.rule == "GL018" for f in res.findings)
+
+
+def test_gl019_repo_baseline_zero():
+    # Every queue on a serving path is bounded (peer batch queue via
+    # GUBER_PEER_QUEUE, engine intake via the overload governor) or
+    # carries a reasoned pragma naming what bounds its producer —
+    # GL019's repo baseline is pinned at zero.
+    res = run_lint(rule_codes=["GL019"])
+    assert [f.render() for f in res.new] == []
+    assert not any(f.rule == "GL019" for f in res.findings)
 
 
 def test_gl017_parses_real_guarded_declarations():

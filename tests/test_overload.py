@@ -1,0 +1,460 @@
+"""Overload control plane (service/overload.py + engine intake hooks):
+
+- RetryBudget token-bucket math;
+- IntakeGovernor admission: deadline refusal, queue-budget shedding,
+  CoDel-style standing-queue control, per-tenant weighted fairness,
+  level-3 heavy-tenant brownout;
+- OverloadManager ladder: escalation streaks, recovery hysteresis,
+  governor level sync, transition metrics;
+- engine intake hardening: expired `deadline_ms` refused at admit
+  (direct check_async AND the bulk path peer forwards ride) and at
+  pump pickup, all with ZERO engine dispatches;
+- GUBER_OVERLOAD off = bit-exact (deadline metadata ignored, knob
+  defaults and validation).
+"""
+
+import pytest
+import requests
+
+from gubernator_tpu.api.types import (
+    ERR_OVERLOADED,
+    RateLimitReq,
+    Status,
+    is_retryable_error,
+)
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.runtime.engine import DeviceEngine, EngineConfig
+from gubernator_tpu.service.overload import (
+    ERR_DEADLINE_EXPIRED,
+    LEVEL_DEGRADED_LOCAL,
+    LEVEL_NORMAL,
+    LEVEL_SHED_TENANTS,
+    IntakeGovernor,
+    OverloadManager,
+    RetryBudget,
+    request_deadline_ms,
+)
+from gubernator_tpu.utils import clock as _clock
+
+
+def mk(key="k", name="t", **kw):
+    kw.setdefault("duration", 60_000)
+    kw.setdefault("limit", 10)
+    kw.setdefault("hits", 1)
+    return RateLimitReq(name=name, unique_key=key, **kw)
+
+
+def expired_md():
+    return {"deadline_ms": str(_clock.now_ms() - 5)}
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget
+
+
+def test_retry_budget_starts_full_then_caps_at_ratio():
+    b = RetryBudget(ratio=0.1, burst=3.0)
+    # burst: the full bucket covers a cold-start failure
+    assert [b.try_spend() for _ in range(3)] == [True, True, True]
+    assert b.try_spend() is False  # dry
+    # 10 first attempts deposit 10 * 0.1 = 1 token
+    b.record(10)
+    assert b.try_spend() is True
+    assert b.try_spend() is False
+    snap = b.snapshot()
+    assert snap["attempts"] == 10
+    assert snap["retries"] == 4
+    assert snap["denied"] == 2
+
+
+def test_retry_budget_refill_caps_at_burst():
+    b = RetryBudget(ratio=1.0, burst=2.0)
+    b.record(1000)  # cannot bank more than burst
+    assert [b.try_spend() for _ in range(3)] == [True, True, False]
+
+
+# ---------------------------------------------------------------------------
+# deadline metadata parsing
+
+
+def test_request_deadline_ms_parsing():
+    assert request_deadline_ms(mk()) is None
+    assert request_deadline_ms(mk(metadata={"deadline_ms": "123"})) == 123
+    assert request_deadline_ms(mk(metadata={"deadline_ms": "1.5e3"})) == 1500
+    assert request_deadline_ms(mk(metadata={"deadline_ms": "soon"})) is None
+
+
+# ---------------------------------------------------------------------------
+# IntakeGovernor
+
+
+def make_gov(**kw):
+    clk = {"t": 100.0}
+    kw.setdefault("limit", 100)
+    kw.setdefault("target_ms", 20.0)
+    kw.setdefault("now", lambda: clk["t"])
+    gov = IntakeGovernor(**kw)
+    gov._test_clk = clk
+    return gov
+
+
+def test_expired_deadline_refused_at_admit():
+    gov = make_gov()
+    resp, dl = gov.admit(mk(metadata=expired_md()), depth=0)
+    assert resp is not None and resp.error == ERR_DEADLINE_EXPIRED
+    assert not is_retryable_error(resp.error)  # caller gave up: terminal
+    assert gov.snapshot()["shed"]["deadline_expired"] == 1
+
+
+def test_live_deadline_rides_through():
+    dl_ms = _clock.now_ms() + 60_000
+    gov = make_gov()
+    resp, dl = gov.admit(mk(metadata={"deadline_ms": str(dl_ms)}), depth=0)
+    assert resp is None and dl == dl_ms
+
+
+def test_queue_budget_sheds_retryable_with_retry_after():
+    gov = make_gov(limit=10)
+    resp, _ = gov.admit(mk(), depth=10)
+    assert resp is not None and resp.error == ERR_OVERLOADED
+    assert is_retryable_error(resp.error)
+    assert int(resp.metadata["retry_after_ms"]) >= 25
+    assert gov.snapshot()["shed"]["queue_full"] == 1
+    # under budget: admitted
+    assert gov.admit(mk(), depth=9) == (None, None)
+
+
+def test_codel_sheds_on_sustained_standing_queue_and_recovers():
+    gov = make_gov(rng=lambda: 0.0)  # always shed once p > 0
+    clk = gov._test_clk
+    # One interval whose MINIMUM queue wait sits above target...
+    gov.observe_wait(0.050)
+    clk["t"] += 0.11
+    gov.observe_wait(0.050)  # rolls the interval -> sustained overload
+    clk["t"] += 0.05
+    resp, _ = gov.admit(mk(), depth=0)
+    assert resp is not None and resp.error == ERR_OVERLOADED
+    # single tenant: no fairness multiplier, plain CoDel
+    assert gov.snapshot()["shed"]["codel"] == 1
+    assert gov.overloaded()["overloaded"] is True
+    # ...then the queue drains: interval min drops under target
+    gov.observe_wait(0.001)
+    clk["t"] += 0.11
+    gov.observe_wait(0.001)
+    assert gov.overloaded()["overloaded"] is False
+    assert gov.admit(mk(), depth=0) == (None, None)
+
+
+def test_tenant_fairness_weights_the_flooder():
+    gov = make_gov(rng=lambda: 1.0)  # never shed probabilistically
+    clk = gov._test_clk
+    for i in range(90):
+        gov.admit(mk(key=f"f{i}", name="flood"), depth=0)
+    for i in range(10):
+        gov.admit(mk(key=f"q{i}", name="quiet"), depth=0)
+    clk["t"] += 1.1  # roll the fairness window
+    snap = gov.snapshot()
+    assert snap["tenant_mult"]["flood"] == pytest.approx(1.8)
+    assert snap["tenant_mult"]["quiet"] == pytest.approx(0.25)  # floor
+    assert snap["heavy_tenants"] == ["flood"]
+    hot = {e["tenant"] for e in snap["hot_tenants"]}
+    assert "flood" in hot  # sketch attribution for /debug/overload
+    # ladder level 3: the heavy tenant sheds outright, quiet passes
+    gov.set_level(LEVEL_SHED_TENANTS)
+    resp, _ = gov.admit(mk(key="fx", name="flood"), depth=0)
+    assert resp is not None and is_retryable_error(resp.error)
+    assert gov.admit(mk(key="qx", name="quiet"), depth=0) == (None, None)
+    assert gov.snapshot()["shed"]["brownout"] == 1
+
+
+def test_shed_metric_reason_labels_and_recorder():
+    recorded = []
+
+    class Rec:
+        def record_decision(self, path, resp, **kw):
+            recorded.append((path, resp.error, kw.get("key")))
+
+    m = Metrics()
+    gov = make_gov(limit=1, metrics=m, recorder=Rec())
+    gov.admit(mk(), depth=5)
+    assert m.intake_shed_counter.labels("queue_full").get() == 1
+    assert recorded and recorded[0][0] == "shed"
+
+
+# ---------------------------------------------------------------------------
+# OverloadManager ladder
+
+
+class FakeSLO:
+    def __init__(self):
+        self.rows = []
+
+    def evaluate(self):
+        return self.rows
+
+
+class FakeWatchdog:
+    def __init__(self):
+        self.stalled = False
+
+    def serving_stalled(self):
+        return self.stalled
+
+
+class FakeSvc:
+    def __init__(self):
+        self.metrics = Metrics()
+
+
+def make_ladder(**kw):
+    gov = make_gov()
+    svc = FakeSvc()
+    slo = FakeSLO()
+    wd = FakeWatchdog()
+    kw.setdefault("escalate_after", 2)
+    kw.setdefault("hysteresis", 3)
+    om = OverloadManager(svc, gov, slo=slo, watchdog=wd, **kw)
+    return om, gov, svc, slo, wd
+
+
+def test_ladder_escalates_on_streak_and_recovers_with_hysteresis():
+    om, gov, svc, slo, wd = make_ladder()
+    slo.rows = [{"id": "flush-latency", "state": "fast_burn"}]
+    assert om.evaluate() == LEVEL_NORMAL  # streak of 1: not yet
+    assert om.evaluate() == 1
+    assert om.shed_observability() and not om.degrade_forwards()
+    om.evaluate()
+    assert om.evaluate() == LEVEL_DEGRADED_LOCAL
+    assert om.degrade_forwards()
+    om.evaluate()
+    assert om.evaluate() == LEVEL_SHED_TENANTS
+    om.evaluate()
+    assert om.evaluate() == LEVEL_SHED_TENANTS  # capped
+    assert gov.snapshot()["level"] == LEVEL_SHED_TENANTS  # synced down
+    # recovery: one good eval is not enough (hysteresis=3)...
+    slo.rows = []
+    assert om.evaluate() == LEVEL_SHED_TENANTS
+    om.evaluate()
+    assert om.evaluate() == LEVEL_DEGRADED_LOCAL
+    for _ in range(6):
+        om.evaluate()
+    assert om.evaluate() == LEVEL_NORMAL
+    assert gov.snapshot()["level"] == LEVEL_NORMAL
+    assert svc.metrics.overload_transitions.labels("3").get() == 1
+    assert svc.metrics.overload_transitions.labels("0").get() == 1
+
+
+def test_ladder_watchdog_stall_and_intake_signals():
+    om, gov, svc, slo, wd = make_ladder(escalate_after=1)
+    wd.stalled = True
+    assert om.evaluate() == 1
+    info = om.debug_info()
+    assert info["enabled"] is True
+    assert info["level_name"] == "shed_observability"
+    assert info["signals"]["serving_stalled"] is True
+    assert info["intake"]["limit"] == 100
+    wd.stalled = False
+    # governor sustained-overload drives the ladder too
+    clk = gov._test_clk
+    gov.observe_wait(0.05)
+    clk["t"] += 0.11
+    gov.observe_wait(0.05)
+    assert om.evaluate() == LEVEL_DEGRADED_LOCAL
+    assert om.debug_info()["signals"]["intake_overloaded"] is True
+
+
+def test_ladder_survives_broken_slo_source():
+    class BrokenSLO:
+        def evaluate(self):
+            raise RuntimeError("scrape exploded")
+
+    gov = make_gov()
+    om = OverloadManager(
+        FakeSvc(), gov, slo=BrokenSLO(), escalate_after=1, hysteresis=1
+    )
+    assert om.evaluate() == LEVEL_NORMAL  # broken source != pressure
+
+
+def test_metrics_sync_publishes_level():
+    om, gov, svc, slo, wd = make_ladder(escalate_after=1)
+    wd.stalled = True
+    om.evaluate()
+    om.metrics_sync(svc.metrics)
+    assert svc.metrics.overload_level.collect()[0].samples[0].value == 1
+
+
+# ---------------------------------------------------------------------------
+# engine intake hardening (zero dispatches for refused work)
+
+
+@pytest.fixture
+def engine():
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.002)
+    )
+    eng.overload = IntakeGovernor(limit=8192, target_ms=20.0)
+    yield eng
+    eng.close()
+
+
+def test_direct_expired_deadline_zero_engine_dispatches(engine):
+    futs = [
+        engine.check_async(mk(key=f"k{i}", metadata=expired_md()))
+        for i in range(8)
+    ]
+    for f in futs:
+        assert f.result(timeout=5).error == ERR_DEADLINE_EXPIRED
+    assert engine.metrics.batches == 0  # flush count unchanged
+    assert engine.metrics.cold_compiles == 0
+
+
+def test_bulk_expired_deadline_refused_like_a_reforward(engine):
+    # The owner's GetPeerRateLimits path feeds re-forwarded items (their
+    # deadline_ms re-stamped by the forwarding peer) through check_bulk.
+    resps = engine.check_bulk(
+        [mk(key=f"k{i}", metadata=expired_md()) for i in range(16)]
+    ).result(timeout=5)
+    assert [r.error for r in resps] == [ERR_DEADLINE_EXPIRED] * 16
+    assert engine.metrics.batches == 0
+    assert engine.metrics.cold_compiles == 0
+
+
+def test_pickup_time_expiry_drops_without_device_touch(engine):
+    # Admitted alive, expired by the time the pump picks it up: force
+    # the pickup-time verdict so the race is deterministic.
+    engine.overload.deadline_expired = lambda dl: True
+    live_md = {"deadline_ms": str(_clock.now_ms() + 60_000)}
+    fut = engine.check_async(mk(metadata=dict(live_md)))
+    assert fut.result(timeout=5).error == ERR_DEADLINE_EXPIRED
+    resps = engine.check_bulk(
+        [mk(key=f"k{i}", metadata=dict(live_md)) for i in range(4)]
+    ).result(timeout=5)
+    assert [r.error for r in resps] == [ERR_DEADLINE_EXPIRED] * 4
+    assert engine.metrics.batches == 0
+    assert engine.metrics.cold_compiles == 0
+
+
+def test_mixed_bulk_serves_live_members(engine):
+    resps = engine.check_bulk(
+        [mk(key="dead", metadata=expired_md()), mk(key="live")]
+    ).result(timeout=5)
+    assert resps[0].error == ERR_DEADLINE_EXPIRED
+    assert resps[1].error == "" and resps[1].status == Status.UNDER_LIMIT
+
+
+def test_overload_off_is_bit_exact():
+    # No governor (GUBER_OVERLOAD=0): deadline metadata is inert — the
+    # historical engine serves the request like any other.
+    eng = DeviceEngine(
+        EngineConfig(num_groups=1 << 10, batch_size=64, batch_wait_s=0.002)
+    )
+    try:
+        assert eng.overload is None
+        resp = eng.check_batch([mk(metadata=expired_md())])[0]
+        assert resp.error == ""
+        assert resp.status == Status.UNDER_LIMIT and resp.remaining == 9
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# knobs
+
+
+def test_overload_knob_defaults_and_validation(monkeypatch):
+    from gubernator_tpu.service.envconfig import setup_daemon_config
+
+    for k in (
+        "GUBER_OVERLOAD", "GUBER_INTAKE_LIMIT", "GUBER_INTAKE_TARGET_MS",
+        "GUBER_PEER_QUEUE", "GUBER_RETRY_BUDGET",
+    ):
+        monkeypatch.delenv(k, raising=False)
+    conf = setup_daemon_config()
+    assert conf.overload is False  # default off = bit-exact
+    assert conf.intake_limit == 8192
+    assert conf.intake_target_ms == 20.0
+    assert conf.behaviors.peer_queue == 1000
+    assert conf.behaviors.retry_budget == 0.1
+
+    monkeypatch.setenv("GUBER_INTAKE_LIMIT", "0")
+    with pytest.raises(ValueError, match="GUBER_INTAKE_LIMIT"):
+        setup_daemon_config()
+    monkeypatch.delenv("GUBER_INTAKE_LIMIT")
+    monkeypatch.setenv("GUBER_INTAKE_TARGET_MS", "-1")
+    with pytest.raises(ValueError, match="GUBER_INTAKE_TARGET_MS"):
+        setup_daemon_config()
+    monkeypatch.delenv("GUBER_INTAKE_TARGET_MS")
+    monkeypatch.setenv("GUBER_PEER_QUEUE", "0")
+    with pytest.raises(ValueError, match="GUBER_PEER_QUEUE"):
+        setup_daemon_config()
+    monkeypatch.delenv("GUBER_PEER_QUEUE")
+    monkeypatch.setenv("GUBER_RETRY_BUDGET", "1.5")
+    with pytest.raises(ValueError, match="GUBER_RETRY_BUDGET"):
+        setup_daemon_config()
+
+
+# ---------------------------------------------------------------------------
+# daemon wiring: /debug/overload on both listeners
+
+
+@pytest.fixture(scope="module")
+def overload_daemon(loop_thread):
+    from gubernator_tpu.service.config import DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = loop_thread.run(
+        Daemon.spawn(
+            DaemonConfig(
+                cache_size=2048,
+                overload=True,
+                status_http_listen_address="127.0.0.1:0",
+            )
+        ),
+        timeout=120,
+    )
+    yield d
+    loop_thread.run(d.close())
+
+
+def test_debug_overload_on_both_listeners(overload_daemon):
+    d = overload_daemon
+    body = {
+        "requests": [
+            {"name": "ovl", "unique_key": f"k{i}", "duration": 60000,
+             "limit": 100, "hits": 1}
+            for i in range(8)
+        ]
+    }
+    requests.post(
+        f"http://{d.http_address}/v1/GetRateLimits", json=body, timeout=10
+    ).raise_for_status()
+    for addr in (d.http_address, d.status_address):
+        r = requests.get(f"http://{addr}/debug/overload", timeout=10)
+        assert r.status_code == 200
+        info = r.json()
+        assert info["enabled"] is True
+        assert info["level"] == 0 and info["level_name"] == "normal"
+        assert info["intake"]["limit"] == 8192
+        assert set(info["intake"]["shed"]) == {
+            "queue_full", "deadline_expired", "codel", "tenant", "brownout",
+        }
+    # the level gauge is exported
+    m = requests.get(f"http://{d.http_address}/metrics", timeout=10).text
+    assert "gubernator_overload_level 0.0" in m
+
+
+def test_debug_overload_disabled_daemon(loop_thread):
+    from gubernator_tpu.service.config import DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = loop_thread.run(
+        Daemon.spawn(DaemonConfig(cache_size=1024)), timeout=120
+    )
+    try:
+        r = requests.get(
+            f"http://{d.http_address}/debug/overload", timeout=10
+        )
+        assert r.status_code == 200
+        assert r.json() == {"enabled": False}
+    finally:
+        loop_thread.run(d.close())

@@ -1900,6 +1900,88 @@ class GL018BlockingUnderLock(Rule):
 
 
 # ---------------------------------------------------------------------------
+# GL019 — queues on serving paths must be bounded.
+
+_QUEUE_SCOPES = (
+    "gubernator_tpu/runtime/",
+    "gubernator_tpu/parallel/",
+    "gubernator_tpu/service/",
+)
+
+
+class GL019UnboundedQueue(Rule):
+    code = "GL019"
+    name = "unbounded-queue"
+    description = (
+        "queue.SimpleQueue()/queue.Queue()/asyncio.Queue() without a "
+        "positive bound in runtime//parallel//service/ is an invisible "
+        "buffer: under overload it converts memory into latency until "
+        "the process dies (the overload control plane bounds engine "
+        "intake at GUBER_INTAKE_LIMIT for exactly this reason) — pass "
+        "maxsize, or carry an allow-unbounded-queue pragma arguing why "
+        "the producer is bounded elsewhere"
+    )
+    requires_reason = True
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if not scan_path(mod.relpath).startswith(_QUEUE_SCOPES):
+            return []
+        out = []
+        for node, stack in mod.scoped():
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = self._queue_ctor(node.func)
+            if ctor is None:
+                continue
+            # SimpleQueue has no maxsize parameter at all; the others
+            # are unbounded only when maxsize is absent or a literal
+            # <= 0 (a computed bound — validated knob, min(...) — is
+            # trusted).
+            if not ctor.endswith("SimpleQueue") and self._bounded(node):
+                continue
+            fn = func_name(stack)
+            out.append(
+                self.finding(
+                    mod.relpath,
+                    node.lineno,
+                    f"unbounded {ctor}() in '{fn}': pass a maxsize (or "
+                    f"add an allow-unbounded-queue pragma stating what "
+                    f"bounds the producer)",
+                    f"{fn}.{ctor}",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _queue_ctor(f) -> Optional[str]:
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "queue" and f.attr in (
+                "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+            ):
+                return f"queue.{f.attr}"
+            if f.value.id == "asyncio" and f.attr in (
+                "Queue", "LifoQueue", "PriorityQueue",
+            ):
+                return f"asyncio.{f.attr}"
+        return None
+
+    @staticmethod
+    def _bounded(call: ast.Call) -> bool:
+        bound = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        if bound is None:
+            return False
+        if isinstance(bound, ast.Constant):
+            try:
+                return int(bound.value) > 0
+            except (TypeError, ValueError):
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
 # --fix-docs support (GL003 auto-stub).
 
 
